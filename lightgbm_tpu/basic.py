@@ -1,0 +1,452 @@
+"""Python-facing Dataset and Booster.
+
+TPU-native counterpart of the reference python package's basic.py
+(/root/reference/python-package/lightgbm/basic.py:656 Dataset, :1578 Booster). The
+reference bridges to C++ through ctypes; here the "engine" is the in-process
+JAX/XLA core (models/gbdt.py), so these classes own parameter handling, lazy
+construction, reference-binning for validation data, and the train/eval/predict/
+save surface with the same names and semantics.
+"""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .dataset import BinnedDataset, construct_dataset
+from .metric import Metric, create_metric, default_metric_for_objective
+from .models import gbdt as gbdt_mod
+from .models.model_text import dump_model_to_json, load_model_from_string, save_model_to_string
+from .objective import create_objective, objective_from_model_string
+from .utils import log
+from .utils.log import LightGBMError
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values"):  # pandas
+        data = data.values
+    if hasattr(data, "toarray"):  # scipy sparse
+        data = data.toarray()
+    arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr.astype(np.float64, copy=False)
+
+
+class Dataset:
+    """Lazy binned dataset (basic.py:656 semantics: construct on first use)."""
+
+    def __init__(
+        self,
+        data,
+        label=None,
+        reference: Optional["Dataset"] = None,
+        weight=None,
+        group=None,
+        init_score=None,
+        feature_name: Union[str, List[str]] = "auto",
+        categorical_feature: Union[str, List] = "auto",
+        params: Optional[Dict] = None,
+        free_raw_data: bool = False,
+    ) -> None:
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._binned: Optional[BinnedDataset] = None
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+    # -- construction ----------------------------------------------------
+
+    def construct(self, config: Optional[Config] = None) -> "Dataset":
+        if self._binned is not None:
+            return self
+        if config is None:
+            config = Config.from_params(self.params)
+        data = _to_2d_float(self.data)
+        feature_names = None
+        if isinstance(self.feature_name, (list, tuple)):
+            feature_names = list(self.feature_name)
+        elif hasattr(self.data, "columns"):
+            feature_names = [str(c) for c in self.data.columns]
+        cats = None
+        if isinstance(self.categorical_feature, (list, tuple)):
+            cats = list(self.categorical_feature)
+        ref_binned = None
+        if self.reference is not None:
+            self.reference.construct(config)
+            ref_binned = self.reference._binned
+        init_score = self.init_score
+        if self._predictor is not None:
+            # continued training: init score = predictor's raw output on this data
+            init_score = self._predictor_raw_scores(data)
+        self._binned = construct_dataset(
+            data,
+            config,
+            label=np.asarray(self.label, np.float64) if self.label is not None else None,
+            weight=np.asarray(self.weight, np.float64) if self.weight is not None else None,
+            group=np.asarray(self.group) if self.group is not None else None,
+            init_score=init_score,
+            feature_names=feature_names,
+            categorical_feature=cats,
+            reference=ref_binned,
+        )
+        self._config = config
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _predictor_raw_scores(self, data: np.ndarray) -> np.ndarray:
+        raw = self._predictor.predict_raw(data)
+        if raw.ndim == 2:
+            return raw.T.reshape(-1)  # class-major flatten
+        return raw
+
+    def set_predictor(self, booster: Optional["Booster"]) -> None:
+        self._predictor = booster._gbdt if booster is not None else None
+        if self._predictor is not None and self._binned is not None:
+            # dataset was constructed before the predictor was attached
+            # (continued-training path): compute init scores now
+            if self.data is None:
+                log.fatal(
+                    "Cannot set an init-score predictor on an already-constructed "
+                    "Dataset whose raw data was freed"
+                )
+            init = self._predictor_raw_scores(_to_2d_float(self.data))
+            self._binned.metadata.init_score = np.asarray(init, np.float64)
+
+    # -- setters (basic.py Dataset API) -----------------------------------
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._binned is not None:
+            self._binned.metadata.label = np.asarray(label, np.float32).reshape(-1)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._binned is not None and weight is not None:
+            self._binned.metadata.weight = np.asarray(weight, np.float32).reshape(-1)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._binned is not None and init_score is not None:
+            self._binned.metadata.init_score = np.asarray(init_score, np.float64)
+        return self
+
+    def get_label(self):
+        if self._binned is not None:
+            return self._binned.metadata.label
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def num_data(self) -> int:
+        if self._binned is not None:
+            return self._binned.num_data
+        return _to_2d_float(self.data).shape[0]
+
+    def num_feature(self) -> int:
+        if self._binned is not None:
+            return self._binned.num_total_features
+        return _to_2d_float(self.data).shape[1]
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        used_indices = np.asarray(used_indices)
+        sub = Dataset(
+            data=None,
+            label=None,
+            reference=self,
+            params=params or self.params,
+        )
+        sub.used_indices = used_indices
+        return sub
+
+    def create_valid(self, data, label=None, weight=None, group=None, init_score=None, params=None) -> "Dataset":
+        return Dataset(
+            data,
+            label=label,
+            reference=self,
+            weight=weight,
+            group=group,
+            init_score=init_score,
+            params=params or self.params,
+        )
+
+    def construct_subset(self, config: Config) -> BinnedDataset:
+        """Materialize a row-subset BinnedDataset (Dataset::CopySubset path)."""
+        assert self.reference is not None and self.used_indices is not None
+        self.reference.construct(config)
+        parent = self.reference._binned
+        from .dataset import Metadata
+
+        idx = self.used_indices
+        init_sub = None
+        if parent.metadata.init_score is not None:
+            isc = np.asarray(parent.metadata.init_score).reshape(-1)
+            if len(isc) == parent.num_data:
+                init_sub = isc[idx]
+            else:
+                K = len(isc) // parent.num_data
+                init_sub = isc.reshape(K, parent.num_data)[:, idx].reshape(-1)
+        md = Metadata(
+            len(idx),
+            label=None if parent.metadata.label is None else parent.metadata.label[idx],
+            weight=None if parent.metadata.weight is None else parent.metadata.weight[idx],
+            group=None,
+            init_score=init_sub,
+        )
+        # group subsetting: rebuild boundaries from parent's query assignment
+        if parent.metadata.query_boundaries is not None:
+            qb = parent.metadata.query_boundaries
+            qid = np.searchsorted(qb, idx, side="right") - 1
+            # indices must be query-contiguous for ranking subsets
+            sizes = np.diff(np.concatenate([[0], np.nonzero(np.diff(qid))[0] + 1, [len(qid)]]))
+            md.query_boundaries = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        binned = BinnedDataset(
+            parent.bins[:, idx],
+            parent.mappers,
+            parent.used_feature_idx,
+            parent.num_total_features,
+            md,
+            feature_names=parent.feature_names,
+            monotone_constraints=parent.monotone_constraints,
+        )
+        return binned
+
+    def get_binned(self, config: Config) -> BinnedDataset:
+        if self.used_indices is not None:
+            return self.construct_subset(config)
+        self.construct(config)
+        return self._binned
+
+
+class Booster:
+    """Training/prediction handle (basic.py:1578 Booster semantics)."""
+
+    def __init__(
+        self,
+        params: Optional[Dict] = None,
+        train_set: Optional[Dataset] = None,
+        model_file: Optional[str] = None,
+        model_str: Optional[str] = None,
+        silent: bool = False,
+    ) -> None:
+        params = dict(params) if params else {}
+        self.params = params
+        self.train_set = train_set
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._valid_names: List[str] = []
+        if train_set is not None:
+            self.config = Config.from_params(params)
+            binned = train_set.get_binned(self.config)
+            objective = create_objective(self.config)
+            metrics = self._make_metrics(self.config)
+            boosting = self.config.boosting
+            cls = _boosting_class(boosting)
+            self._gbdt = cls(self.config, binned, objective, metrics)
+            self._train_dataset = train_set
+        elif model_file is not None:
+            with open(model_file) as fh:
+                self._load(fh.read(), params)
+        elif model_str is not None:
+            self._load(model_str, params)
+        else:
+            raise LightGBMError("Booster needs train_set, model_file or model_str")
+
+    def _load(self, text: str, params: Dict) -> None:
+        self.config = Config.from_params(params) if params else Config()
+        self._gbdt = load_model_from_string(text, gbdt_mod.GBDT, self.config)
+        obj = objective_from_model_string(getattr(self._gbdt, "loaded_objective", None), self.config)
+        self._gbdt.objective = obj
+        self._train_dataset = None
+
+    def _make_metrics(self, config: Config) -> List[Metric]:
+        names = config.metric if config.metric else [default_metric_for_objective(config.objective)]
+        out = []
+        for n in names:
+            if n in ("", "None", "na", "null", "custom"):
+                continue
+            m = create_metric(n, config)
+            if m is not None:
+                out.append(m)
+        return out
+
+    # -- training --------------------------------------------------------
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        binned = data.get_binned(self.config)
+        metrics = self._make_metrics(self.config)
+        self._gbdt.add_valid(binned, metrics, name)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if stopped (can't split)."""
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        K = self._gbdt.num_tree_per_iteration
+        score = self._gbdt._train_score_np()
+        grad, hess = fobj(_score_for_custom(score, K), self._train_dataset)
+        return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration
+
+    def num_trees(self) -> int:
+        return self._gbdt.num_trees()
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        return self._gbdt.max_feature_idx + 1
+
+    # -- evaluation ------------------------------------------------------
+
+    def eval_train(self, feval=None) -> List:
+        return self._eval_set(self._gbdt._train_score_np(), "training", self._gbdt.training_metrics, feval, self._train_dataset)
+
+    def eval_valid(self, feval=None) -> List:
+        out = []
+        for i, name in enumerate(self._gbdt.valid_names):
+            out.extend(
+                self._eval_set(
+                    self._gbdt._valid_score_np(i), name, self._gbdt.valid_metrics[i], feval, None
+                )
+            )
+        return out
+
+    def _eval_set(self, score, name, metrics, feval, dataset) -> List:
+        results = []
+        for m in metrics:
+            for mname, val, bigger in m.eval(score, self._gbdt.objective):
+                results.append((name, mname, val, bigger))
+        if feval is not None:
+            preds = score if self._gbdt.objective is None else self._gbdt.objective.convert_output(score)
+            ret = feval(preds, dataset)
+            if ret is not None:
+                if isinstance(ret, list):
+                    for (mname, val, bigger) in ret:
+                        results.append((name, mname, val, bigger))
+                else:
+                    mname, val, bigger = ret
+                    results.append((name, mname, val, bigger))
+        return results
+
+    # -- prediction ------------------------------------------------------
+
+    def predict(
+        self,
+        data,
+        num_iteration: int = -1,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        pred_contrib: bool = False,
+        **kwargs,
+    ) -> np.ndarray:
+        X = _to_2d_float(data)
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(X, num_iteration)
+        if pred_contrib:
+            raise LightGBMError("predict_contrib is not implemented yet in lightgbm_tpu")
+        return self._gbdt.predict(X, num_iteration, raw_score=raw_score)
+
+    # -- model IO --------------------------------------------------------
+
+    def save_model(self, filename: str, num_iteration: int = -1, start_iteration: int = 0) -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration))
+        return self
+
+    def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0) -> str:
+        return save_model_to_string(self._gbdt, start_iteration, num_iteration)
+
+    def dump_model(self, num_iteration: int = -1) -> dict:
+        return dump_model_to_json(self._gbdt, num_iteration)
+
+    def feature_importance(self, importance_type: str = "split", iteration: int = -1) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type, iteration)
+
+    def feature_name(self) -> List[str]:
+        ds = self._gbdt.train_set
+        if ds is not None:
+            return ds.feature_names
+        return getattr(self._gbdt, "feature_names", [])
+
+    def reset_parameter(self, params: Dict) -> "Booster":
+        self.params.update(params)
+        self._gbdt.reset_parameter(params)
+        return self
+
+    def __getstate__(self):
+        return {"model_str": self.model_to_string(), "params": self.params}
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.best_iteration = -1
+        self.best_score = {}
+        self._valid_names = []
+        self.train_set = None
+        self._load(state["model_str"], state["params"])
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, memo):
+        return Booster(model_str=self.model_to_string(), params=self.params)
+
+
+def _score_for_custom(score: np.ndarray, K: int) -> np.ndarray:
+    """Custom-fobj score layout: [N] or flattened class-major [K*N] (engine.py)."""
+    if K == 1:
+        return score
+    return score.reshape(-1)
+
+
+def _boosting_class(name: str):
+    from .models.gbdt import GBDT
+
+    if name == "gbdt":
+        return GBDT
+    if name == "dart":
+        from .models.dart import DART
+
+        return DART
+    if name == "goss":
+        from .models.goss import GOSS
+
+        return GOSS
+    if name == "rf":
+        from .models.rf import RandomForest
+
+        return RandomForest
+    log.fatal("Unknown boosting type %s" % name)

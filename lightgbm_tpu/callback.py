@@ -1,0 +1,160 @@
+"""Training callbacks.
+
+Mirrors /root/reference/python-package/lightgbm/callback.py: print_evaluation,
+record_evaluation, reset_parameter, early_stopping, with the same CallbackEnv
+contract and EarlyStopException control flow.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration", "evaluation_result_list"],
+)
+
+
+class EarlyStopException(Exception):
+    def __init__(self, best_iteration: int, best_score) -> None:
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+def _format_eval_result(value, show_stdv: bool = True) -> str:
+    if len(value) == 4:
+        return "%s's %s: %g" % (value[0], value[1], value[2])
+    if len(value) == 5:
+        if show_stdv:
+            return "%s's %s: %g + %g" % (value[0], value[1], value[2], value[4])
+        return "%s's %s: %g" % (value[0], value[1], value[2])
+    raise ValueError("Wrong metric value")
+
+
+def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list and (env.iteration + 1) % period == 0:
+            result = "\t".join(
+                [_format_eval_result(x, show_stdv) for x in env.evaluation_result_list]
+            )
+            print("[%d]\t%s" % (env.iteration + 1, result))
+
+    _callback.order = 10  # type: ignore[attr-defined]
+    return _callback
+
+
+def record_evaluation(eval_result: Dict) -> Callable:
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dictionary")
+    eval_result.clear()
+
+    def _init(env: CallbackEnv) -> None:
+        for data_name, eval_name, _, _ in env.evaluation_result_list:
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for data_name, eval_name, result, _ in env.evaluation_result_list:
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+            eval_result[data_name][eval_name].append(result)
+
+    _callback.order = 20  # type: ignore[attr-defined]
+    return _callback
+
+
+def reset_parameter(**kwargs) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        new_parameters = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        "Length of list %r has to equal to 'num_boost_round'." % key
+                    )
+                new_param = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_param = value(env.iteration - env.begin_iteration)
+            else:
+                raise ValueError("Only list and callable values are supported as a mapping from boosting round index to new parameter value")
+            new_parameters[key] = new_param
+        if new_parameters:
+            env.model.reset_parameter(new_parameters)
+
+    _callback.before_iteration = True  # type: ignore[attr-defined]
+    _callback.order = 10  # type: ignore[attr-defined]
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False, verbose: bool = True) -> Callable:
+    best_score: List = []
+    best_iter: List = []
+    best_score_list: List = []
+    cmp_op: List = []
+    enabled = [True]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = not any(
+            (boost_alias in env.params and env.params[boost_alias] == "dart")
+            for boost_alias in ("boosting", "boosting_type", "boost")
+        )
+        if not enabled[0]:
+            import warnings
+
+            warnings.warn("Early stopping is not available in dart mode")
+            return
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one dataset and eval metric is required for evaluation"
+            )
+        if verbose:
+            print("Training until validation scores don't improve for %d rounds." % stopping_rounds)
+        for eval_ret in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+            if eval_ret[3]:  # bigger is better
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda x, y: x > y)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda x, y: x < y)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not cmp_op:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i in range(len(env.evaluation_result_list)):
+            score = env.evaluation_result_list[i][2]
+            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            elif env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    print(
+                        "Early stopping, best iteration is:\n[%d]\t%s"
+                        % (
+                            best_iter[i] + 1,
+                            "\t".join([_format_eval_result(x) for x in best_score_list[i]]),
+                        )
+                    )
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    print(
+                        "Did not meet early stopping. Best iteration is:\n[%d]\t%s"
+                        % (
+                            best_iter[i] + 1,
+                            "\t".join([_format_eval_result(x) for x in best_score_list[i]]),
+                        )
+                    )
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if first_metric_only:
+                break
+
+    _callback.order = 30  # type: ignore[attr-defined]
+    return _callback

@@ -1,0 +1,306 @@
+"""train() / cv() drivers.
+
+Mirrors /root/reference/python-package/lightgbm/engine.py:19 (train) and :343 (cv):
+callback orchestration, early stopping, init_model continuation, evals_result
+recording, stratified/group k-fold cross validation.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import Config
+from .utils import log
+from .utils.log import LightGBMError
+
+
+def train(
+    params: Dict,
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    valid_sets: Optional[List[Dataset]] = None,
+    valid_names: Optional[List[str]] = None,
+    fobj: Optional[Callable] = None,
+    feval: Optional[Callable] = None,
+    init_model=None,
+    feature_name: str = "auto",
+    categorical_feature: str = "auto",
+    early_stopping_rounds: Optional[int] = None,
+    evals_result: Optional[Dict] = None,
+    verbose_eval: Union[bool, int] = True,
+    learning_rates=None,
+    keep_training_booster: bool = False,
+    callbacks: Optional[List[Callable]] = None,
+) -> Booster:
+    params = dict(params) if params else {}
+    params = Config.canonicalize(params)
+    if "num_iterations" in params:
+        num_boost_round = int(params.pop("num_iterations"))
+    if "early_stopping_round" in params and early_stopping_rounds is None:
+        early_stopping_rounds = int(params.pop("early_stopping_round"))
+    if fobj is not None:
+        params["objective"] = "none"
+    # continued training
+    predictor = None
+    if init_model is not None:
+        if isinstance(init_model, str):
+            predictor = Booster(model_file=init_model)
+        elif isinstance(init_model, Booster):
+            predictor = init_model
+    init_iteration = predictor.current_iteration if predictor is not None else 0
+
+    if feature_name != "auto":
+        train_set.feature_name = feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+    if predictor is not None:
+        train_set.set_predictor(predictor)
+
+    booster = Booster(params=params, train_set=train_set)
+    if predictor is not None:
+        booster._gbdt._merge_from(predictor._gbdt)
+
+    is_valid_contain_train = False
+    train_data_name = "training"
+    if valid_sets is not None:
+        if valid_names is None:
+            valid_names = ["valid_%d" % i for i in range(len(valid_sets))]
+        for i, vset in enumerate(valid_sets):
+            if vset is train_set:
+                is_valid_contain_train = True
+                train_data_name = valid_names[i]
+                continue
+            if vset.reference is None:
+                vset.reference = train_set
+            booster.add_valid(vset, valid_names[i])
+
+    # callbacks
+    cbs = set(callbacks or [])
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval > 0:
+        cbs.add(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(
+            callback_mod.early_stopping(
+                early_stopping_rounds, bool(params.get("first_metric_only", False)),
+                verbose=bool(verbose_eval),
+            )
+        )
+    if learning_rates is not None:
+        cbs.add(callback_mod.reset_parameter(learning_rate=learning_rates))
+    if evals_result is not None:
+        cbs.add(callback_mod.record_evaluation(evals_result))
+    cbs_before = {c for c in cbs if getattr(c, "before_iteration", False)}
+    cbs_after = cbs - cbs_before
+    cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
+    cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
+
+    evaluation_result_list: List = []
+    for i in range(init_iteration, init_iteration + num_boost_round):
+        for cb in cbs_before:
+            cb(
+                callback_mod.CallbackEnv(
+                    model=booster,
+                    params=params,
+                    iteration=i,
+                    begin_iteration=init_iteration,
+                    end_iteration=init_iteration + num_boost_round,
+                    evaluation_result_list=None,
+                )
+            )
+        finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if valid_sets is not None or params.get("is_provide_training_metric"):
+            if is_valid_contain_train:
+                evaluation_result_list.extend(
+                    [(train_data_name, n, v, b) for (_, n, v, b) in booster.eval_train(feval)]
+                )
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(
+                    callback_mod.CallbackEnv(
+                        model=booster,
+                        params=params,
+                        iteration=i,
+                        begin_iteration=init_iteration,
+                        end_iteration=init_iteration + num_boost_round,
+                        evaluation_result_list=evaluation_result_list,
+                    )
+                )
+        except callback_mod.EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            evaluation_result_list = es.best_score
+            break
+        if finished:
+            break
+
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for (dname, ename, v, _) in evaluation_result_list or []:
+        booster.best_score[dname][ename] = v
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration
+    return booster
+
+
+class CVBooster:
+    def __init__(self) -> None:
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold, params, seed, stratified, shuffle, config):
+    full_data.construct(config)
+    num_data = full_data.num_data()
+    binned = full_data._binned
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and hasattr(folds, "split"):
+            group = binned.metadata.query_boundaries
+            group_info = None
+            if group is not None:
+                qid = np.zeros(num_data, np.int64)
+                for q in range(len(group) - 1):
+                    qid[group[q] : group[q + 1]] = q
+                group_info = qid
+            folds = folds.split(X=np.zeros(num_data), y=binned.metadata.label, groups=group_info)
+    else:
+        rng = np.random.RandomState(seed)
+        if binned.metadata.query_boundaries is not None:
+            # group-aware folds: split whole queries
+            nq = binned.metadata.num_queries
+            qperm = rng.permutation(nq) if shuffle else np.arange(nq)
+            fold_qs = np.array_split(qperm, nfold)
+            qb = binned.metadata.query_boundaries
+            folds = []
+            for fq in fold_qs:
+                test_idx = np.concatenate(
+                    [np.arange(qb[q], qb[q + 1]) for q in sorted(fq)]
+                ) if len(fq) else np.array([], np.int64)
+                train_idx = np.setdiff1d(np.arange(num_data), test_idx)
+                folds.append((train_idx, test_idx))
+        elif stratified:
+            label = binned.metadata.label.astype(np.int64)
+            folds = []
+            fold_assign = np.zeros(num_data, np.int64)
+            for cls in np.unique(label):
+                idx = np.nonzero(label == cls)[0]
+                if shuffle:
+                    idx = idx[rng.permutation(len(idx))]
+                fold_assign[idx] = np.arange(len(idx)) % nfold
+            for k in range(nfold):
+                test_idx = np.nonzero(fold_assign == k)[0]
+                train_idx = np.nonzero(fold_assign != k)[0]
+                folds.append((train_idx, test_idx))
+        else:
+            perm = rng.permutation(num_data) if shuffle else np.arange(num_data)
+            chunks = np.array_split(perm, nfold)
+            folds = [
+                (np.setdiff1d(np.arange(num_data), c), np.sort(c)) for c in chunks
+            ]
+    return folds
+
+
+def cv(
+    params: Dict,
+    train_set: Dataset,
+    num_boost_round: int = 100,
+    folds=None,
+    nfold: int = 5,
+    stratified: bool = True,
+    shuffle: bool = True,
+    metrics=None,
+    fobj=None,
+    feval=None,
+    init_model=None,
+    feature_name: str = "auto",
+    categorical_feature: str = "auto",
+    early_stopping_rounds: Optional[int] = None,
+    fpreproc=None,
+    verbose_eval=None,
+    show_stdv: bool = True,
+    seed: int = 0,
+    callbacks=None,
+    eval_train_metric: bool = False,
+) -> Dict[str, List[float]]:
+    params = Config.canonicalize(dict(params) if params else {})
+    if "num_iterations" in params:
+        num_boost_round = int(params.pop("num_iterations"))
+    if "early_stopping_round" in params and early_stopping_rounds is None:
+        early_stopping_rounds = int(params.pop("early_stopping_round"))
+    if metrics is not None:
+        params["metric"] = metrics
+    if params.get("objective") in ("binary",) or str(params.get("objective", "")).startswith("multiclass"):
+        pass
+    else:
+        stratified = False
+    config = Config.from_params(params)
+
+    folds = _make_n_folds(train_set, folds, nfold, params, seed, stratified, shuffle, config)
+
+    results = collections.defaultdict(list)
+    cvboosters = []
+    fold_data = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(np.sort(train_idx))
+        te = train_set.subset(np.sort(test_idx))
+        booster = Booster(params=params, train_set=tr)
+        booster.add_valid(te, "valid")
+        cvboosters.append(booster)
+        fold_data.append((tr, te))
+
+    cbs = set(callbacks or [])
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.add(callback_mod.early_stopping(early_stopping_rounds, verbose=False))
+    if verbose_eval is True:
+        cbs.add(callback_mod.print_evaluation(show_stdv=show_stdv))
+    elif isinstance(verbose_eval, int) and verbose_eval:
+        cbs.add(callback_mod.print_evaluation(verbose_eval, show_stdv))
+    cbs = sorted(cbs, key=lambda c: getattr(c, "order", 0))
+
+    best_iteration = -1
+    for i in range(num_boost_round):
+        agg: Dict[str, List[float]] = collections.defaultdict(list)
+        for booster in cvboosters:
+            booster.update(fobj=fobj)
+            for (dname, ename, v, b) in booster.eval_valid(feval):
+                agg[("%s %s" % (dname, ename), b)].append(v)
+        res_list = []
+        for (key, bigger), vals in agg.items():
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results[key.split(" ", 1)[1] + "-mean"].append(mean)
+            results[key.split(" ", 1)[1] + "-stdv"].append(std)
+            res_list.append(("cv_agg", key.split(" ", 1)[1], mean, bigger, std))
+        try:
+            for cb in cbs:
+                cb(
+                    callback_mod.CallbackEnv(
+                        model=None,
+                        params=params,
+                        iteration=i,
+                        begin_iteration=0,
+                        end_iteration=num_boost_round,
+                        evaluation_result_list=res_list,
+                    )
+                )
+        except callback_mod.EarlyStopException as es:
+            best_iteration = es.best_iteration + 1
+            for key in list(results.keys()):
+                results[key] = results[key][:best_iteration]
+            break
+    return dict(results)

@@ -1,0 +1,136 @@
+"""DART boosting (dropout trees).
+
+TPU-native counterpart of /root/reference/src/boosting/dart.hpp: per iteration a
+random subset of existing trees is dropped (uniform or weight-proportional,
+dart.hpp:97-155), gradients are computed on the reduced score, the new tree is
+shrunk by lr/(k+1), and dropped trees are renormalized by k/(k+1)
+(dart.hpp:158-200 Normalize), with train/valid scores patched accordingly.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.predict import make_predict_tree, tree_predict_value
+from ..utils import log
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    def _setup_train(self, train_set):
+        super()._setup_train(train_set)
+        self._drop_rng = np.random.RandomState(self.config.drop_seed & 0x7FFFFFFF)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self.drop_index: List[int] = []
+        self._dropped_train_preds = {}
+        self._train_bins_t = None
+        log.info("Using DART")
+
+    def _tree_train_pred(self, idx: int):
+        ta, cid = self._device_trees[idx]
+        if ta is None:
+            return None, cid
+        ptree = make_predict_tree(ta, self.feature_meta)
+        return tree_predict_value(self._train_bins_t_dev(), ptree), cid
+
+    def _before_train_iter(self, init_scores):
+        self._select_dropping_trees()
+        K = self.num_tree_per_iteration
+        self._dropped_train_preds = {}
+        for i in self.drop_index:
+            for k in range(K):
+                idx = i * K + k
+                pred, cid = self._tree_train_pred(idx)
+                if pred is None:
+                    continue
+                self._dropped_train_preds[idx] = (pred, cid)
+                self.scores = self.scores.at[cid].add(-pred)
+
+    def _select_dropping_trees(self):
+        """DroppingTrees (dart.hpp:97-155)."""
+        cfg = self.config
+        self.drop_index = []
+        if self._drop_rng.rand() < cfg.skip_drop:
+            pass
+        else:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                if self.sum_weight > 0:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if cfg.max_drop > 0:
+                        drop_rate = min(drop_rate, cfg.max_drop * inv_avg / self.sum_weight)
+                    for i in range(self.iter_):
+                        if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                            self.drop_index.append(i)
+                            if len(self.drop_index) >= cfg.max_drop > 0:
+                                break
+            else:
+                if cfg.max_drop > 0 and self.iter_ > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / float(self.iter_))
+                for i in range(self.iter_):
+                    if self._drop_rng.rand() < drop_rate:
+                        self.drop_index.append(i)
+                        if len(self.drop_index) >= cfg.max_drop > 0:
+                            break
+        k = float(len(self.drop_index))
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = cfg.learning_rate / (1.0 + k)
+        else:
+            if not self.drop_index:
+                self.shrinkage_rate = cfg.learning_rate
+            else:
+                self.shrinkage_rate = cfg.learning_rate / (cfg.learning_rate + k)
+
+    def _after_train_iter(self):
+        """Normalize (dart.hpp:158-200), both standard and xgboost_dart_mode."""
+        cfg = self.config
+        k = float(len(self.drop_index))
+        K = self.num_tree_per_iteration
+        lr = cfg.learning_rate
+        if not cfg.xgboost_dart_mode:
+            # dropped tree ends at weight k/(k+1)
+            valid_factor = 1.0 / (k + 1.0)
+            tree_factor = k / (k + 1.0)
+            weight_denom = k + 1.0
+        else:
+            # dropped tree ends at weight k/(k+lr) (dart.hpp:179-196)
+            valid_factor = lr / (k + lr)
+            tree_factor = k / (k + lr)
+            weight_denom = k + lr
+        for i in self.drop_index:
+            for kk in range(K):
+                idx = i * K + kk
+                ta, cid = self._device_trees[idx]
+                if ta is None:
+                    continue
+                # valid scores lose pred * (1 - tree_factor)
+                if hasattr(self, "valid_scores"):
+                    ptree = make_predict_tree(ta, self.feature_meta)
+                    for vi, bins_t in enumerate(self._valid_bins_t):
+                        v = tree_predict_value(bins_t, ptree)
+                        self.valid_scores[vi] = self.valid_scores[vi].at[cid].add(
+                            -v * np.float32(valid_factor)
+                        )
+                # train scores regain pred * tree_factor (were fully subtracted)
+                pred, cid2 = self._dropped_train_preds.get(idx, (None, cid))
+                if pred is not None:
+                    self.scores = self.scores.at[cid2].add(pred * np.float32(tree_factor))
+                # rescale the stored tree
+                factor = np.float32(tree_factor)
+                self._device_trees[idx] = (
+                    ta._replace(
+                        leaf_value=ta.leaf_value * factor,
+                        internal_value=ta.internal_value * factor,
+                    ),
+                    cid,
+                )
+                self.models[idx] = None  # invalidate stale host copy
+            if not cfg.uniform_drop and self.tree_weight:
+                self.sum_weight -= self.tree_weight[i] * (1.0 / weight_denom)
+                self.tree_weight[i] *= tree_factor
+        self.tree_weight.append(self.shrinkage_rate)
+        self.sum_weight += self.shrinkage_rate
+        self._dropped_train_preds = {}

@@ -1,0 +1,536 @@
+"""GBDT boosting loop.
+
+TPU-native counterpart of the reference GBDT (/root/reference/src/boosting/gbdt.cpp,
+gbdt.h). The training loop structure is preserved — gradients from the objective,
+bagging, per-class tree training, optional leaf renewal, shrinkage, score update,
+metric eval with early stopping, boost-from-average folded into the first trees'
+leaves (gbdt.cpp:308-413) — while the mechanics are TPU-shaped:
+
+ * scores live on device as ``[num_class, N]`` f32; the tree learner returns the
+   per-row leaf assignment so the score update is a gather (no re-traversal),
+   matching ScoreUpdater::AddScore-with-learner-partition (score_updater.hpp:80).
+ * bagging is a per-row {0,1} mask (exactly floor(bagging_fraction*N) rows chosen)
+   instead of index compaction — keeps shapes static for XLA (gbdt.cpp:179-240).
+ * trees stay as device TreeArrays during training and convert to host model Trees
+   lazily (for save/predict); validation scores update by on-device traversal.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import BinnedDataset
+from ..metric import Metric
+from ..objective import ObjectiveFunction
+from ..ops.grow import grow_tree
+from ..ops.predict import PredictTree, make_predict_tree, tree_predict_value
+from ..ops.split import SplitParams
+from ..utils import log
+from .tree import Tree
+
+K_EPSILON = 1e-15
+
+
+class GBDT:
+    """Gradient Boosting Decision Tree trainer/model (gbdt.h:37-501)."""
+
+    def __init__(
+        self,
+        config: Config,
+        train_set: Optional[BinnedDataset],
+        objective: Optional[ObjectiveFunction],
+        training_metrics: Optional[List[Metric]] = None,
+    ) -> None:
+        self.config = config
+        self.objective = objective
+        self.train_set = train_set
+        self.training_metrics = training_metrics or []
+        self.iter_ = 0
+        self.models: List[Tree] = []  # host-side trees (lazy)
+        self._device_trees: List[Tuple] = []  # (TreeArrays, class_id)
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = (
+            objective.num_model_per_iteration if objective is not None else config.num_class
+        )
+        self.shrinkage_rate = config.learning_rate
+        self.max_feature_idx = 0
+        self.label_idx = 0
+        self.average_output = False
+        self._early_stop_best: Dict = {}
+        self._es_counter = 0
+        self.best_iteration = -1
+        self.valid_sets: List[BinnedDataset] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.valid_names: List[str] = []
+        self._eval_history: Dict[str, Dict[str, List[float]]] = {}
+
+        if train_set is not None:
+            self._setup_train(train_set)
+
+    # ------------------------------------------------------------------
+    def _setup_train(self, train_set: BinnedDataset) -> None:
+        cfg = self.config
+        self.num_data = train_set.num_data
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.bins_dev = jnp.asarray(train_set.bins)
+        meta_np = train_set.feature_meta_arrays()
+        self.feature_meta = {k: jnp.asarray(v) for k, v in meta_np.items()}
+        self.num_bins = int(train_set.max_num_bin)
+        self.split_params = SplitParams(
+            lambda_l1=cfg.lambda_l1,
+            lambda_l2=cfg.lambda_l2,
+            max_delta_step=cfg.max_delta_step,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            min_gain_to_split=cfg.min_gain_to_split,
+        )
+        K = self.num_tree_per_iteration
+        init = train_set.metadata.init_score
+        self.scores = jnp.zeros((K, self.num_data), jnp.float32)
+        self._has_init_score = init is not None
+        if init is not None:
+            arr = np.asarray(init, np.float64).reshape(-1)
+            if len(arr) == self.num_data:
+                arr = np.tile(arr, (K, 1)) if K > 1 else arr[None, :]
+            else:
+                arr = arr.reshape(K, self.num_data)
+            self.scores = jnp.asarray(arr, jnp.float32)
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, self.num_data)
+        for m in self.training_metrics:
+            m.init(train_set.metadata, self.num_data)
+        self._bag_rng = np.random.RandomState(cfg.bagging_seed & 0x7FFFFFFF)
+        self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed & 0x7FFFFFFF)
+        self._bag_mask = jnp.ones((self.num_data,), jnp.float32)
+        self._bag_mask_np: Optional[np.ndarray] = None
+        self.class_need_train = [
+            self.objective.class_need_train(k) if self.objective is not None else True
+            for k in range(K)
+        ]
+        self._is_constant_hessian = (
+            self.objective.is_constant_hessian if self.objective is not None else False
+        )
+
+    def add_valid(self, valid_set: BinnedDataset, metrics: List[Metric], name: str) -> None:
+        for m in metrics:
+            m.init(valid_set.metadata, valid_set.num_data)
+        self.valid_sets.append(valid_set)
+        self.valid_metrics.append(metrics)
+        self.valid_names.append(name)
+        K = self.num_tree_per_iteration
+        score = jnp.zeros((K, valid_set.num_data), jnp.float32)
+        init = valid_set.metadata.init_score
+        if init is not None:
+            arr = np.asarray(init, np.float64).reshape(-1)
+            if len(arr) == valid_set.num_data:
+                arr = np.tile(arr, (K, 1)) if K > 1 else arr[None, :]
+            else:
+                arr = arr.reshape(K, valid_set.num_data)
+            score = jnp.asarray(arr, jnp.float32)
+        if not hasattr(self, "valid_scores"):
+            self.valid_scores: List[jax.Array] = []
+            self._valid_bins_t: List[jax.Array] = []
+        self.valid_scores.append(score)
+        self._valid_bins_t.append(jnp.asarray(valid_set.bins.T))
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self, class_id: int) -> float:
+        """gbdt.cpp:308-331."""
+        cfg = self.config
+        if self.models or self._device_trees or self._has_init_score or self.objective is None:
+            return 0.0
+        if cfg.boost_from_average or self.train_set.num_features == 0:
+            init_score = self.objective.boost_from_score(class_id)
+            if abs(init_score) > K_EPSILON:
+                self.scores = self.scores.at[class_id].add(np.float32(init_score))
+                if hasattr(self, "valid_scores"):
+                    for i in range(len(self.valid_scores)):
+                        self.valid_scores[i] = self.valid_scores[i].at[class_id].add(
+                            np.float32(init_score)
+                        )
+                log.info("Start training from score %f" % init_score)
+                return init_score
+        elif self.objective.name in ("regression_l1", "quantile", "mape"):
+            log.warning(
+                "Disabling boost_from_average in %s may cause the slow convergence"
+                % self.objective.name
+            )
+        return 0.0
+
+    def _compute_gradients(self, init_scores) -> Tuple[jax.Array, jax.Array]:
+        """Boosting() (gbdt.cpp:148): objective gradients at the current scores."""
+        K = self.num_tree_per_iteration
+        grad, hess = self.objective.get_gradients(self.scores if K > 1 else self.scores[0])
+        if K == 1:
+            grad, hess = grad[None, :], hess[None, :]
+        return grad, hess
+
+    def _before_train_iter(self, init_scores) -> None:
+        """Hook for boosting variants (DART's tree dropping)."""
+
+    def _after_train_iter(self) -> None:
+        """Hook for boosting variants (DART's normalization)."""
+
+    def _bagging(self, iter_: int, grad, hess) -> Tuple[jax.Array, jax.Array]:
+        """Row-mask bagging (gbdt.cpp:179-240 expressed as a mask).
+
+        Returns possibly-modified gradients (GOSS rescales sampled rows)."""
+        cfg = self.config
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            return grad, hess
+        if iter_ % cfg.bagging_freq == 0:
+            bag_cnt = int(cfg.bagging_fraction * self.num_data)
+            mask = np.zeros(self.num_data, np.float32)
+            idx = self._bag_rng.choice(self.num_data, size=bag_cnt, replace=False)
+            mask[idx] = 1.0
+            self._bag_mask_np = mask
+            self._bag_mask = jnp.asarray(mask)
+        return grad, hess
+
+    def _sample_features(self) -> jax.Array:
+        cfg = self.config
+        F = self.train_set.num_features
+        if cfg.feature_fraction >= 1.0:
+            return jnp.ones((F,), bool)
+        k = max(1, int(cfg.feature_fraction * F))
+        idx = self._feat_rng.choice(F, size=k, replace=False)
+        mask = np.zeros(F, bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(
+        self, gradients: Optional[np.ndarray] = None, hessians: Optional[np.ndarray] = None
+    ) -> bool:
+        """One boosting iteration; returns True if training should stop
+        (TrainOneIter, gbdt.cpp:332-413)."""
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        init_scores = [0.0] * K
+        if gradients is None or hessians is None:
+            for k in range(K):
+                init_scores[k] = self._boost_from_average(k)
+            self._before_train_iter(init_scores)
+            grad, hess = self._compute_gradients(init_scores)
+        else:
+            grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(K, self.num_data))
+            hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(K, self.num_data))
+
+        grad, hess = self._bagging(self.iter_, grad, hess)
+
+        should_continue = False
+        for k in range(K):
+            tree_arrays = None
+            leaf_id = None
+            if self.class_need_train[k] and self.train_set.num_features > 0:
+                tree_arrays, leaf_id = self._train_tree(grad[k], hess[k])
+            num_leaves = int(tree_arrays.num_leaves) if tree_arrays is not None else 1
+            if num_leaves > 1:
+                should_continue = True
+                tree_arrays = self._renew_and_shrink(tree_arrays, leaf_id, k)
+                # score update by leaf gather (all rows incl. out-of-bag)
+                self.scores = self.scores.at[k].add(tree_arrays.leaf_value[leaf_id])
+                self._update_valid_scores(tree_arrays, k)
+                if abs(init_scores[k]) > K_EPSILON:
+                    tree_arrays = tree_arrays._replace(
+                        leaf_value=tree_arrays.leaf_value + np.float32(init_scores[k])
+                    )
+                self._device_trees.append((tree_arrays, k))
+                self.models.append(None)  # lazily converted
+            else:
+                if len(self.models) < K:
+                    output = 0.0
+                    if not self.class_need_train[k]:
+                        if self.objective is not None:
+                            output = self.objective.boost_from_score(k)
+                    else:
+                        output = init_scores[k]
+                    t = Tree(1)
+                    t.leaf_value[0] = output
+                    self.models.append(t)
+                    self._device_trees.append((None, k))
+                    if output != 0.0:
+                        self.scores = self.scores.at[k].add(np.float32(output))
+                        if hasattr(self, "valid_scores"):
+                            for i in range(len(self.valid_scores)):
+                                self.valid_scores[i] = (
+                                    self.valid_scores[i].at[k].add(np.float32(output))
+                                )
+                else:
+                    # keep models_ aligned per iteration
+                    t = Tree(1)
+                    self.models.append(t)
+                    self._device_trees.append((None, k))
+
+        if not should_continue:
+            log.warning(
+                "Stopped training because there are no more leaves that meet the split requirements"
+            )
+            if len(self.models) > K:
+                for _ in range(K):
+                    self.models.pop()
+                    self._device_trees.pop()
+            return True
+        self._after_train_iter()
+        self.iter_ += 1
+        return False
+
+    def _train_tree(self, grad_k: jax.Array, hess_k: jax.Array):
+        cfg = self.config
+        fmask = self._sample_features()
+        return grow_tree(
+            self.bins_dev,
+            grad_k,
+            hess_k,
+            self._bag_mask,
+            fmask,
+            self.feature_meta,
+            num_leaves=cfg.num_leaves,
+            max_depth=cfg.max_depth,
+            num_bins=self.num_bins,
+            params=self.split_params,
+            chunk=cfg.tpu_hist_chunk,
+        )
+
+    def _renew_and_shrink(self, tree_arrays, leaf_id, class_id: int):
+        """RenewTreeOutput (serial_tree_learner.cpp:854) + Shrinkage."""
+        obj = self.objective
+        if obj is not None and obj.is_renew_tree_output:
+            n_leaves = int(tree_arrays.num_leaves)
+            leaf_id_np = np.asarray(leaf_id)
+            score_np = np.asarray(self.scores[class_id], np.float64)
+            outputs = np.asarray(tree_arrays.leaf_value, np.float64).copy()
+            new_out = obj.renew_leaf_outputs(
+                score_np, leaf_id_np, self._bag_mask_np, n_leaves, outputs
+            )
+            tree_arrays = tree_arrays._replace(
+                leaf_value=jnp.asarray(new_out, jnp.float32)
+            )
+        rate = np.float32(self.shrinkage_rate)
+        return tree_arrays._replace(
+            leaf_value=tree_arrays.leaf_value * rate,
+            internal_value=tree_arrays.internal_value * rate,
+        )
+
+    def _update_valid_scores(self, tree_arrays, class_id: int) -> None:
+        if not hasattr(self, "valid_scores"):
+            return
+        ptree = make_predict_tree(tree_arrays, self.feature_meta)
+        for i, bins_t in enumerate(self._valid_bins_t):
+            val = tree_predict_value(bins_t, ptree)
+            self.valid_scores[i] = self.valid_scores[i].at[class_id].add(val)
+
+    # ------------------------------------------------------------------
+    # training driver with eval + early stopping (gbdt.cpp:242-260, 433-535)
+    # ------------------------------------------------------------------
+
+    def train(self) -> None:
+        cfg = self.config
+        start = time.time()
+        for it in range(cfg.num_iterations):
+            finished = self.train_one_iter()
+            if not finished:
+                finished = self.eval_and_check_early_stopping()
+            log.info(
+                "%f seconds elapsed, finished iteration %d" % (time.time() - start, it + 1)
+            )
+            if finished:
+                break
+
+    def eval_and_check_early_stopping(self) -> bool:
+        cfg = self.config
+        if cfg.metric_freq <= 0 or (self.iter_ % cfg.metric_freq != 0 and cfg.early_stopping_round <= 0):
+            return False
+        msgs = self.output_metric(self.iter_)
+        if msgs:
+            log.info(
+                "Early stopping at iteration %d, the best iteration round is %d"
+                % (self.iter_, self.iter_ - cfg.early_stopping_round)
+            )
+            self.best_iteration = self.iter_ - cfg.early_stopping_round
+            drop = cfg.early_stopping_round * self.num_tree_per_iteration
+            for _ in range(drop):
+                self.models.pop()
+                self._device_trees.pop()
+            self.iter_ -= cfg.early_stopping_round
+            return True
+        return False
+
+    def output_metric(self, iter_: int) -> str:
+        """OutputMetric (gbdt.cpp:477-535): print + early-stopping bookkeeping.
+
+        Returns non-empty best-message when early stop triggers.
+        """
+        cfg = self.config
+        es_round = cfg.early_stopping_round
+        print_now = cfg.metric_freq > 0 and iter_ % cfg.metric_freq == 0 and cfg.verbosity >= 1
+        # training metrics
+        if cfg.is_provide_training_metric and print_now:
+            score = self._train_score_np()
+            for m in self.training_metrics:
+                for name, val, _ in m.eval(score, self.objective):
+                    log.info("Iteration:%d, training %s : %g" % (iter_, name, val))
+        # valid metrics
+        met_early = False
+        best_msg = ""
+        for i in range(len(self.valid_sets)):
+            score = self._valid_score_np(i)
+            for j, m in enumerate(self.valid_metrics[i]):
+                results = m.eval(score, self.objective)
+                for name, val, bigger in results:
+                    full = "valid_%d %s" % (i + 1, name)
+                    if print_now:
+                        log.info("Iteration:%d, %s : %g" % (iter_, full, val))
+                    self._eval_history.setdefault(self.valid_names[i], {}).setdefault(
+                        name, []
+                    ).append(val)
+                    if es_round > 0 and (not cfg.first_metric_only or j == 0):
+                        key = (i, name)
+                        cmp = val if bigger else -val
+                        cur = self._early_stop_best.get(key)
+                        if cur is None or cmp > cur[0]:
+                            self._early_stop_best[key] = (cmp, iter_, "%s : %g" % (full, val))
+        if es_round > 0 and self.valid_sets:
+            newest_best = max(v[1] for v in self._early_stop_best.values())
+            if iter_ - newest_best >= es_round:
+                met_early = True
+                best_msg = "; ".join(v[2] for v in self._early_stop_best.values())
+        return best_msg if met_early else ""
+
+    def _train_score_np(self) -> np.ndarray:
+        s = np.asarray(self.scores, np.float64)
+        return s[0] if self.num_tree_per_iteration == 1 else s
+
+    def _valid_score_np(self, i: int) -> np.ndarray:
+        s = np.asarray(self.valid_scores[i], np.float64)
+        return s[0] if self.num_tree_per_iteration == 1 else s
+
+    # ------------------------------------------------------------------
+    # model materialization / prediction
+    # ------------------------------------------------------------------
+
+    def _materialize(self) -> None:
+        for i, (ta, k) in enumerate(self._device_trees):
+            if self.models[i] is None:
+                self.models[i] = Tree.from_device(ta, self.train_set)
+                self.models[i].shrinkage = self.shrinkage_rate
+
+    def num_trees(self) -> int:
+        return len(self.models)
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def trees(self) -> List[Tree]:
+        self._materialize()
+        return self.models
+
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """Raw scores [N] or [N, K] (PredictRaw, gbdt_prediction.cpp:13)."""
+        self._materialize()
+        X = np.asarray(X, np.float64)
+        N = X.shape[0]
+        K = self.num_tree_per_iteration
+        use = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            use = min(use, num_iteration * K)
+        out = np.zeros((K, N), np.float64)
+        for i in range(use):
+            k = i % K
+            out[k] += self.models[i].predict_fast(X)
+        if self.average_output and use > 0:
+            out /= max(use // K, 1)
+        return out[0] if K == 1 else out.T
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1, raw_score: bool = False) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        return self.objective.convert_output(raw)
+
+    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        self._materialize()
+        X = np.asarray(X, np.float64)
+        use = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            use = min(use, num_iteration * self.num_tree_per_iteration)
+        return np.stack(
+            [self.models[i].predict_leaf_fast(X) for i in range(use)], axis=1
+        ).astype(np.int32)
+
+    def rollback_one_iter(self) -> None:
+        """RollbackOneIter (gbdt.cpp:415-431)."""
+        if self.iter_ <= 0:
+            return
+        K = self.num_tree_per_iteration
+        for k in range(K):
+            idx = len(self._device_trees) - K + k
+            ta, cid = self._device_trees[idx]
+            if ta is not None:
+                # subtract this tree's contribution from train/valid scores
+                ptree = make_predict_tree(ta, self.feature_meta)
+                val = tree_predict_value(self._train_bins_t_dev(), ptree)
+                self.scores = self.scores.at[cid].add(-val)
+                if hasattr(self, "valid_scores"):
+                    for i, bins_t in enumerate(self._valid_bins_t):
+                        v = tree_predict_value(bins_t, ptree)
+                        self.valid_scores[i] = self.valid_scores[i].at[cid].add(-v)
+        for _ in range(K):
+            self.models.pop()
+            self._device_trees.pop()
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    def feature_importance(self, importance_type: str = "split", num_iteration: int = -1) -> np.ndarray:
+        self._materialize()
+        n = self.max_feature_idx + 1
+        out = np.zeros(n, np.float64)
+        use = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            use = min(use, num_iteration * self.num_tree_per_iteration)
+        for t in self.models[:use]:
+            if t is None or t.num_leaves <= 1:
+                continue
+            if importance_type == "gain":
+                out += t.feature_importance_gains(n)
+            else:
+                out += t.feature_importance_counts(n)
+        return out
+
+    def eval_history(self) -> Dict:
+        return self._eval_history
+
+    def _train_bins_t_dev(self) -> jax.Array:
+        """Cached row-major [N, F] bin matrix on device for traversals."""
+        if getattr(self, "_train_bins_t_cache", None) is None:
+            self._train_bins_t_cache = jnp.asarray(self.train_set.bins.T)
+        return self._train_bins_t_cache
+
+    def _merge_from(self, other: "GBDT") -> None:
+        """Continued training (init_model): keep the predictor's trees in front
+        (gbdt.h num_init_iteration_ semantics; init scores already seeded via
+        the dataset's predictor-generated init_score)."""
+        other._materialize()
+        self.models = list(other.models) + self.models
+        self._device_trees = [(None, i % max(self.num_tree_per_iteration, 1)) for i in range(len(other.models))] + self._device_trees
+        self.num_init_iteration = len(other.models) // max(other.num_tree_per_iteration, 1)
+
+    def reset_parameter(self, params: Dict) -> None:
+        """reset_parameter callback support (ResetConfig path)."""
+        self.config = self.config.update(params)
+        self.shrinkage_rate = self.config.learning_rate
+        cfg = self.config
+        self.split_params = SplitParams(
+            lambda_l1=cfg.lambda_l1,
+            lambda_l2=cfg.lambda_l2,
+            max_delta_step=cfg.max_delta_step,
+            min_data_in_leaf=cfg.min_data_in_leaf,
+            min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+            min_gain_to_split=cfg.min_gain_to_split,
+        )

@@ -1,0 +1,58 @@
+"""GOSS boosting (Gradient-based One-Side Sampling).
+
+TPU-native counterpart of /root/reference/src/boosting/goss.hpp: keep the top
+``top_rate`` fraction of rows by sum_k |grad_k * hess_k|, sample ``other_rate`` of
+the rest, and amplify the sampled small-gradient rows' grad/hess by
+(n - top_k) / other_k (goss.hpp:91-141). The subset is expressed as a row mask
+(static shapes) instead of index compaction. Like the reference, no subsampling
+for the first 1/learning_rate iterations (goss.hpp:143-146).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    def _setup_train(self, train_set):
+        super()._setup_train(train_set)
+        cfg = self.config
+        if cfg.top_rate + cfg.other_rate > 1.0:
+            log.fatal("top_rate + other_rate must be <= 1.0 in GOSS")
+        if cfg.top_rate <= 0 or cfg.other_rate <= 0:
+            log.fatal("top_rate and other_rate must be positive in GOSS")
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction != 1.0:
+            log.fatal("Cannot use bagging in GOSS")
+        log.info("Using GOSS")
+        self._goss_rng = np.random.RandomState(cfg.bagging_seed & 0x7FFFFFFF)
+
+    def _bagging(self, iter_, grad, hess):
+        cfg = self.config
+        n = self.num_data
+        if iter_ < int(1.0 / cfg.learning_rate):
+            self._bag_mask = jnp.ones((n,), jnp.float32)
+            self._bag_mask_np = None
+            return grad, hess
+        g_np = np.asarray(grad)
+        h_np = np.asarray(hess)
+        score = np.sum(np.abs(g_np * h_np), axis=0)
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        order = np.argsort(-score, kind="stable")
+        top_idx = order[:top_k]
+        rest_idx = order[top_k:]
+        sampled = self._goss_rng.choice(len(rest_idx), size=min(other_k, len(rest_idx)), replace=False)
+        other_idx = rest_idx[sampled]
+        multiply = np.float32((n - top_k) / other_k)
+        mask = np.zeros(n, np.float32)
+        mask[top_idx] = 1.0
+        mask[other_idx] = 1.0
+        amp = np.ones(n, np.float32)
+        amp[other_idx] = multiply
+        self._bag_mask_np = mask
+        self._bag_mask = jnp.asarray(mask)
+        amp_dev = jnp.asarray(amp)[None, :]
+        return grad * amp_dev, hess * amp_dev

@@ -1,0 +1,89 @@
+"""Random-forest mode.
+
+TPU-native counterpart of /root/reference/src/boosting/rf.hpp: bagged trees with no
+shrinkage; gradients are computed at the constant boost-from-average score every
+iteration (rf.hpp:82-103), each tree carries the init bias, and the model output is
+the AVERAGE of tree outputs (average_output, score normalized by iteration count,
+rf.hpp:189 MultiplyScore).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT
+
+K_EPSILON = 1e-15
+
+
+class RandomForest(GBDT):
+    def _setup_train(self, train_set):
+        super()._setup_train(train_set)
+        cfg = self.config
+        if not (cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0):
+            log.fatal("Random forest mode requires bagging (bagging_freq > 0 and bagging_fraction < 1.0)")
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        self._rf_init_scores = None
+        log.info("Using RF (random forest) mode")
+
+    def _boost_from_average(self, class_id):
+        # RF computes the init score but never seeds the score buffer with it
+        # (BoostFromAverage(cur_tree_id, false), rf.hpp:88); the bias rides in
+        # every tree instead, so the average keeps it.
+        return 0.0
+
+    def _rf_init(self):
+        if self._rf_init_scores is None:
+            K = self.num_tree_per_iteration
+            self._rf_init_scores = np.zeros(K)
+            if self.objective is not None and (
+                self.config.boost_from_average or self.train_set.num_features == 0
+            ):
+                for k in range(K):
+                    self._rf_init_scores[k] = self.objective.boost_from_score(k)
+        return self._rf_init_scores
+
+    def _compute_gradients(self, init_scores):
+        init = self._rf_init()
+        K = self.num_tree_per_iteration
+        const_scores = jnp.broadcast_to(
+            jnp.asarray(init, jnp.float32)[:, None], (K, self.num_data)
+        )
+        grad, hess = self.objective.get_gradients(const_scores if K > 1 else const_scores[0])
+        if K == 1:
+            grad, hess = grad[None, :], hess[None, :]
+        return grad, hess
+
+    def _renew_and_shrink(self, tree_arrays, leaf_id, class_id):
+        obj = self.objective
+        init = float(self._rf_init()[class_id])
+        if obj is not None and obj.is_renew_tree_output:
+            n_leaves = int(tree_arrays.num_leaves)
+            leaf_id_np = np.asarray(leaf_id)
+            score_np = np.full(self.num_data, init, np.float64)
+            outputs = np.asarray(tree_arrays.leaf_value, np.float64).copy()
+            new_out = obj.renew_leaf_outputs(
+                score_np, leaf_id_np, self._bag_mask_np, n_leaves, outputs
+            )
+            tree_arrays = tree_arrays._replace(leaf_value=jnp.asarray(new_out, jnp.float32))
+        # no shrinkage; fold the init bias into every tree (rf.hpp:139-143)
+        if abs(init) > K_EPSILON:
+            tree_arrays = tree_arrays._replace(
+                leaf_value=tree_arrays.leaf_value + np.float32(init)
+            )
+        return tree_arrays
+
+    # scores hold the SUM of tree outputs; metrics see the average
+    def _train_score_np(self):
+        s = np.asarray(self.scores, np.float64)
+        it = max(self.current_iteration, 1)
+        s = s / it
+        return s[0] if self.num_tree_per_iteration == 1 else s
+
+    def _valid_score_np(self, i):
+        s = np.asarray(self.valid_scores[i], np.float64)
+        it = max(self.current_iteration, 1)
+        s = s / it
+        return s[0] if self.num_tree_per_iteration == 1 else s

@@ -1,0 +1,314 @@
+"""Host-side flat-array decision tree.
+
+TPU-native counterpart of the reference Tree
+(/root/reference/include/LightGBM/tree.h:58-522, src/io/tree.cpp). The device
+grower (ops/grow.py) emits bin-space TreeArrays; this class owns the *model*
+representation: real-valued thresholds (RealThreshold = BinToValue + AvoidInf,
+dataset.h:504, common.h:665), LightGBM's decision_type bit encoding, the versioned
+text serialization (Tree::ToString, tree.cpp:206), and double-precision numpy
+prediction with NumericalDecision semantics (tree.h:216-255).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+K_ZERO_THRESHOLD = 1e-35
+
+
+def _avoid_inf(x: float) -> float:
+    if x >= 1e300:
+        return 1e300
+    if x <= -1e300:
+        return -1e300
+    if math.isnan(x):
+        return 0.0
+    return x
+
+
+def _short_float(v: float, precision: int = 20) -> str:
+    s = "%.*g" % (precision, float(v))
+    return s
+
+
+class Tree:
+    """A trained decision tree (numerical + one-hot categorical splits)."""
+
+    def __init__(self, num_leaves: int) -> None:
+        n = max(num_leaves, 1)
+        self.num_leaves = n
+        self.split_feature: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.int32)
+        self.threshold_bin: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.int32)
+        self.threshold: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.float64)
+        self.decision_type: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.int8)
+        self.left_child: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.int32)
+        self.right_child: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.int32)
+        self.split_gain: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.float32)
+        self.internal_value: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.float64)
+        self.internal_count: np.ndarray = np.zeros(max(n - 1, 0), dtype=np.int64)
+        self.leaf_value: np.ndarray = np.zeros(n, dtype=np.float64)
+        self.leaf_count: np.ndarray = np.zeros(n, dtype=np.int64)
+        self.shrinkage: float = 1.0
+
+    # -- construction from device output ---------------------------------
+
+    @classmethod
+    def from_device(cls, tree_arrays, dataset) -> "Tree":
+        """Convert bin-space TreeArrays (ops/grow.py) into a model Tree."""
+        n = int(tree_arrays.num_leaves)
+        t = cls(n)
+        if n <= 1:
+            t.leaf_value[0] = float(np.asarray(tree_arrays.leaf_value)[0]) if n == 1 else 0.0
+            t.leaf_count[0] = int(np.asarray(tree_arrays.leaf_count)[0]) if n == 1 else 0
+            return t
+        m = n - 1
+        sf_used = np.asarray(tree_arrays.split_feature)[:m].astype(np.int32)
+        t.threshold_bin = np.asarray(tree_arrays.threshold_bin)[:m].astype(np.int32)
+        dl = np.asarray(tree_arrays.default_left)[:m].astype(bool)
+        t.left_child = np.asarray(tree_arrays.left_child)[:m].astype(np.int32)
+        t.right_child = np.asarray(tree_arrays.right_child)[:m].astype(np.int32)
+        t.split_gain = np.asarray(tree_arrays.split_gain)[:m].astype(np.float32)
+        t.internal_value = np.asarray(tree_arrays.internal_value)[:m].astype(np.float64)
+        t.internal_count = np.rint(np.asarray(tree_arrays.internal_count)[:m]).astype(np.int64)
+        t.leaf_value = np.asarray(tree_arrays.leaf_value)[:n].astype(np.float64)
+        t.leaf_count = np.rint(np.asarray(tree_arrays.leaf_count)[:n]).astype(np.int64)
+
+        # child encodings: device uses -(leaf+1); LightGBM text uses ~leaf == -(leaf+1). Same.
+        t.split_feature = np.array(
+            [dataset.used_feature_idx[f] for f in sf_used], dtype=np.int32
+        )
+        t.threshold = np.zeros(m, dtype=np.float64)
+        t.decision_type = np.zeros(m, dtype=np.int8)
+        for i in range(m):
+            mapper = dataset.mappers[sf_used[i]]
+            dt = 0
+            if mapper.bin_type == 1:  # categorical one-hot: store the category VALUE
+                dt |= K_CATEGORICAL_MASK
+                t.threshold[i] = float(mapper.bin_2_categorical[int(t.threshold_bin[i])])
+            else:
+                t.threshold[i] = _avoid_inf(mapper.bin_to_value(int(t.threshold_bin[i])))
+            if dl[i]:
+                dt |= K_DEFAULT_LEFT_MASK
+            dt |= (mapper.missing_type & 3) << 2
+            t.decision_type[i] = dt
+        return t
+
+    # -- decision helpers -------------------------------------------------
+
+    def _default_left(self, node: int) -> bool:
+        return bool(self.decision_type[node] & K_DEFAULT_LEFT_MASK)
+
+    def _missing_type(self, node: int) -> int:
+        return (int(self.decision_type[node]) >> 2) & 3
+
+    def _is_categorical(self, node: int) -> bool:
+        return bool(self.decision_type[node] & K_CATEGORICAL_MASK)
+
+    # -- prediction (double precision, NumericalDecision tree.h:216) ------
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        leaf = self.predict_leaf(X)
+        return self.leaf_value[leaf]
+
+    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int32)
+        out = np.full(n, -1, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        while active.any():
+            idx = np.nonzero(active)[0]
+            nd = node[idx]
+            fv = X[idx, self.split_feature[nd]].astype(np.float64)
+            go_left = np.zeros(len(idx), dtype=bool)
+            for k in range(len(idx)):
+                go_left[k] = self._decide(int(nd[k]), float(fv[k]))
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            is_leaf = nxt < 0
+            out[idx[is_leaf]] = -(nxt[is_leaf] + 1)
+            node[idx] = nxt
+            active[idx] = ~is_leaf
+        return out
+
+    def _decide(self, node: int, fval: float) -> bool:
+        """NumericalDecision / CategoricalDecision (tree.h:216-271)."""
+        miss = self._missing_type(node)
+        if self._is_categorical(node):
+            if math.isnan(fval):
+                return False
+            return int(fval) == int(self.threshold[node])
+        if math.isnan(fval) and miss != MISSING_NAN:
+            fval = 0.0
+        if (miss == MISSING_ZERO and -K_ZERO_THRESHOLD < fval <= K_ZERO_THRESHOLD) or (
+            miss == MISSING_NAN and math.isnan(fval)
+        ):
+            return self._default_left(node)
+        return fval <= self.threshold[node]
+
+    def predict_fast(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized double-precision traversal (same semantics as predict)."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0])
+        leaf = self.predict_leaf_fast(X)
+        return self.leaf_value[leaf]
+
+    def predict_leaf_fast(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        miss_arr = (self.decision_type.astype(np.int32) >> 2) & 3
+        dl_arr = (self.decision_type & K_DEFAULT_LEFT_MASK) > 0
+        cat_arr = (self.decision_type & K_CATEGORICAL_MASK) > 0
+        node = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        while True:
+            idx = np.nonzero(active)[0]
+            if len(idx) == 0:
+                break
+            nd = node[idx]
+            fv = X[idx, self.split_feature[nd]].astype(np.float64)
+            miss = miss_arr[nd]
+            thr = self.threshold[nd]
+            nanv = np.isnan(fv)
+            fv2 = np.where(nanv & (miss != MISSING_NAN), 0.0, fv)
+            is_zero = (fv2 > -K_ZERO_THRESHOLD) & (fv2 <= K_ZERO_THRESHOLD)
+            use_default = ((miss == MISSING_ZERO) & is_zero) | (
+                (miss == MISSING_NAN) & np.isnan(fv2)
+            )
+            num_left = np.where(use_default, dl_arr[nd], fv2 <= thr)
+            fv_int = np.floor(np.nan_to_num(fv, nan=-1.0)).astype(np.int64)
+            cat_left = (~nanv) & (fv_int == thr.astype(np.int64))
+            go_left = np.where(cat_arr[nd], cat_left, num_left)
+            nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
+            node[idx] = nxt
+            active[idx] = nxt >= 0
+        return -(node + 1)
+
+    # -- transforms --------------------------------------------------------
+
+    def apply_shrinkage(self, rate: float) -> None:
+        """Tree::Shrinkage (tree.h:148)."""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def set_leaf_values(self, values: np.ndarray) -> None:
+        self.leaf_value = np.asarray(values, dtype=np.float64)[: self.num_leaves]
+
+    def feature_importance_counts(self, num_total_features: int) -> np.ndarray:
+        out = np.zeros(num_total_features, dtype=np.float64)
+        for f in self.split_feature:
+            out[f] += 1
+        return out
+
+    def feature_importance_gains(self, num_total_features: int) -> np.ndarray:
+        out = np.zeros(num_total_features, dtype=np.float64)
+        for f, g in zip(self.split_feature, self.split_gain):
+            out[f] += float(g)
+        return out
+
+    # -- serialization (Tree::ToString, tree.cpp:206) ----------------------
+
+    def to_string(self) -> str:
+        lines = []
+        lines.append("num_leaves=%d" % self.num_leaves)
+        lines.append("num_cat=0")
+        n1 = self.num_leaves - 1
+        lines.append("split_feature=" + " ".join(str(int(v)) for v in self.split_feature[:n1]))
+        lines.append("split_gain=" + " ".join(_short_float(v, 8) for v in self.split_gain[:n1]))
+        lines.append("threshold=" + " ".join(_short_float(v) for v in self.threshold[:n1]))
+        lines.append("decision_type=" + " ".join(str(int(v)) for v in self.decision_type[:n1]))
+        lines.append("left_child=" + " ".join(str(int(v)) for v in self.left_child[:n1]))
+        lines.append("right_child=" + " ".join(str(int(v)) for v in self.right_child[:n1]))
+        lines.append("leaf_value=" + " ".join(_short_float(v) for v in self.leaf_value[: self.num_leaves]))
+        lines.append("leaf_count=" + " ".join(str(int(v)) for v in self.leaf_count[: self.num_leaves]))
+        lines.append("internal_value=" + " ".join(_short_float(v, 8) for v in self.internal_value[:n1]))
+        lines.append("internal_count=" + " ".join(str(int(v)) for v in self.internal_count[:n1]))
+        lines.append("shrinkage=" + _short_float(self.shrinkage, 8))
+        lines.append("")
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_string(cls, text: str) -> "Tree":
+        kv: Dict[str, str] = {}
+        for line in text.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        n = int(kv["num_leaves"])
+        t = cls(n)
+
+        def arr(key, dtype, count):
+            if count <= 0 or key not in kv or kv[key] == "":
+                return np.zeros(max(count, 0), dtype=dtype)
+            vals = kv[key].split()
+            return np.asarray([float(x) for x in vals], dtype=np.float64).astype(dtype)
+
+        n1 = n - 1
+        t.split_feature = arr("split_feature", np.int32, n1)
+        t.split_gain = arr("split_gain", np.float32, n1)
+        t.threshold = arr("threshold", np.float64, n1)
+        t.decision_type = arr("decision_type", np.int8, n1)
+        t.left_child = arr("left_child", np.int32, n1)
+        t.right_child = arr("right_child", np.int32, n1)
+        t.leaf_value = arr("leaf_value", np.float64, n)
+        t.leaf_count = arr("leaf_count", np.int64, n)
+        t.internal_value = arr("internal_value", np.float64, n1)
+        t.internal_count = arr("internal_count", np.int64, n1)
+        t.shrinkage = float(kv.get("shrinkage", 1.0))
+        return t
+
+    def to_json(self) -> dict:
+        """Tree::ToJSON (tree.cpp:243) as a python dict."""
+        if self.num_leaves == 1:
+            structure = {"leaf_value": float(self.leaf_value[0])}
+        else:
+            structure = self._node_json(0)
+        return {
+            "num_leaves": int(self.num_leaves),
+            "num_cat": 0,
+            "shrinkage": self.shrinkage,
+            "tree_structure": structure,
+        }
+
+    def _node_json(self, index: int) -> dict:
+        if index < 0:
+            leaf = -(index + 1)
+            return {
+                "leaf_index": int(leaf),
+                "leaf_value": float(self.leaf_value[leaf]),
+                "leaf_count": int(self.leaf_count[leaf]),
+            }
+        miss = ["None", "Zero", "NaN"][self._missing_type(index)]
+        return {
+            "split_index": int(index),
+            "split_feature": int(self.split_feature[index]),
+            "split_gain": float(self.split_gain[index]),
+            "threshold": float(self.threshold[index]),
+            "decision_type": "==" if self._is_categorical(index) else "<=",
+            "default_left": self._default_left(index),
+            "missing_type": miss,
+            "internal_value": float(self.internal_value[index]),
+            "internal_count": int(self.internal_count[index]),
+            "left_child": self._node_json(int(self.left_child[index])),
+            "right_child": self._node_json(int(self.right_child[index])),
+        }
+
+    def max_depth(self) -> int:
+        if self.num_leaves <= 1:
+            return 0
+
+        def depth(node, d):
+            if node < 0:
+                return d
+            return max(depth(int(self.left_child[node]), d + 1), depth(int(self.right_child[node]), d + 1))
+
+        return depth(0, 0)

@@ -1,0 +1,1 @@
+from . import histogram, split, grow, predict  # noqa: F401

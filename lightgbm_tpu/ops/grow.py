@@ -1,0 +1,363 @@
+"""Jitted leaf-wise (best-first) tree growth.
+
+TPU-native counterpart of SerialTreeLearner::Train
+(/root/reference/src/treelearner/serial_tree_learner.cpp:173-237) and its split loop.
+Differences from the reference are architectural, not semantic:
+
+ * Leaf membership is a per-row ``leaf_id`` vector updated with ``where`` instead of
+   DataPartition's index reshuffle (data_partition.hpp:111) — fully vectorized, no
+   sorting, static shapes.
+ * The whole num_leaves-1 split loop runs inside one ``lax.while_loop`` so a tree
+   trains without host round-trips.
+ * The smaller/larger-leaf histogram subtraction trick (serial_tree_learner.cpp:510,
+   feature_histogram.hpp:75 Subtract) is kept: per split, one masked histogram pass
+   over the smaller child; the larger child's histogram is parent minus smaller.
+ * Monotone-constraint windows per leaf mirror serial_tree_learner.cpp:841-850.
+ * With ``axis_name`` set (under shard_map), rows are sharded across the mesh and
+   the histogram/root sums are combined with psum — the data-parallel learner's
+   dataflow (data_parallel_tree_learner.cpp:149-257) collapsed onto XLA collectives.
+
+Output is a flat-array tree in *bin space*; the host Tree object (models/tree.py)
+converts thresholds to real values with the BinMappers for prediction on raw data.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import leaf_histogram, leaf_values
+from .split import (
+    MISSING_NAN,
+    MISSING_ZERO,
+    SplitParams,
+    SplitResult,
+    calculate_leaf_output,
+    find_best_split,
+)
+
+
+class TreeArrays(NamedTuple):
+    """Flat-array decision tree (bin-space thresholds), mirroring tree.h:58-522."""
+
+    num_leaves: jax.Array  # scalar int32: leaves actually grown
+    split_feature: jax.Array  # [M-1] int32 (used-feature index)
+    threshold_bin: jax.Array  # [M-1] int32
+    default_left: jax.Array  # [M-1] bool
+    left_child: jax.Array  # [M-1] int32 (node idx, or -(leaf+1) for leaves)
+    right_child: jax.Array  # [M-1] int32
+    split_gain: jax.Array  # [M-1] f32
+    internal_value: jax.Array  # [M-1] f32
+    internal_count: jax.Array  # [M-1] f32
+    leaf_value: jax.Array  # [M] f32
+    leaf_count: jax.Array  # [M] f32
+    leaf_weight: jax.Array  # [M] f32 (sum of hessians)
+    leaf_parent: jax.Array  # [M] int32
+    leaf_depth: jax.Array  # [M] int32
+
+
+class GrowState(NamedTuple):
+    it: jax.Array
+    leaf_id: jax.Array  # [N] int32
+    tree: TreeArrays
+    best: SplitResult  # per-leaf best splits, each field [M]
+    leaf_sum_grad: jax.Array  # [M]
+    leaf_sum_hess: jax.Array
+    leaf_num_data: jax.Array
+    min_con: jax.Array  # [M] monotone windows
+    max_con: jax.Array
+    hist: jax.Array  # [M, F, B, 3]
+
+
+def _vmapped_split(params: SplitParams):
+    return jax.vmap(
+        lambda h, sg, sh, nd, mnc, mxc, meta, fmask: find_best_split(
+            h, sg, sh, nd, mnc, mxc, meta, fmask, params
+        ),
+        in_axes=(0, 0, 0, 0, 0, 0, None, None),
+    )
+
+
+def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat):
+    """Bin-space split decision (dense_bin.hpp Split / CategoricalDecision)."""
+    go_left = col <= threshold
+    is_zero_missing = missing_type == MISSING_ZERO
+    is_nan_missing = missing_type == MISSING_NAN
+    go_left = jnp.where(is_zero_missing & (col == default_bin), default_left, go_left)
+    go_left = jnp.where(is_nan_missing & (col == nan_bin), default_left, go_left)
+    # categorical one-hot: only the chosen category's bin goes left
+    go_left = jnp.where(is_cat, col == threshold, go_left)
+    return go_left
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "max_depth", "num_bins", "params", "chunk", "axis_name"),
+)
+def grow_tree(
+    bins: jax.Array,  # [F, N] uint8/int32
+    grad: jax.Array,  # [N] f32 (already zeroed outside the bag)
+    hess: jax.Array,  # [N] f32
+    bag_mask: jax.Array,  # [N] f32 (1.0 = in bag)
+    feature_mask: jax.Array,  # [F] bool (feature_fraction sample)
+    feature_meta: Dict[str, jax.Array],
+    num_leaves: int,
+    max_depth: int,
+    num_bins: int,
+    params: SplitParams,
+    chunk: int = 4096,
+    axis_name: Optional[str] = None,
+):
+    """Grow one tree; returns (TreeArrays, leaf_id [N])."""
+    F, N = bins.shape
+    M = num_leaves
+    B = num_bins
+    f32 = jnp.float32
+
+    vsplit = _vmapped_split(params)
+
+    def masked_values(mask_f32):
+        return leaf_values(grad, hess, mask_f32 * bag_mask)
+
+    # ---- root ----------------------------------------------------------
+    root_vals = masked_values(jnp.ones((N,), f32))
+    root_hist = leaf_histogram(bins, root_vals, B, chunk=chunk, axis_name=axis_name)
+    # Root totals from the histogram of feature 0 would miss rows in padded bins;
+    # sum the mask directly instead (psum'd under shard_map like GBDT's root sync,
+    # serial_tree_learner.cpp:271 BeforeTrain).
+    root_g = jnp.sum(grad * bag_mask)
+    root_h = jnp.sum(hess * bag_mask)
+    root_n = jnp.sum(bag_mask)
+    if axis_name is not None:
+        root_g = jax.lax.psum(root_g, axis_name)
+        root_h = jax.lax.psum(root_h, axis_name)
+        root_n = jax.lax.psum(root_n, axis_name)
+
+    neg_inf = jnp.float32(-jnp.inf)
+    no_con_min = jnp.full((M,), -jnp.inf, f32)
+    no_con_max = jnp.full((M,), jnp.inf, f32)
+
+    root_split = find_best_split(
+        root_hist,
+        root_g,
+        root_h,
+        root_n,
+        no_con_min[0],
+        no_con_max[0],
+        feature_meta,
+        feature_mask,
+        params,
+    )
+
+    def expand(res: SplitResult, idx: int) -> SplitResult:
+        """Scatter a single-leaf SplitResult into [M]-sized per-leaf arrays."""
+        return SplitResult(
+            *[
+                jnp.full((M,), _field_init(name), dtype=getattr(res, name).dtype)
+                .at[idx]
+                .set(getattr(res, name))
+                for name in SplitResult._fields
+            ]
+        )
+
+    def _field_init(name):
+        return -jnp.inf if name == "gain" else 0
+
+    best0 = expand(root_split, 0)
+
+    tree0 = TreeArrays(
+        num_leaves=jnp.int32(1),
+        split_feature=jnp.zeros((M - 1,), jnp.int32),
+        threshold_bin=jnp.zeros((M - 1,), jnp.int32),
+        default_left=jnp.zeros((M - 1,), bool),
+        left_child=jnp.zeros((M - 1,), jnp.int32),
+        right_child=jnp.zeros((M - 1,), jnp.int32),
+        split_gain=jnp.zeros((M - 1,), f32),
+        internal_value=jnp.zeros((M - 1,), f32),
+        internal_count=jnp.zeros((M - 1,), f32),
+        leaf_value=jnp.zeros((M,), f32).at[0].set(
+            calculate_leaf_output(root_g, root_h, params)
+        ),
+        leaf_count=jnp.zeros((M,), f32).at[0].set(root_n),
+        leaf_weight=jnp.zeros((M,), f32).at[0].set(root_h),
+        leaf_parent=jnp.full((M,), -1, jnp.int32),
+        leaf_depth=jnp.zeros((M,), jnp.int32),  # root depth 0 (tree.cpp ctor)
+    )
+
+    hist0 = jnp.zeros((M, F, B, 3), f32).at[0].set(root_hist)
+
+    state0 = GrowState(
+        it=jnp.int32(0),
+        leaf_id=jnp.zeros((N,), jnp.int32),
+        tree=tree0,
+        best=best0,
+        leaf_sum_grad=jnp.zeros((M,), f32).at[0].set(root_g),
+        leaf_sum_hess=jnp.zeros((M,), f32).at[0].set(root_h),
+        leaf_num_data=jnp.zeros((M,), f32).at[0].set(root_n),
+        min_con=no_con_min,
+        max_con=no_con_max,
+        hist=hist0,
+    )
+
+    num_bin_arr = feature_meta["num_bin"].astype(jnp.int32)
+    missing_arr = feature_meta["missing_type"].astype(jnp.int32)
+    default_bin_arr = feature_meta["default_bin"].astype(jnp.int32)
+    mono_arr = feature_meta["monotone"].astype(jnp.int32)
+    is_cat_arr = feature_meta.get("is_categorical")
+    if is_cat_arr is None:
+        is_cat_arr = jnp.zeros((F,), bool)
+    else:
+        is_cat_arr = is_cat_arr.astype(bool)
+
+    def depth_gate(gain, depth):
+        if max_depth > 0:
+            return jnp.where(depth >= max_depth, neg_inf, gain)
+        return gain
+
+    def cond(s: GrowState):
+        return (s.it < M - 1) & (jnp.max(s.best.gain) > 0.0)
+
+    def body(s: GrowState) -> GrowState:
+        best_leaf = jnp.argmax(s.best.gain).astype(jnp.int32)
+        rec = SplitResult(*[getattr(s.best, n)[best_leaf] for n in SplitResult._fields])
+        node = s.it
+        new_leaf = s.tree.num_leaves
+
+        f = rec.feature
+        col = jax.lax.dynamic_slice(bins, (f, 0), (1, N))[0].astype(jnp.int32)
+        go_left = _decision_go_left(
+            col,
+            rec.threshold,
+            rec.default_left,
+            missing_arr[f],
+            default_bin_arr[f],
+            num_bin_arr[f] - 1,
+            is_cat_arr[f],
+        )
+        in_leaf = s.leaf_id == best_leaf
+        leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, s.leaf_id)
+
+        # ---- wire the tree ------------------------------------------------
+        t = s.tree
+        parent = t.leaf_parent[best_leaf]
+        parent_safe = jnp.maximum(parent, 0)
+        enc_old = -(best_leaf + 1)
+        lc = t.left_child
+        rc = t.right_child
+        lc = lc.at[parent_safe].set(
+            jnp.where((parent >= 0) & (lc[parent_safe] == enc_old), node, lc[parent_safe])
+        )
+        rc = rc.at[parent_safe].set(
+            jnp.where((parent >= 0) & (rc[parent_safe] == enc_old), node, rc[parent_safe])
+        )
+        lc = lc.at[node].set(-(best_leaf + 1))
+        rc = rc.at[node].set(-(new_leaf + 1))
+
+        depth_child = t.leaf_depth[best_leaf] + 1
+        parent_value = calculate_leaf_output(
+            s.leaf_sum_grad[best_leaf], s.leaf_sum_hess[best_leaf], params
+        )
+        tree = TreeArrays(
+            num_leaves=t.num_leaves + 1,
+            split_feature=t.split_feature.at[node].set(f),
+            threshold_bin=t.threshold_bin.at[node].set(rec.threshold),
+            default_left=t.default_left.at[node].set(rec.default_left),
+            left_child=lc,
+            right_child=rc,
+            split_gain=t.split_gain.at[node].set(rec.gain),
+            internal_value=t.internal_value.at[node].set(parent_value),
+            internal_count=t.internal_count.at[node].set(s.leaf_num_data[best_leaf]),
+            leaf_value=t.leaf_value.at[best_leaf]
+            .set(rec.left_output)
+            .at[new_leaf]
+            .set(rec.right_output),
+            leaf_count=t.leaf_count.at[best_leaf]
+            .set(rec.left_count)
+            .at[new_leaf]
+            .set(rec.right_count),
+            leaf_weight=t.leaf_weight.at[best_leaf]
+            .set(rec.left_sum_hess)
+            .at[new_leaf]
+            .set(rec.right_sum_hess),
+            leaf_parent=t.leaf_parent.at[best_leaf].set(node).at[new_leaf].set(node),
+            leaf_depth=t.leaf_depth.at[best_leaf]
+            .set(depth_child)
+            .at[new_leaf]
+            .set(depth_child),
+        )
+
+        # ---- leaf aggregates ---------------------------------------------
+        lsg = s.leaf_sum_grad.at[best_leaf].set(rec.left_sum_grad).at[new_leaf].set(rec.right_sum_grad)
+        lsh = s.leaf_sum_hess.at[best_leaf].set(rec.left_sum_hess).at[new_leaf].set(rec.right_sum_hess)
+        lnd = s.leaf_num_data.at[best_leaf].set(rec.left_count).at[new_leaf].set(rec.right_count)
+
+        # ---- monotone windows (serial_tree_learner.cpp:841-850) ----------
+        mono_f = mono_arr[f]
+        mid = (rec.left_output + rec.right_output) / 2.0
+        pmin = s.min_con[best_leaf]
+        pmax = s.max_con[best_leaf]
+        # increasing (+1): left <= right  -> left.max = mid, right.min = mid
+        # decreasing (-1): left >= right  -> left.min = mid, right.max = mid
+        l_min = jnp.where(mono_f < 0, mid, pmin)
+        l_max = jnp.where(mono_f > 0, mid, pmax)
+        r_min = jnp.where(mono_f > 0, mid, pmin)
+        r_max = jnp.where(mono_f < 0, mid, pmax)
+        min_con = s.min_con.at[best_leaf].set(l_min).at[new_leaf].set(r_min)
+        max_con = s.max_con.at[best_leaf].set(l_max).at[new_leaf].set(r_max)
+
+        # ---- histograms: smaller child pass + subtraction ----------------
+        left_smaller = rec.left_count <= rec.right_count
+        small_idx = jnp.where(left_smaller, best_leaf, new_leaf)
+        large_idx = jnp.where(left_smaller, new_leaf, best_leaf)
+        small_mask = (leaf_id == small_idx).astype(f32)
+        small_hist = leaf_histogram(
+            bins, masked_values(small_mask), B, chunk=chunk, axis_name=axis_name
+        )
+        parent_hist = s.hist[best_leaf]
+        large_hist = parent_hist - small_hist
+        hist = s.hist.at[small_idx].set(small_hist).at[large_idx].set(large_hist)
+
+        # ---- children best splits ----------------------------------------
+        child_idx = jnp.stack([best_leaf, new_leaf])
+        ch_hist = hist[child_idx]
+        ch_sg = lsg[child_idx]
+        ch_sh = lsh[child_idx]
+        ch_nd = lnd[child_idx]
+        ch_min = min_con[child_idx]
+        ch_max = max_con[child_idx]
+        ch_split = vsplit(ch_hist, ch_sg, ch_sh, ch_nd, ch_min, ch_max, feature_meta, feature_mask)
+        ch_gain = depth_gate(ch_split.gain, depth_child)
+
+        def upd(field_arr, child_vals):
+            return field_arr.at[best_leaf].set(child_vals[0]).at[new_leaf].set(child_vals[1])
+
+        best = SplitResult(
+            *[
+                upd(
+                    getattr(s.best, n),
+                    ch_gain if n == "gain" else getattr(ch_split, n),
+                )
+                for n in SplitResult._fields
+            ]
+        )
+
+        return GrowState(
+            it=s.it + 1,
+            leaf_id=leaf_id,
+            tree=tree,
+            best=best,
+            leaf_sum_grad=lsg,
+            leaf_sum_hess=lsh,
+            leaf_num_data=lnd,
+            min_con=min_con,
+            max_con=max_con,
+            hist=hist,
+        )
+
+    if M > 1:
+        final = jax.lax.while_loop(cond, body, state0)
+    else:
+        final = state0
+    return final.tree, final.leaf_id

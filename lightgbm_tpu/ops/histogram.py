@@ -1,0 +1,115 @@
+"""Per-leaf gradient/hessian histogram construction.
+
+TPU-native replacement for the reference's histogram kernels — the CPU scatter-add
+loops (DenseBin::ConstructHistogram, /root/reference/src/io/dense_bin.hpp:71-167) and
+the OpenCL workgroup kernels (src/treelearner/ocl/histogram256.cl). TPUs have no fast
+atomics, so the scatter-add becomes a chunked one-hot contraction that XLA maps onto
+the MXU/VPU: for each row-chunk, ``onehot(bins) @ [grad*mask, hess*mask, mask]``
+accumulated over chunks with ``lax.scan``.
+
+The histogram layout is ``[num_features, num_bins, 3]`` float32 with channels
+(sum_grad, sum_hess, count) — the dtype-native analogue of the reference's
+20-byte HistogramBinEntry {double, double, int32} (bin.h:33-62). float32
+accumulation follows the reference's GPU path, which demonstrates AUC parity with
+single-precision accumulators (docs/GPU-Performance.rst:131-145).
+
+A Pallas kernel with VMEM-resident accumulators replaces this op when available
+(ops/hist_pallas.py); this module is the portable XLA fallback and the reference
+implementation for its tests.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:
+    """Bound the transient one-hot tensor to ~64MB of f32."""
+    budget = 64 * 1024 * 1024 // 4
+    c = budget // max(num_features * num_bins, 1)
+    c = max(256, min(int(c), requested))
+    # round down to a multiple of 256 for clean tiling
+    return max(256, (c // 256) * 256)
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins", "chunk", "axis_name"))
+def leaf_histogram(
+    bins: jax.Array,
+    values: jax.Array,
+    num_bins: int,
+    chunk: int = 4096,
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """Histogram of per-row values over binned features.
+
+    Args:
+      bins: ``[F, N]`` integer bin matrix (uint8/int32). N must be a multiple of
+        the chunk size actually used (pad rows with value-0 masked entries).
+      values: ``[N, K]`` float32 per-row accumulands; K is typically 3 for
+        (grad*mask, hess*mask, mask). Rows outside the leaf must already be
+        zeroed via the mask.
+      num_bins: histogram width B (padded max over features).
+      axis_name: if set, psum the result over that mesh axis (the data-parallel
+        ReduceScatter path of data_parallel_tree_learner.cpp:161 collapsed into
+        one XLA collective).
+
+    Returns:
+      ``[F, B, K]`` float32 histogram.
+    """
+    F, N = bins.shape
+    K = values.shape[1]
+    B = num_bins
+    C = _pick_chunk(F, B, chunk)
+    if N % C != 0:
+        pad = (-N) % C
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        values = jnp.pad(values, ((0, pad), (0, 0)))
+        N += pad
+    n_chunks = N // C
+
+    bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
+    vals_c = values.reshape(n_chunks, C, K)  # [n, C, K]
+
+    iota = jnp.arange(B, dtype=jnp.int32)
+
+    def body(acc, inputs):
+        b, v = inputs  # [F, C], [C, K]
+        onehot = (b.astype(jnp.int32)[:, :, None] == iota[None, None, :]).astype(jnp.float32)
+        # [F, C, B] x [C, K] -> [F, B, K]; f32 accumulate on MXU
+        # contract the C axis: [F, C, B] . [C, K] -> [F, B, K]
+        acc = acc + jax.lax.dot_general(
+            onehot,
+            v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, None
+
+    init = jnp.zeros((F, B, K), dtype=jnp.float32)
+    hist, _ = jax.lax.scan(body, init, (bins_c, vals_c))
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
+    return hist
+
+
+def leaf_values(
+    grad: jax.Array, hess: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Stack (grad, hess, 1) * mask into the [N, 3] accumuland matrix."""
+    m = mask.astype(jnp.float32)
+    return jnp.stack([grad * m, hess * m, m], axis=1)
+
+
+def histogram_reference(bins: np.ndarray, values: np.ndarray, num_bins: int) -> np.ndarray:
+    """Numpy oracle for tests (mirrors dense_bin.hpp:71-167 accumulation order-free)."""
+    F, N = bins.shape
+    K = values.shape[1]
+    out = np.zeros((F, num_bins, K), dtype=np.float64)
+    for f in range(F):
+        for k in range(K):
+            np.add.at(out[f, :, k], bins[f].astype(np.int64), values[:, k])
+    return out.astype(np.float32)

@@ -1,0 +1,128 @@
+"""Vectorized tree traversal on device.
+
+TPU-native counterpart of Tree::Predict / GetLeaf
+(/root/reference/include/LightGBM/tree.h:116,491) and GBDT's batch scoring
+(src/boosting/gbdt_prediction.cpp). The reference walks one row at a time through
+pointer-ish child arrays; here all rows advance one level per step of a
+``lax.while_loop`` over node-index vectors — wide gathers instead of per-row chase.
+
+Traversal is in *bin space*: rows are binned with the training BinMappers first, so
+the decision at a node needs only integer compares plus the missing-bin rules
+(dense_bin.hpp Split semantics). Negative node ids encode leaves as -(leaf+1).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .split import MISSING_NAN, MISSING_ZERO
+
+
+class PredictTree(NamedTuple):
+    """Device-side flat tree for traversal (subset of TreeArrays + feature meta)."""
+
+    split_feature: jax.Array  # [M-1] int32
+    threshold_bin: jax.Array  # [M-1] int32
+    default_left: jax.Array  # [M-1] bool
+    left_child: jax.Array  # [M-1] int32
+    right_child: jax.Array  # [M-1] int32
+    leaf_value: jax.Array  # [M] f32
+    missing_type: jax.Array  # [M-1] int32 (per split node, gathered from feature)
+    default_bin: jax.Array  # [M-1] int32
+    nan_bin: jax.Array  # [M-1] int32
+    is_cat: jax.Array  # [M-1] bool
+    num_leaves: jax.Array  # scalar int32
+
+
+def make_predict_tree(tree, feature_meta) -> PredictTree:
+    """Bundle TreeArrays with per-node feature metadata for traversal."""
+    f = tree.split_feature
+    num_bin = feature_meta["num_bin"].astype(jnp.int32)
+    is_cat = feature_meta.get("is_categorical")
+    if is_cat is None:
+        is_cat_nodes = jnp.zeros(f.shape, bool)
+    else:
+        is_cat_nodes = is_cat.astype(bool)[f]
+    return PredictTree(
+        split_feature=tree.split_feature.astype(jnp.int32),
+        threshold_bin=tree.threshold_bin.astype(jnp.int32),
+        default_left=tree.default_left,
+        left_child=tree.left_child.astype(jnp.int32),
+        right_child=tree.right_child.astype(jnp.int32),
+        leaf_value=tree.leaf_value.astype(jnp.float32),
+        missing_type=feature_meta["missing_type"].astype(jnp.int32)[f],
+        default_bin=feature_meta["default_bin"].astype(jnp.int32)[f],
+        nan_bin=num_bin[f] - 1,
+        is_cat=is_cat_nodes,
+        num_leaves=tree.num_leaves.astype(jnp.int32),
+    )
+
+
+@jax.jit
+def tree_predict_leaf(bins_t: jax.Array, tree: PredictTree) -> jax.Array:
+    """Leaf index per row. ``bins_t``: [N, F] row-major binned matrix."""
+    N = bins_t.shape[0]
+
+    def cond(state):
+        node, _ = state
+        return jnp.any(node >= 0)
+
+    def body(state):
+        node, _ = state
+        active = node >= 0
+        nsafe = jnp.maximum(node, 0)
+        f = tree.split_feature[nsafe]
+        col = jnp.take_along_axis(bins_t, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        thr = tree.threshold_bin[nsafe]
+        dl = tree.default_left[nsafe]
+        miss = tree.missing_type[nsafe]
+        dbin = tree.default_bin[nsafe]
+        nbin = tree.nan_bin[nsafe]
+        go_left = col <= thr
+        go_left = jnp.where((miss == MISSING_ZERO) & (col == dbin), dl, go_left)
+        go_left = jnp.where((miss == MISSING_NAN) & (col == nbin), dl, go_left)
+        go_left = jnp.where(tree.is_cat[nsafe], col == thr, go_left)
+        nxt = jnp.where(go_left, tree.left_child[nsafe], tree.right_child[nsafe])
+        node = jnp.where(active, nxt, node)
+        return node, active
+
+    is_stump = tree.num_leaves <= 1
+    init = jnp.where(is_stump, -1, 0) * jnp.ones((N,), jnp.int32)
+    node, _ = jax.lax.while_loop(cond, body, (init, jnp.ones((N,), bool)))
+    return -(node + 1)  # decode -(leaf+1)
+
+
+@jax.jit
+def tree_predict_value(bins_t: jax.Array, tree: PredictTree) -> jax.Array:
+    leaf = tree_predict_leaf(bins_t, tree)
+    return tree.leaf_value[leaf]
+
+
+@jax.jit
+def ensemble_predict(bins_t: jax.Array, trees: PredictTree) -> jax.Array:
+    """Sum of tree outputs for stacked trees (each field has leading axis T).
+
+    The scan keeps the whole ensemble's traversal on device — the counterpart of
+    GBDT::PredictRaw's per-tree loop (gbdt_prediction.cpp:13).
+    """
+
+    def body(acc, tree):
+        return acc + tree_predict_value(bins_t, tree), None
+
+    init = jnp.zeros((bins_t.shape[0],), jnp.float32)
+    out, _ = jax.lax.scan(body, init, trees)
+    return out
+
+
+@jax.jit
+def ensemble_predict_leaves(bins_t: jax.Array, trees: PredictTree) -> jax.Array:
+    """[N, T] leaf indices (predict_leaf_index path, gbdt_prediction.cpp:77)."""
+
+    def body(_, tree):
+        return None, tree_predict_leaf(bins_t, tree)
+
+    _, leaves = jax.lax.scan(body, None, trees)
+    return leaves.T

@@ -1,0 +1,282 @@
+"""Vectorized best-split search over leaf histograms.
+
+TPU-native counterpart of FeatureHistogram's per-feature threshold scans
+(/root/reference/src/treelearner/feature_histogram.hpp:91-650). The reference walks
+each feature's bins twice (right-to-left then left-to-right) with early-exit
+branches; here both directions become cumulative sums over the bin axis for ALL
+features at once, with every constraint (min_data_in_leaf, min_sum_hessian_in_leaf,
+min_gain_to_split, L1/L2, max_delta_step, monotone clamps, missing-value bin
+exclusions) expressed as masks — no data-dependent control flow, so the whole scan
+jits into one fused XLA program.
+
+Semantics preserved exactly (including kEpsilon placements, feature_histogram.hpp:87
+and the scan accumulator seeds, and scan-order tie-breaking):
+
+ * missing_type None (or num_bin<=2): single right-to-left scan, default_left=True
+   (flipped to False when missing_type is NaN and num_bin<=2).
+ * missing_type Zero: both scans skip the default(zero) bin — its mass lands on the
+   complement side, i.e. zeros follow the default direction.
+ * missing_type NaN: the last bin is the NaN bin; it is excluded from explicit
+   accumulation so NaNs follow the default direction.
+ * dir=-1 prefers the largest threshold among equal gains, dir=+1 the smallest, and
+   dir=+1 must strictly beat dir=-1 (strict '>' updates in the reference loops).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+K_EPSILON = 1e-15  # meta.h:42
+K_MIN_SCORE = -jnp.inf
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class SplitParams(NamedTuple):
+    """Static split hyperparameters (subset of Config used by the scan)."""
+
+    lambda_l1: float
+    lambda_l2: float
+    max_delta_step: float
+    min_data_in_leaf: int
+    min_sum_hessian_in_leaf: float
+    min_gain_to_split: float
+
+
+def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
+    """ThresholdL1 (feature_histogram.hpp:446)."""
+    if l1 == 0.0:
+        return s
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def calculate_leaf_output(sum_grad, sum_hess, p: SplitParams):
+    """CalculateSplittedLeafOutput without monotone clamp (feature_histogram.hpp:451)."""
+    ret = -threshold_l1(sum_grad, p.lambda_l1) / (sum_hess + p.lambda_l2)
+    if p.max_delta_step > 0.0:
+        ret = jnp.clip(ret, -p.max_delta_step, p.max_delta_step)
+    return ret
+
+
+def _leaf_output_constrained(sum_grad, sum_hess, p: SplitParams, min_c, max_c):
+    return jnp.clip(calculate_leaf_output(sum_grad, sum_hess, p), min_c, max_c)
+
+
+def _gain_given_output(sum_grad, sum_hess, output, p: SplitParams):
+    """GetLeafSplitGainGivenOutput (feature_histogram.hpp:505)."""
+    sg_l1 = threshold_l1(sum_grad, p.lambda_l1)
+    return -(2.0 * sg_l1 * output + (sum_hess + p.lambda_l2) * output * output)
+
+
+def leaf_split_gain(sum_grad, sum_hess, p: SplitParams):
+    """GetLeafSplitGain (feature_histogram.hpp:498): parent gain, unconstrained."""
+    out = calculate_leaf_output(sum_grad, sum_hess, p)
+    return _gain_given_output(sum_grad, sum_hess, out, p)
+
+
+class SplitResult(NamedTuple):
+    gain: jax.Array  # scalar f32, already minus gain_shift; <=0 means no split
+    feature: jax.Array  # int32 index into used features; -1 if none
+    threshold: jax.Array  # int32 bin threshold (left: bin <= threshold)
+    default_left: jax.Array  # bool
+    left_sum_grad: jax.Array
+    left_sum_hess: jax.Array
+    left_count: jax.Array
+    right_sum_grad: jax.Array
+    right_sum_hess: jax.Array
+    right_count: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def find_best_split(
+    hist: jax.Array,  # [F, B, 3] (sum_grad, sum_hess, count)
+    sum_grad: jax.Array,  # leaf totals (scalars)
+    sum_hess: jax.Array,
+    num_data: jax.Array,
+    min_constraint: jax.Array,  # monotone constraint window for this leaf
+    max_constraint: jax.Array,
+    feature_meta: Dict[str, jax.Array],  # num_bin/missing_type/default_bin/monotone [F]
+    feature_mask: jax.Array,  # [F] bool: feature_fraction sample & usable
+    params: SplitParams,
+) -> SplitResult:
+    """Best split for one leaf across all features (FindBestThresholdNumerical)."""
+    F, B, _ = hist.shape
+    p = params
+    num_bin = feature_meta["num_bin"].astype(jnp.int32)  # [F]
+    missing = feature_meta["missing_type"].astype(jnp.int32)
+    default_bin = feature_meta["default_bin"].astype(jnp.int32)
+    mono = feature_meta["monotone"].astype(jnp.int32)
+
+    sum_hess_eff = sum_hess + 2 * K_EPSILON  # feature_histogram.hpp:87
+
+    gain_shift = leaf_split_gain(sum_grad, sum_hess_eff, p)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+
+    multi_bin = num_bin > 2
+    use_na = (missing == MISSING_NAN) & multi_bin  # [F]
+    skip_def = (missing == MISSING_ZERO) & multi_bin
+    single_scan = ~(use_na | skip_def)
+
+    bins = jnp.arange(B, dtype=jnp.int32)[None, :]  # [1, B]
+    nan_bin = (num_bin - 1)[:, None]
+    excl = (bins >= num_bin[:, None])
+    excl |= skip_def[:, None] & (bins == default_bin[:, None])
+    excl |= use_na[:, None] & (bins == nan_bin)
+    contrib = hist * (~excl)[:, :, None].astype(hist.dtype)  # [F, B, 3]
+
+    prefix = jnp.cumsum(contrib, axis=1)  # inclusive prefix over bins
+    total = prefix[:, -1, :]  # [F, 3] sums over included bins
+
+    thresholds = jnp.arange(B, dtype=jnp.int32)[None, :]  # threshold t -> left bins <= t
+
+    def side_stats(left_g, left_h_raw, left_c):
+        left_h = left_h_raw + K_EPSILON
+        right_g = sum_grad - left_g
+        right_h = sum_hess_eff - left_h
+        right_c = num_data - left_c
+        return left_h, right_g, right_h, right_c
+
+    def gains_for(left_g, left_h, right_g, right_h, left_c, right_c, valid):
+        ok = (
+            valid
+            & (left_c >= p.min_data_in_leaf)
+            & (right_c >= p.min_data_in_leaf)
+            & (left_h >= p.min_sum_hessian_in_leaf)
+            & (right_h >= p.min_sum_hessian_in_leaf)
+        )
+        lo = _leaf_output_constrained(left_g, left_h, p, min_constraint, max_constraint)
+        ro = _leaf_output_constrained(right_g, right_h, p, min_constraint, max_constraint)
+        g = _gain_given_output(left_g, left_h, lo, p) + _gain_given_output(
+            right_g, right_h, ro, p
+        )
+        mono_bad = ((mono[:, None] > 0) & (lo > ro)) | ((mono[:, None] < 0) & (lo < ro))
+        g = jnp.where(mono_bad, 0.0, g)
+        ok &= g > min_gain_shift
+        return jnp.where(ok, g, K_MIN_SCORE)
+
+    # ---- dir = +1 (left-to-right; default_left = False) ------------------
+    lg_pos = prefix[:, :, 0]
+    lh_pos_raw = prefix[:, :, 1]
+    lc_pos = prefix[:, :, 2]
+    lh_pos, rg_pos, rh_pos, rc_pos = side_stats(lg_pos, lh_pos_raw, lc_pos)
+    valid_pos = thresholds <= (num_bin[:, None] - 2)
+    valid_pos &= ~(skip_def[:, None] & (thresholds == default_bin[:, None]))
+    # dir=+1 runs only for the missing-handling scans
+    valid_pos &= (~single_scan)[:, None]
+    gains_pos = gains_for(lg_pos, lh_pos, rg_pos, rh_pos, lc_pos, rc_pos, valid_pos)
+
+    # ---- dir = -1 (right-to-left; default_left = True) -------------------
+    rg_neg_raw = total[:, None, 0] - prefix[:, :, 0]
+    rh_neg_raw = total[:, None, 1] - prefix[:, :, 1]
+    rc_neg = total[:, None, 2] - prefix[:, :, 2]
+    rh_neg = rh_neg_raw + K_EPSILON
+    lg_neg = sum_grad - rg_neg_raw
+    lh_neg = sum_hess_eff - rh_neg
+    lc_neg = num_data - rc_neg
+    valid_neg = thresholds <= (num_bin[:, None] - 2 - use_na[:, None].astype(jnp.int32))
+    valid_neg &= ~(skip_def[:, None] & (thresholds == default_bin[:, None] - 1))
+    gains_neg = gains_for(lg_neg, lh_neg, rg_neg_raw, rh_neg, lc_neg, rc_neg, valid_neg)
+
+    # ---- categorical one-hot candidates ---------------------------------
+    # FindBestThresholdCategorical one-hot branch (feature_histogram.hpp:139-172):
+    # left = the single bin t, right = rest; no monotone; default_left=False.
+    is_cat = feature_meta.get("is_categorical")
+    if is_cat is None:
+        is_cat = jnp.zeros((F,), bool)
+    else:
+        is_cat = is_cat.astype(bool)
+    cat_lg = hist[:, :, 0]
+    cat_lh_raw = hist[:, :, 1]
+    cat_lc = hist[:, :, 2]
+    cat_lh = cat_lh_raw + K_EPSILON
+    cat_rg = sum_grad - cat_lg
+    cat_rh = sum_hess_eff - cat_lh
+    cat_rc = num_data - cat_lc
+    used_bin = num_bin + jnp.where(missing == MISSING_NONE, 0, -1)  # [F]
+    cat_valid = thresholds < used_bin[:, None]
+    cat_valid &= (cat_lc >= p.min_data_in_leaf) & (cat_rc >= p.min_data_in_leaf)
+    cat_valid &= (cat_lh_raw >= p.min_sum_hessian_in_leaf) & (
+        cat_rh >= p.min_sum_hessian_in_leaf
+    )
+    cat_lo = _leaf_output_constrained(cat_lg, cat_lh, p, min_constraint, max_constraint)
+    cat_ro = _leaf_output_constrained(cat_rg, cat_rh, p, min_constraint, max_constraint)
+    cat_g = _gain_given_output(cat_lg, cat_lh, cat_lo, p) + _gain_given_output(
+        cat_rg, cat_rh, cat_ro, p
+    )
+    cat_valid &= cat_g > min_gain_shift
+    gains_cat = jnp.where(cat_valid, cat_g, K_MIN_SCORE)
+    t_cat = jnp.argmax(gains_cat, axis=1)  # smallest t wins ties
+    g_cat = jnp.take_along_axis(gains_cat, t_cat[:, None], axis=1)[:, 0]
+
+    # ---- per-feature best with scan-order tie-breaking -------------------
+    # dir=-1 prefers the LARGEST threshold among equal gains.
+    t_neg_rev = jnp.argmax(gains_neg[:, ::-1], axis=1)
+    t_neg = B - 1 - t_neg_rev
+    g_neg = jnp.take_along_axis(gains_neg, t_neg[:, None], axis=1)[:, 0]
+    # dir=+1 prefers the smallest threshold; must strictly beat dir=-1.
+    t_pos = jnp.argmax(gains_pos, axis=1)
+    g_pos = jnp.take_along_axis(gains_pos, t_pos[:, None], axis=1)[:, 0]
+
+    use_pos = g_pos > g_neg
+    g_best = jnp.where(use_pos, g_pos, g_neg)
+    t_best = jnp.where(use_pos, t_pos, t_neg)
+    dl_best = ~use_pos  # default_left = (dir == -1)
+    # 2-bin NaN features keep default_left=False (feature_histogram.hpp:108-111)
+    two_bin_nan = (missing == MISSING_NAN) & ~multi_bin
+    dl_best = jnp.where(two_bin_nan, False, dl_best)
+
+    # categorical features use the one-hot candidates exclusively
+    g_best = jnp.where(is_cat, g_cat, g_best)
+    t_best = jnp.where(is_cat, t_cat, t_best)
+    dl_best = jnp.where(is_cat, False, dl_best)
+    use_pos = jnp.where(is_cat, True, use_pos)  # pick() reads the prefix arrays
+
+    g_best = jnp.where(feature_mask, g_best, K_MIN_SCORE)
+
+    best_f = jnp.argmax(g_best)  # first max wins ties (feature index order)
+    best_gain_raw = g_best[best_f]
+    best_t = t_best[best_f]
+    best_dl = dl_best[best_f]
+    has_split = best_gain_raw > K_MIN_SCORE
+
+    # Recover the chosen candidate's side sums.
+    best_is_cat = is_cat[best_f]
+
+    def pick(arr_pos, arr_neg, arr_cat):
+        pos_v = arr_pos[best_f, best_t]
+        neg_v = arr_neg[best_f, best_t]
+        cat_v = arr_cat[best_f, best_t]
+        return jnp.where(best_is_cat, cat_v, jnp.where(use_pos[best_f], pos_v, neg_v))
+
+    left_g = pick(lg_pos, lg_neg, cat_lg)
+    left_h = pick(lh_pos, lh_neg, cat_lh)  # includes +eps
+    left_c = pick(lc_pos, lc_neg, cat_lc)
+    right_g = sum_grad - left_g
+    right_h = sum_hess_eff - left_h
+    right_c = num_data - left_c
+
+    left_out = _leaf_output_constrained(left_g, left_h, p, min_constraint, max_constraint)
+    right_out = _leaf_output_constrained(right_g, right_h, p, min_constraint, max_constraint)
+
+    gain = jnp.where(has_split, best_gain_raw - min_gain_shift, K_MIN_SCORE)
+    return SplitResult(
+        gain=gain.astype(jnp.float32),
+        feature=jnp.where(has_split, best_f.astype(jnp.int32), -1),
+        threshold=best_t.astype(jnp.int32),
+        default_left=best_dl,
+        left_sum_grad=left_g,
+        left_sum_hess=left_h - K_EPSILON,
+        left_count=left_c,
+        right_sum_grad=right_g,
+        right_sum_hess=right_h - K_EPSILON,
+        right_count=right_c,
+        left_output=left_out,
+        right_output=right_out,
+    )

@@ -1,0 +1,329 @@
+"""scikit-learn estimator API.
+
+Mirrors /root/reference/python-package/lightgbm/sklearn.py: LGBMModel base with
+get/set_params, fit with eval_set/early stopping, LGBMClassifier (label encoding,
+predict_proba), LGBMRegressor, LGBMRanker (group arrays), plus the custom
+objective/eval adapters (_ObjectiveFunctionWrapper/_EvalFunctionWrapper,
+sklearn.py:18,81).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .engine import train
+from .utils.log import LightGBMError
+
+
+class _ObjectiveFunctionWrapper:
+    """Adapt sklearn-style fobj(y_true, y_pred[, weight, group]) (sklearn.py:18)."""
+
+    def __init__(self, func: Callable) -> None:
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = dataset.get_label()
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            grad, hess = self.func(labels, preds)
+        elif argc == 3:
+            grad, hess = self.func(labels, preds, dataset.get_weight())
+        elif argc == 4:
+            grad, hess = self.func(labels, preds, dataset.get_weight(), dataset.get_group())
+        else:
+            raise TypeError("Self-defined objective should have 2, 3 or 4 arguments")
+        return grad, hess
+
+
+class _EvalFunctionWrapper:
+    """Adapt sklearn-style feval (sklearn.py:81)."""
+
+    def __init__(self, func: Callable) -> None:
+        self.func = func
+
+    def __call__(self, preds, dataset):
+        labels = dataset.get_label() if dataset is not None else None
+        argc = self.func.__code__.co_argcount
+        if argc == 2:
+            return self.func(labels, preds)
+        if argc == 3:
+            return self.func(labels, preds, dataset.get_weight())
+        if argc == 4:
+            return self.func(labels, preds, dataset.get_weight(), dataset.get_group())
+        raise TypeError("Self-defined eval function should have 2, 3 or 4 arguments")
+
+
+class LGBMModel:
+    """Base estimator (sklearn.py:133)."""
+
+    def __init__(
+        self,
+        boosting_type: str = "gbdt",
+        num_leaves: int = 31,
+        max_depth: int = -1,
+        learning_rate: float = 0.1,
+        n_estimators: int = 100,
+        subsample_for_bin: int = 200000,
+        objective: Optional[str] = None,
+        class_weight=None,
+        min_split_gain: float = 0.0,
+        min_child_weight: float = 1e-3,
+        min_child_samples: int = 20,
+        subsample: float = 1.0,
+        subsample_freq: int = 0,
+        colsample_bytree: float = 1.0,
+        reg_alpha: float = 0.0,
+        reg_lambda: float = 0.0,
+        random_state: Optional[int] = None,
+        n_jobs: int = -1,
+        silent: bool = True,
+        importance_type: str = "split",
+        **kwargs,
+    ) -> None:
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._evals_result: Dict = {}
+        self._best_iteration = -1
+        self._best_score: Dict = {}
+        self._objective = objective
+
+    # -- sklearn plumbing -------------------------------------------------
+
+    def get_params(self, deep: bool = True) -> Dict:
+        params = {
+            k: getattr(self, k)
+            for k in (
+                "boosting_type num_leaves max_depth learning_rate n_estimators "
+                "subsample_for_bin objective class_weight min_split_gain "
+                "min_child_weight min_child_samples subsample subsample_freq "
+                "colsample_bytree reg_alpha reg_lambda random_state n_jobs "
+                "silent importance_type"
+            ).split()
+        }
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for k, v in params.items():
+            if hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self._other_params[k] = v
+        return self
+
+    def _lgb_params(self) -> Dict:
+        params = {
+            "boosting": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "bin_construct_sample_cnt": self.subsample_for_bin,
+            "objective": self._objective or "regression",
+            "min_gain_to_split": self.min_split_gain,
+            "min_sum_hessian_in_leaf": self.min_child_weight,
+            "min_data_in_leaf": self.min_child_samples,
+            "bagging_fraction": self.subsample,
+            "bagging_freq": self.subsample_freq,
+            "feature_fraction": self.colsample_bytree,
+            "lambda_l1": self.reg_alpha,
+            "lambda_l2": self.reg_lambda,
+            "verbosity": -1 if self.silent else 1,
+        }
+        if self.random_state is not None:
+            params["seed"] = self.random_state
+            params["bagging_seed"] = self.random_state
+            params["feature_fraction_seed"] = self.random_state
+            params["drop_seed"] = self.random_state
+            params["data_random_seed"] = self.random_state
+        params.update(self._other_params)
+        return params
+
+    # -- fit/predict ------------------------------------------------------
+
+    def fit(
+        self,
+        X,
+        y,
+        sample_weight=None,
+        init_score=None,
+        group=None,
+        eval_set=None,
+        eval_names=None,
+        eval_sample_weight=None,
+        eval_init_score=None,
+        eval_group=None,
+        eval_metric=None,
+        early_stopping_rounds=None,
+        verbose=False,
+        feature_name="auto",
+        categorical_feature="auto",
+        callbacks=None,
+    ) -> "LGBMModel":
+        params = self._lgb_params()
+        fobj = None
+        if callable(self._objective):
+            fobj = _ObjectiveFunctionWrapper(self._objective)
+            params["objective"] = "none"
+        feval = _EvalFunctionWrapper(eval_metric) if callable(eval_metric) else None
+        if eval_metric is not None and not callable(eval_metric):
+            params["metric"] = eval_metric
+
+        train_set = Dataset(
+            X,
+            label=y,
+            weight=sample_weight,
+            group=group,
+            init_score=init_score,
+            feature_name=feature_name,
+            categorical_feature=categorical_feature,
+            params=params,
+        )
+        valid_sets: List[Dataset] = []
+        if eval_set is not None:
+            for i, (vx, vy) in enumerate(eval_set):
+                if vx is X and vy is y:
+                    valid_sets.append(train_set)
+                    continue
+                vw = eval_sample_weight[i] if eval_sample_weight else None
+                vis = eval_init_score[i] if eval_init_score else None
+                vg = eval_group[i] if eval_group else None
+                valid_sets.append(
+                    Dataset(vx, label=vy, weight=vw, group=vg, init_score=vis, reference=train_set)
+                )
+        self._evals_result = {}
+        self._Booster = train(
+            params,
+            train_set,
+            num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None,
+            valid_names=eval_names,
+            fobj=fobj,
+            feval=feval,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=self._evals_result,
+            verbose_eval=verbose,
+            callbacks=callbacks,
+        )
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = self._Booster.best_score
+        return self
+
+    def predict(self, X, raw_score: bool = False, num_iteration: int = -1, **kwargs) -> np.ndarray:
+        if self._Booster is None:
+            raise LightGBMError("Estimator not fitted, call fit before predict")
+        return self._Booster.predict(X, raw_score=raw_score, num_iteration=num_iteration, **kwargs)
+
+    @property
+    def booster_(self) -> Booster:
+        if self._Booster is None:
+            raise LightGBMError("No booster found. Need to call fit beforehand.")
+        return self._Booster
+
+    @property
+    def evals_result_(self) -> Dict:
+        return self._evals_result
+
+    @property
+    def best_iteration_(self) -> int:
+        return self._best_iteration
+
+    @property
+    def best_score_(self) -> Dict:
+        return self._best_score
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        return self.booster_.feature_importance(self.importance_type)
+
+    @property
+    def n_features_(self) -> int:
+        return self.booster_.num_feature()
+
+
+class LGBMRegressor(LGBMModel):
+    def fit(self, X, y, **kwargs):
+        if self._objective is None:
+            self._objective = "regression"
+        return super().fit(X, y, **kwargs)
+
+
+class LGBMClassifier(LGBMModel):
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y)
+        self._classes = np.unique(y)
+        self._n_classes = len(self._classes)
+        self._class_map = {c: i for i, c in enumerate(self._classes)}
+        y_enc = np.asarray([self._class_map[v] for v in y], np.float64)
+        if self._objective is None or not callable(self._objective):
+            if self._n_classes > 2:
+                self._objective = self._objective or "multiclass"
+                self._other_params.setdefault("num_class", self._n_classes)
+            else:
+                self._objective = self._objective or "binary"
+        ev = kwargs.get("eval_set")
+        if ev is not None:
+            kwargs["eval_set"] = [
+                (vx, np.asarray([self._class_map[v] for v in np.asarray(vy)], np.float64))
+                for vx, vy in ev
+            ]
+        return super().fit(X, y_enc, **kwargs)
+
+    def predict(self, X, raw_score: bool = False, num_iteration: int = -1, **kwargs):
+        probs = self.predict_proba(X, raw_score=raw_score, num_iteration=num_iteration, **kwargs)
+        if raw_score:
+            return probs
+        if probs.ndim == 1:
+            idx = (probs > 0.5).astype(int)
+        else:
+            idx = np.argmax(probs, axis=1)
+        return self._classes[idx]
+
+    def predict_proba(self, X, raw_score: bool = False, num_iteration: int = -1, **kwargs):
+        out = super().predict(X, raw_score=raw_score, num_iteration=num_iteration, **kwargs)
+        if raw_score:
+            return out
+        if out.ndim == 1:
+            return np.vstack([1.0 - out, out]).T if not raw_score else out
+        return out
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        return self._n_classes
+
+    def predict_proba_raw(self, X, **kwargs):
+        return super().predict(X, raw_score=True, **kwargs)
+
+
+class LGBMRanker(LGBMModel):
+    def fit(self, X, y, group=None, **kwargs):
+        if group is None:
+            raise LightGBMError("Should set group for ranking task")
+        if self._objective is None:
+            self._objective = "lambdarank"
+        return super().fit(X, y, group=group, **kwargs)

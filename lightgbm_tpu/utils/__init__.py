@@ -1,0 +1,4 @@
+from . import log
+from .log import LightGBMError
+
+__all__ = ["log", "LightGBMError"]

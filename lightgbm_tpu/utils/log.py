@@ -1,0 +1,65 @@
+"""Logging for lightgbm_tpu.
+
+TPU-native counterpart of the reference's ``Log`` singleton
+(/root/reference/include/LightGBM/utils/log.h:38-108): levels Debug/Info/Warning/Fatal,
+Fatal raises, and a pluggable callback so embedding hosts (CLI, tests) can redirect
+output.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+_LEVELS = {"debug": 10, "info": 20, "warning": 30, "fatal": 40}
+_level = "info"
+_callback: Optional[Callable[[str], None]] = None
+
+
+class LightGBMError(Exception):
+    """Raised on fatal errors (mirrors Log::Fatal throwing std::runtime_error)."""
+
+
+def set_verbosity(verbosity: int) -> None:
+    """Map LightGBM's ``verbosity`` int to a level: <0 fatal, 0 warning, 1 info, >1 debug."""
+    global _level
+    if verbosity < 0:
+        _level = "fatal"
+    elif verbosity == 0:
+        _level = "warning"
+    elif verbosity == 1:
+        _level = "info"
+    else:
+        _level = "debug"
+
+
+def register_callback(cb: Optional[Callable[[str], None]]) -> None:
+    global _callback
+    _callback = cb
+
+
+def _emit(level: str, msg: str) -> None:
+    if _LEVELS[level] < _LEVELS[_level]:
+        return
+    text = "[LightGBM-TPU] [%s] %s" % (level.capitalize(), msg)
+    if _callback is not None:
+        _callback(text + "\n")
+    else:
+        print(text, file=sys.stderr, flush=True)
+
+
+def debug(msg: str, *args) -> None:
+    _emit("debug", msg % args if args else msg)
+
+
+def info(msg: str, *args) -> None:
+    _emit("info", msg % args if args else msg)
+
+
+def warning(msg: str, *args) -> None:
+    _emit("warning", msg % args if args else msg)
+
+
+def fatal(msg: str, *args) -> None:
+    text = msg % args if args else msg
+    _emit("fatal", text)
+    raise LightGBMError(text)

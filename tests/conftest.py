@@ -1,0 +1,20 @@
+"""Test configuration: run JAX on a virtual 8-device CPU platform.
+
+Multi-chip TPU hardware is not available in CI; sharding/collective tests use
+XLA's host-platform device-count override, per the project testing strategy
+(SURVEY.md §4: in-process multi-worker simulation the reference lacks).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
