@@ -1,0 +1,108 @@
+"""Benchmark: Higgs-shaped binary classification training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload mirrors the reference's headline Higgs experiment
+(/root/reference/docs/Experiments.rst:103-128): binary objective, 28 features,
+255 leaves, 255 bins, lr=0.1 — on 1M synthetic Higgs-like rows (the north-star
+"Higgs-1M" size from BASELINE.json; the tabular feature distributions are
+synthetic but binning/shape-equivalent).
+
+Baseline: LightGBM CPU trains the real 10.5M-row Higgs at 500 iters / 238.5 s =
+2.096 iters/s on 16 Xeon threads (Experiments.rst:103-115). LightGBM histogram
+training is linear in rows, so the 1M-row equivalent CPU baseline is
+2.096 * 10.5 = 22.0 iters/s. vs_baseline = ours / 22.0 (>1 beats the reference
+CPU; the BASELINE.json target is >= 4).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_ITERS_PER_SEC_1M = 2.096 * 10.5  # LightGBM CPU, scaled to 1M rows
+
+N_ROWS = 1_000_000
+N_FEATURES = 28
+NUM_LEAVES = 255
+MAX_BIN = 255
+WARMUP_ITERS = 3
+BENCH_ITERS = 30
+
+
+def make_higgs_like(n: int, f: int, seed: int = 7):
+    rng = np.random.RandomState(seed)
+    # mix of unit-gaussian "low-level" features and derived positive "high-level"
+    # features, like the HIGGS csv: 21 kinematic + 7 derived
+    X = np.empty((n, f), np.float32)
+    X[:, :21] = rng.randn(n, 21).astype(np.float32)
+    for j in range(21, f):
+        a, b = rng.randint(0, 21, 2)
+        X[:, j] = np.abs(X[:, a] * X[:, b] + rng.randn(n).astype(np.float32) * 0.5)
+    w = rng.randn(f) * (rng.rand(f) > 0.3)
+    logits = X @ w * 0.3 + rng.randn(n) * 2.0
+    y = (logits > 0).astype(np.float32)
+    return X, y
+
+
+def main() -> None:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.metric import AUCMetric
+
+    X, y = make_higgs_like(N_ROWS, N_FEATURES)
+
+    params = {
+        "objective": "binary",
+        "num_leaves": NUM_LEAVES,
+        "max_bin": MAX_BIN,
+        "learning_rate": 0.1,
+        "metric": "auc",
+        "verbosity": -1,
+    }
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(params=params, train_set=ds)
+    bin_time = time.time() - t0
+
+    # warmup (jit compile)
+    t0 = time.time()
+    for _ in range(WARMUP_ITERS):
+        booster.update()
+    warmup_time = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(BENCH_ITERS):
+        booster.update()
+    # force completion of the last device work
+    import jax
+
+    jax.block_until_ready(booster._gbdt.scores)
+    bench_time = time.time() - t0
+
+    iters_per_sec = BENCH_ITERS / bench_time
+
+    score = booster._gbdt._train_score_np()
+    auc_metric = AUCMetric(booster.config)
+    auc_metric.init(ds._binned.metadata, ds.num_data())
+    auc = auc_metric.eval(score, booster._gbdt.objective)[0][1]
+
+    result = {
+        "metric": "higgs1m_boost_iters_per_sec",
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/s (binary, 1M x 28, 255 leaves, 255 bins)",
+        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC_1M, 4),
+    }
+    print(json.dumps(result))
+    # side info on stderr for humans
+    import sys
+
+    print(
+        "bench detail: bin=%.1fs warmup(%d)=%.1fs bench(%d)=%.1fs train-AUC=%.5f"
+        % (bin_time, WARMUP_ITERS, warmup_time, BENCH_ITERS, bench_time, auc),
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

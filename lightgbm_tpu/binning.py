@@ -138,7 +138,13 @@ def find_bin_with_zero_as_one_bin(
 
     if right_start >= 0:
         right_max_bin = max_bin - 1 - len(bin_upper_bound)
-        assert right_max_bin > 0
+        if right_max_bin <= 0:
+            # the reference CHECK-fails here too (bin.cpp:197): max_bin is too
+            # small to hold negative bins + zero bin + positive bins
+            log.fatal(
+                "max_bin=%d is too small for a feature with both negative and "
+                "positive values (needs >= 4)" % max_bin
+            )
         right_bounds = greedy_find_bin(
             distinct_values[right_start:], counts[right_start:], right_max_bin, right_cnt_data, min_data_in_bin
         )

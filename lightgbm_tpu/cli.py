@@ -1,0 +1,133 @@
+"""Command-line application: train / predict from config files.
+
+TPU-native counterpart of the reference Application
+(/root/reference/src/application/application.cpp, src/main.cpp): parses
+``key=value`` argv tokens plus an optional ``config=`` file (argv wins,
+application.cpp:48-81), dispatches on ``task`` (train/predict, config.h:26),
+loads train/valid data with sidecar weight/query files, runs the boosting loop
+with per-iteration metric output, and saves/loads LightGBM-format models.
+
+Usage:  python -m lightgbm_tpu task=train config=train.conf [key=value ...]
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import Config, load_config_file
+from .engine import train as train_api
+from .io import load_sidecar, load_text_file
+from .utils import log
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    params = Config.kv2map(argv)
+    if "config" in params:
+        file_params = load_config_file(params["config"])
+        for k, v in file_params.items():
+            params.setdefault(k, v)  # CLI overrides file
+    return params
+
+
+def _load_dataset(path: str, config: Config, reference: Optional[Dataset] = None) -> Dataset:
+    X, y, names = load_text_file(path, has_header=config.header, label_column=config.label_column)
+    weight = load_sidecar(path, "weight")
+    group = load_sidecar(path, "query")
+    init_score = load_sidecar(path, "init")
+    ds = Dataset(
+        X,
+        label=y,
+        weight=weight,
+        group=None if group is None else group.astype(np.int64),
+        init_score=init_score,
+        reference=reference,
+        feature_name=names if names else "auto",
+        params={},
+    )
+    return ds
+
+
+def run_train(config: Config, params: Dict[str, str]) -> None:
+    if not config.data:
+        log.fatal("No training data specified (data=...)")
+    log.info("Loading train data from %s" % config.data)
+    train_set = _load_dataset(config.data, config)
+    valid_sets = []
+    valid_names = []
+    for i, v in enumerate(config.valid):
+        log.info("Loading validation data from %s" % v)
+        valid_sets.append(_load_dataset(v, config, reference=train_set))
+        valid_names.append("valid_%d" % (i + 1))
+
+    params = dict(params)
+    params.pop("config", None)
+    params.pop("task", None)
+    params.pop("data", None)
+    params.pop("valid", None)
+    params.pop("output_model", None)
+    booster = train_api(
+        params,
+        train_set,
+        num_boost_round=config.num_iterations,
+        valid_sets=valid_sets or None,
+        valid_names=valid_names or None,
+        init_model=config.input_model or None,
+        early_stopping_rounds=config.early_stopping_round or None,
+        verbose_eval=config.metric_freq if config.verbosity >= 1 else False,
+    )
+    booster.save_model(config.output_model)
+    log.info("Finished training; model saved to %s" % config.output_model)
+
+
+def run_predict(config: Config, params: Dict[str, str]) -> None:
+    if not config.data:
+        log.fatal("No prediction data specified (data=...)")
+    if not config.input_model:
+        log.fatal("No model file specified (input_model=...)")
+    booster = Booster(model_file=config.input_model)
+    X, _, _ = load_text_file(
+        config.data,
+        has_header=config.header,
+        label_column=config.label_column,
+        model_num_features=booster.num_feature(),
+    )
+    preds = booster.predict(
+        X,
+        num_iteration=config.num_iteration_predict,
+        raw_score=config.predict_raw_score,
+        pred_leaf=config.predict_leaf_index,
+    )
+    out = np.asarray(preds)
+    with open(config.output_result, "w") as fh:
+        if out.ndim == 1:
+            for v in out:
+                fh.write("%.18g\n" % v)
+        else:
+            for row in out:
+                fh.write("\t".join("%.18g" % v for v in row) + "\n")
+    log.info("Finished prediction; results saved to %s" % config.output_result)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    params = parse_args(argv)
+    config = Config.from_params(params)
+    if config.task == "train":
+        run_train(config, params)
+    elif config.task in ("predict", "prediction", "test"):
+        run_predict(config, params)
+    elif config.task == "convert_model":
+        log.fatal("convert_model task is not implemented yet in lightgbm_tpu")
+    elif config.task == "refit":
+        log.fatal("refit task is not implemented yet in lightgbm_tpu")
+    else:
+        log.fatal("Unknown task: %s" % config.task)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,152 @@
+"""Text data loading: CSV / TSV / LibSVM with format auto-detection.
+
+TPU-native counterpart of the reference Parser (src/io/parser.{cpp,hpp}) and the
+file-side of DatasetLoader (src/io/dataset_loader.cpp): sniffs the format from the
+first lines (Parser::CreateParser), resolves the label column, reads optional
+sidecar ``<file>.weight`` / ``<file>.query`` / ``<file>.init`` files
+(metadata.cpp semantics), and returns dense numpy arrays ready for binning.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .utils import log
+
+
+def _sniff_format(lines: List[str]) -> str:
+    """Parser::CreateParser format detection: libsvm if 'idx:value' tokens."""
+    for line in lines:
+        toks = line.replace("\t", " ").split()
+        if any(":" in t for t in toks[1:]):
+            return "libsvm"
+    if lines and "\t" in lines[0]:
+        return "tsv"
+    return "csv"
+
+
+def _is_number(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
+def load_text_file(
+    path: str,
+    has_header: bool = False,
+    label_column: str = "",
+    model_num_features: Optional[int] = None,
+) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[List[str]]]:
+    """Returns (features [N, F], label [N] or None, feature_names or None).
+
+    With ``model_num_features`` set (prediction path), label presence is
+    detected by comparing the file's column count against the model — the
+    reference Predictor's behavior for label-less prediction files.
+    """
+    with open(path) as fh:
+        raw_lines = [ln.rstrip("\r\n") for ln in fh if ln.strip()]
+    if not raw_lines:
+        log.fatal("Data file %s is empty" % path)
+
+    header: Optional[List[str]] = None
+    first = raw_lines[0]
+    sample = raw_lines[1 if has_header else 0 : 20]
+    fmt = _sniff_format(sample)
+    sep = "\t" if fmt == "tsv" else ","
+    if fmt != "libsvm":
+        first_toks = [t.strip() for t in first.split(sep)]
+        auto_header = not all(_is_number(t) or t == "" for t in first_toks)
+    else:
+        auto_header = False
+    use_header = has_header or auto_header
+    if use_header:
+        raw_lines = raw_lines[1:]  # header line is skipped for every format
+        if fmt != "libsvm":
+            header = [t.strip() for t in first.split(sep)]
+
+    label_idx = _resolve_label(label_column, header)
+    if model_num_features is not None and fmt != "libsvm":
+        ncols = len(raw_lines[0].split(sep))
+        if ncols == model_num_features:
+            label_idx = None  # no label column in the prediction file
+        elif ncols != model_num_features + 1:
+            log.fatal(
+                "Prediction data has %d columns but the model needs %d features"
+                % (ncols, model_num_features)
+            )
+
+    if fmt == "libsvm":
+        return _parse_libsvm(raw_lines, label_idx) + (None,)
+    return _parse_delimited(raw_lines, sep, label_idx, header)
+
+
+def _resolve_label(label_column: str, header: Optional[List[str]]) -> int:
+    if not label_column:
+        return 0
+    if label_column.startswith("name:"):
+        name = label_column[5:]
+        if header is None or name not in header:
+            log.fatal("Could not find label column '%s' in data file header" % name)
+        return header.index(name)
+    return int(label_column)
+
+
+def _parse_delimited(lines, sep, label_idx, header):
+    rows = []
+    labels = []
+    for ln in lines:
+        toks = ln.split(sep)
+        vals = [float(t) if t.strip() not in ("", "NA", "na", "NaN", "nan", "N/A") else np.nan
+                for t in toks]
+        if label_idx is not None:
+            labels.append(vals[label_idx])
+            del vals[label_idx]
+        rows.append(vals)
+    X = np.asarray(rows, np.float64)
+    y = np.asarray(labels, np.float64) if label_idx is not None else None
+    names = None
+    if header is not None:
+        names = [h for i, h in enumerate(header) if i != label_idx]
+    return X, y, names
+
+
+def _parse_libsvm(lines, label_idx):
+    labels = []
+    entries = []
+    max_idx = -1
+    for ln in lines:
+        toks = ln.split()
+        labels.append(float(toks[0]))
+        row = []
+        for t in toks[1:]:
+            if ":" not in t:
+                continue
+            i, v = t.split(":", 1)
+            i = int(i)
+            row.append((i, float(v)))
+            max_idx = max(max_idx, i)
+        entries.append(row)
+    X = np.zeros((len(lines), max_idx + 1), np.float64)
+    for r, row in enumerate(entries):
+        for i, v in row:
+            X[r, i] = v
+    return X, np.asarray(labels, np.float64)
+
+
+def load_sidecar(path: str, kind: str) -> Optional[np.ndarray]:
+    """<data>.weight / <data>.query / <data>.init sidecar files (metadata.cpp)."""
+    side = path + "." + kind
+    if not os.path.exists(side):
+        return None
+    vals = []
+    with open(side) as fh:
+        for ln in fh:
+            ln = ln.strip()
+            if ln:
+                vals.append(float(ln))
+    log.info("Loading %s from %s" % (kind, side))
+    return np.asarray(vals, np.float64)

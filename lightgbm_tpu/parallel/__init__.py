@@ -1,0 +1,2 @@
+from .mesh import data_mesh, shard_rows  # noqa: F401
+from .data_parallel import grow_tree_data_parallel  # noqa: F401

@@ -1,0 +1,85 @@
+"""Data-parallel tree learning over a device mesh.
+
+TPU-native counterpart of DataParallelTreeLearner
+(/root/reference/src/treelearner/data_parallel_tree_learner.cpp): rows are sharded
+over the mesh 'data' axis; each shard builds local histograms for ALL features and
+the shard histograms are combined with one XLA collective (psum — subsuming the
+reference's ReduceScatter of HistogramBinEntry at :161 plus its feature-ownership
+bookkeeping at :76-117, which exists only because CPU ranks must split scan work);
+every shard then finds the identical global best split, applies the identical
+partition update, and no SyncUpGlobalBestSplit record exchange is needed
+(:241 becomes a no-op by construction).
+
+Two execution modes:
+ * GSPMD (default): the caller simply places bins/grad/hess with a row-sharded
+   NamedSharding and jits the ordinary grow_tree — XLA inserts the collectives.
+ * shard_map (explicit): this module wraps grow_tree per-shard with psum on the
+   histogram/root sums, which pins the communication pattern (used by the
+   multi-chip dryrun and as the template for voting-parallel).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.30 stable name
+    from jax import shard_map as _shard_map_mod
+
+    shard_map = _shard_map_mod
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ..ops.grow import grow_tree
+from ..ops.split import SplitParams
+
+
+def grow_tree_data_parallel(
+    mesh: Mesh,
+    bins: jax.Array,  # [F, N] sharded P(None, 'data') (or host array)
+    grad: jax.Array,  # [N]
+    hess: jax.Array,
+    bag_mask: jax.Array,
+    feature_mask: jax.Array,
+    feature_meta: Dict[str, jax.Array],
+    num_leaves: int,
+    max_depth: int,
+    num_bins: int,
+    params: SplitParams,
+    chunk: int = 4096,
+):
+    """Explicit shard_map data-parallel growth; returns (TreeArrays, leaf_id).
+
+    TreeArrays come out replicated; leaf_id stays row-sharded.
+    """
+    meta_keys = sorted(feature_meta.keys())
+    meta_vals = tuple(feature_meta[k] for k in meta_keys)
+
+    def local(bins_l, grad_l, hess_l, bag_l, fmask, *meta_flat):
+        meta = dict(zip(meta_keys, meta_flat))
+        return grow_tree(
+            bins_l,
+            grad_l,
+            hess_l,
+            bag_l,
+            fmask,
+            meta,
+            num_leaves=num_leaves,
+            max_depth=max_depth,
+            num_bins=num_bins,
+            params=params,
+            chunk=chunk,
+            axis_name="data",
+        )
+
+    row = P("data")
+    rep = P()
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(None, "data"), row, row, row, rep) + (rep,) * len(meta_vals),
+        out_specs=(rep, row),
+        check_vma=False,
+    )
+    return jax.jit(fn)(bins, grad, hess, bag_mask, feature_mask, *meta_vals)

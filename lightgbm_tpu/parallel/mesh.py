@@ -1,0 +1,50 @@
+"""Device mesh helpers.
+
+TPU-native replacement for the reference's Network init/topology layer
+(/root/reference/src/network/): instead of TCP/MPI rank wiring, distribution is a
+``jax.sharding.Mesh`` whose axes carry the two parallelism dimensions the
+reference implements as tree-learner variants (SURVEY.md §2.4):
+
+ * ``data``    — row sharding (data_parallel_tree_learner.cpp)
+ * ``feature`` — column sharding (feature_parallel_tree_learner.cpp)
+
+Collectives ride ICI within a slice and DCN across slices; multi-host init is
+``jax.distributed.initialize`` (the analogue of Network::Init at
+application.cpp:169).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+
+def data_mesh(num_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """1D mesh over the row axis (the data-parallel learner's world)."""
+    if devices is None:
+        devices = jax.devices()
+        if num_devices is not None:
+            devices = devices[:num_devices]
+    return Mesh(np.array(devices), ("data",))
+
+
+def data_feature_mesh(data: int, feature: int, devices: Optional[Sequence] = None) -> Mesh:
+    """2D mesh: rows × features (data-parallel × feature-parallel hybrid)."""
+    if devices is None:
+        devices = jax.devices()
+    arr = np.array(devices[: data * feature]).reshape(data, feature)
+    return Mesh(arr, ("data", "feature"))
+
+
+def shard_rows(mesh: Mesh, arr: jax.Array, row_axis: int) -> jax.Array:
+    """Place an array with its row dimension sharded over the 'data' mesh axis."""
+    spec = [None] * arr.ndim
+    spec[row_axis] = "data"
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def replicated(mesh: Mesh, arr: jax.Array) -> jax.Array:
+    return jax.device_put(arr, NamedSharding(mesh, P()))

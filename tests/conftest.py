@@ -1,15 +1,25 @@
 """Test configuration: run JAX on a virtual 8-device CPU platform.
 
 Multi-chip TPU hardware is not available in CI; sharding/collective tests use
-XLA's host-platform device-count override, per the project testing strategy
-(SURVEY.md §4: in-process multi-worker simulation the reference lacks).
+virtual CPU devices, per the project testing strategy (SURVEY.md §4: in-process
+multi-worker simulation the reference lacks).
+
+Note: this environment pins JAX_PLATFORMS=axon (the TPU tunnel) in the profile,
+and jax 0.9 replaced --xla_force_host_platform_device_count with the
+jax_num_cpu_devices config; both are handled here before jax initializes.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # belt: fresh interpreters
+
+import jax  # noqa: E402
+
+# suspenders: this machine's sitecustomize pre-imports jax with the axon (TPU)
+# platform pinned, so the env var alone is ignored; the config update works as
+# long as the backend hasn't initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for the test mesh"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

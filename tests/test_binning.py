@@ -1,0 +1,133 @@
+"""BinMapper unit tests (reference semantics: src/io/bin.cpp)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (
+    BIN_CATEGORICAL,
+    MISSING_NAN,
+    MISSING_NONE,
+    MISSING_ZERO,
+    BinMapper,
+    greedy_find_bin,
+)
+
+
+def make_mapper(values, total=None, max_bin=255, min_data_in_bin=3, min_split=20, **kw):
+    values = np.asarray(values, np.float64)
+    total = total if total is not None else len(values)
+    m = BinMapper()
+    m.find_bin(values, total, max_bin, min_data_in_bin, min_split, **kw)
+    return m
+
+
+class TestGreedyFindBin:
+    def test_few_distinct_values_get_own_bins(self):
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+        counts = np.array([10, 10, 10, 10])
+        bounds = greedy_find_bin(vals, counts, 255, 40, 3)
+        assert bounds[-1] == np.inf
+        assert len(bounds) == 4
+        # boundaries lie between the distinct values
+        assert 1.0 < bounds[0] < 2.0
+        assert 2.0 < bounds[1] < 3.0
+
+    def test_min_data_in_bin_merges(self):
+        vals = np.array([1.0, 2.0, 3.0, 4.0])
+        counts = np.array([1, 1, 10, 10])
+        bounds = greedy_find_bin(vals, counts, 255, 22, 2)
+        # 1.0 alone has count 1 < 2, so first boundary is after 2.0
+        assert bounds[0] > 2.0
+
+    def test_equal_count_property(self):
+        rng = np.random.RandomState(0)
+        vals = np.sort(rng.randn(10000))
+        uniq, counts = np.unique(vals, return_counts=True)
+        bounds = greedy_find_bin(uniq, counts, 32, len(vals), 1)
+        assert len(bounds) <= 32
+        # roughly equal mass per bin
+        idx = np.searchsorted(bounds, vals, side="left")
+        per_bin = np.bincount(idx, minlength=len(bounds))
+        assert per_bin.max() < 3 * len(vals) / len(bounds)
+
+
+class TestBinMapper:
+    def test_zero_gets_own_bin(self):
+        rng = np.random.RandomState(1)
+        data = np.concatenate([rng.randn(500), np.zeros(500)])
+        nonzero = data[np.abs(data) > 1e-35]
+        m = make_mapper(nonzero, total=1000, max_bin=32)
+        zb = m.value_to_bin(0.0)
+        # zero bin contains no other sampled value's bin boundary crossing
+        assert m.value_to_bin(1e-40) == zb
+        assert m.default_bin == zb
+
+    def test_missing_nan_gets_last_bin(self):
+        data = np.concatenate([np.random.RandomState(2).randn(500), [np.nan] * 100])
+        m = make_mapper(data, total=600, max_bin=32, use_missing=True)
+        assert m.missing_type == MISSING_NAN
+        assert m.value_to_bin(np.nan) == m.num_bin - 1
+
+    def test_no_missing(self):
+        data = np.random.RandomState(3).randn(500)
+        m = make_mapper(data, total=500)
+        assert m.missing_type == MISSING_NONE
+
+    def test_zero_as_missing(self):
+        data = np.random.RandomState(4).randn(500)
+        m = make_mapper(data, total=800, zero_as_missing=True)
+        assert m.missing_type == MISSING_ZERO
+
+    def test_value_to_bin_monotonic(self):
+        data = np.random.RandomState(5).randn(2000)
+        m = make_mapper(data, total=2000, max_bin=64)
+        xs = np.linspace(-4, 4, 1001)
+        bins = m.values_to_bins(xs)
+        assert (np.diff(bins) >= 0).all()
+        # vectorized matches scalar
+        for x in xs[::100]:
+            assert m.value_to_bin(float(x)) == bins[np.searchsorted(xs, x)]
+
+    def test_bin_to_value_upper_bound(self):
+        data = np.random.RandomState(6).randn(2000)
+        m = make_mapper(data, total=2000, max_bin=64)
+        for b in range(m.num_bin - 1):
+            ub = m.bin_to_value(b)
+            if np.isfinite(ub):
+                assert m.value_to_bin(ub) == b
+                assert m.value_to_bin(np.nextafter(ub, np.inf)) == b + 1
+
+    def test_trivial_constant_feature(self):
+        m = make_mapper(np.ones(100) * 5.0, total=100)
+        # one distinct value -> at most 2 bins and filtered by min_split_data
+        assert m.is_trivial
+
+    def test_categorical_count_sorted(self):
+        rng = np.random.RandomState(7)
+        data = rng.choice([3, 7, 7, 7, 9, 9], size=1000).astype(np.float64)
+        m = make_mapper(data, total=1000, bin_type=BIN_CATEGORICAL, min_split=1)
+        assert m.bin_type == BIN_CATEGORICAL
+        # most frequent category gets bin 0
+        counts = {c: (data == c).sum() for c in (3, 7, 9)}
+        most = max(counts, key=counts.get)
+        assert m.bin_2_categorical[0] == most
+        assert m.value_to_bin(float(most)) == 0
+
+    def test_categorical_unseen_goes_last(self):
+        data = np.asarray([1.0, 2.0, 2.0, 3.0] * 50)
+        m = make_mapper(data, total=200, bin_type=BIN_CATEGORICAL, min_split=1)
+        assert m.value_to_bin(999.0) == m.num_bin - 1
+        assert m.value_to_bin(-5.0) == m.num_bin - 1
+
+    def test_max_bin_respected(self):
+        data = np.random.RandomState(8).randn(10000)
+        # (max_bin=2 on mixed-sign data CHECK-fails in the reference too, bin.cpp:197)
+        for mb in (4, 15, 63, 255):
+            m = make_mapper(data, total=10000, max_bin=mb)
+            assert m.num_bin <= mb
+
+    def test_serialization_roundtrip(self):
+        data = np.concatenate([np.random.RandomState(9).randn(500), [np.nan] * 50])
+        m = make_mapper(data, total=550)
+        m2 = BinMapper.from_dict(m.to_dict())
+        xs = np.linspace(-3, 3, 100)
+        assert (m.values_to_bins(xs) == m2.values_to_bins(xs)).all()

@@ -1,0 +1,253 @@
+"""End-to-end training tests per objective with metric thresholds.
+
+Mirrors the reference test strategy (tests/python_package_test/test_engine.py,
+SURVEY.md §4): each objective family trains on synthetic data and must clear a
+metric threshold; plus the exact-prediction missing-value micro-datasets
+(test_engine.py:96-185) and the monotone-constraint property walk (:719).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+# shared shapes keep jit recompiles down on the CPU test runner
+BASE = {"verbosity": -1, "num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 5}
+
+
+def make_binary(n=2000, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = 2 * X[:, 0] + X[:, 1] - 0.5 * X[:, 2] + 0.3 * rng.randn(n)
+    return X, (logit > 0).astype(np.float64)
+
+
+def make_regression(n=2000, f=8, seed=1):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = 3 * X[:, 0] + np.abs(X[:, 1]) + 0.1 * rng.randn(n)
+    return X, y
+
+
+def auc_of(y, p):
+    order = np.argsort(-p)
+    ys = y[order] > 0
+    npos, nneg = ys.sum(), (~ys).sum()
+    ranks = np.arange(1, len(y) + 1)
+    return 1.0 - (np.sum(ranks[ys]) - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+class TestObjectivesE2E:
+    def test_binary(self):
+        X, y = make_binary()
+        bst = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y), 30)
+        p = bst.predict(X)
+        assert auc_of(y, p) > 0.98
+
+    def test_regression_l2(self):
+        X, y = make_regression()
+        bst = lgb.train(dict(BASE, objective="regression"), lgb.Dataset(X, label=y), 50)
+        rmse = np.sqrt(np.mean((bst.predict(X) - y) ** 2))
+        assert rmse < 0.35 * y.std()
+
+    def test_regression_l1(self):
+        X, y = make_regression()
+        bst = lgb.train(dict(BASE, objective="regression_l1"), lgb.Dataset(X, label=y), 50)
+        mae = np.mean(np.abs(bst.predict(X) - y))
+        assert mae < 0.35 * np.mean(np.abs(y - np.median(y)))
+
+    def test_huber_fair_quantile_mape(self):
+        X, y = make_regression()
+        for obj in ("huber", "fair", "quantile", "mape"):
+            bst = lgb.train(dict(BASE, objective=obj), lgb.Dataset(X, label=np.abs(y) + 1), 25)
+            p = bst.predict(X)
+            assert np.isfinite(p).all(), obj
+
+    def test_poisson_gamma_tweedie(self):
+        X, y = make_regression()
+        ypos = np.exp(y / y.std())
+        for obj in ("poisson", "gamma", "tweedie"):
+            bst = lgb.train(dict(BASE, objective=obj), lgb.Dataset(X, label=ypos), 30)
+            p = bst.predict(X)
+            assert (p > 0).all(), obj
+            corr = np.corrcoef(p, ypos)[0, 1]
+            assert corr > 0.7, (obj, corr)
+
+    def test_multiclass(self):
+        rng = np.random.RandomState(3)
+        X = rng.randn(2000, 8)
+        y = np.digitize(X[:, 0] + 0.2 * rng.randn(2000), [-0.7, 0.7]).astype(np.float64)
+        bst = lgb.train(
+            dict(BASE, objective="multiclass", num_class=3), lgb.Dataset(X, label=y), 25
+        )
+        p = bst.predict(X)
+        assert p.shape == (2000, 3)
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+        assert np.mean(np.argmax(p, 1) == y) > 0.9
+
+    def test_multiclassova(self):
+        rng = np.random.RandomState(4)
+        X = rng.randn(1500, 8)
+        y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float64)
+        bst = lgb.train(
+            dict(BASE, objective="multiclassova", num_class=3), lgb.Dataset(X, label=y), 25
+        )
+        p = bst.predict(X)
+        assert np.mean(np.argmax(p, 1) == y) > 0.9
+
+    def test_xentropy(self):
+        rng = np.random.RandomState(5)
+        X = rng.randn(2000, 8)
+        prob = 1 / (1 + np.exp(-(X[:, 0] + X[:, 1])))
+        y = prob  # probabilistic labels
+        bst = lgb.train(dict(BASE, objective="xentropy"), lgb.Dataset(X, label=y), 30)
+        p = bst.predict(X)
+        assert np.corrcoef(p, prob)[0, 1] > 0.95
+
+    def test_lambdarank(self):
+        rng = np.random.RandomState(6)
+        n_q, per_q = 100, 20
+        n = n_q * per_q
+        X = rng.randn(n, 8)
+        rel = np.clip(np.round(X[:, 0] + 0.3 * rng.randn(n) + 1), 0, 4).astype(np.float64)
+        group = np.full(n_q, per_q)
+        bst = lgb.train(
+            dict(BASE, objective="lambdarank", metric="ndcg"),
+            lgb.Dataset(X, label=rel, group=group),
+            30,
+        )
+        p = bst.predict(X)
+        # per-query spearman-ish check: top-scored doc should tend to have high label
+        top_labels = [
+            rel[q * per_q :(q + 1) * per_q][np.argmax(p[q * per_q :(q + 1) * per_q])]
+            for q in range(n_q)
+        ]
+        assert np.mean(top_labels) > rel.mean() + 0.8
+
+
+class TestMissingValues:
+    """Exact-prediction micro-datasets (reference test_engine.py:96-185)."""
+
+    def test_nan_goes_default_direction(self):
+        # feature perfectly splits; NaNs carry label 1 -> NaN rows must route to
+        # the positive leaf at predict time
+        x = np.concatenate([np.zeros(50), np.ones(50), np.full(20, np.nan)])
+        y = np.concatenate([np.zeros(50), np.ones(50), np.ones(20)])
+        X = x.reshape(-1, 1)
+        bst = lgb.train(
+            {"objective": "regression", "verbosity": -1, "num_leaves": 3,
+             "min_data_in_leaf": 1, "max_bin": 15, "learning_rate": 1.0,
+             "boost_from_average": False, "min_data_in_bin": 1},
+            lgb.Dataset(X, label=y), 1)
+        pred_nan = bst.predict(np.array([[np.nan]]))[0]
+        pred_one = bst.predict(np.array([[1.0]]))[0]
+        pred_zero = bst.predict(np.array([[0.0]]))[0]
+        assert abs(pred_nan - pred_one) < 1e-6
+        assert pred_zero < 0.5 < pred_one
+
+    def test_zero_as_missing(self):
+        x = np.concatenate([np.full(60, -1.0), np.full(60, 1.0), np.zeros(30)])
+        y = np.concatenate([np.zeros(60), np.ones(60), np.ones(30)])
+        X = x.reshape(-1, 1)
+        bst = lgb.train(
+            {"objective": "regression", "verbosity": -1, "num_leaves": 3,
+             "min_data_in_leaf": 1, "max_bin": 15, "learning_rate": 1.0,
+             "boost_from_average": False, "zero_as_missing": True,
+             "min_data_in_bin": 1},
+            lgb.Dataset(X, label=y), 1)
+        # zeros (missing) carried label 1 -> default direction must be the 1-leaf
+        assert abs(bst.predict(np.array([[0.0]]))[0] - bst.predict(np.array([[1.0]]))[0]) < 1e-6
+
+    def test_categorical_exact(self):
+        x = np.repeat([0, 1, 2, 3], 30).astype(np.float64)
+        y = (x == 2).astype(np.float64)
+        X = x.reshape(-1, 1)
+        bst = lgb.train(
+            {"objective": "regression", "verbosity": -1, "num_leaves": 3,
+             "min_data_in_leaf": 1, "learning_rate": 1.0,
+             "boost_from_average": False, "min_data_in_bin": 1,
+             "min_data_per_group": 1, "cat_smooth": 0.0},
+            lgb.Dataset(X, label=y, categorical_feature=[0]), 1)
+        preds = bst.predict(np.array([[0.0], [1.0], [2.0], [3.0]]))
+        np.testing.assert_allclose(preds, [0, 0, 1, 0], atol=1e-6)
+
+
+class TestTrainingControls:
+    def test_monotone_constraints(self):
+        """Property walk from reference test_engine.py:719."""
+        rng = np.random.RandomState(8)
+        n = 2000
+        x_mono = rng.rand(n)
+        x_other = rng.rand(n)
+        y = 3 * x_mono + np.sin(6 * x_other) + 0.1 * rng.randn(n)
+        X = np.stack([x_mono, x_other], axis=1)
+        bst = lgb.train(
+            dict(BASE, objective="regression", monotone_constraints=[1, 0]),
+            lgb.Dataset(X, label=y), 40)
+        # walk the monotone feature holding the other fixed
+        for other in (0.2, 0.5, 0.8):
+            xs = np.linspace(0, 1, 50)
+            grid = np.stack([xs, np.full(50, other)], axis=1)
+            preds = bst.predict(grid)
+            assert (np.diff(preds) >= -1e-10).all()
+
+    def test_max_depth(self):
+        X, y = make_binary(800)
+        bst = lgb.train(
+            dict(BASE, objective="binary", max_depth=2, num_leaves=31),
+            lgb.Dataset(X, label=y), 3)
+        for t in bst._gbdt.trees():
+            assert t.max_depth() <= 2
+
+    def test_bagging_and_feature_fraction(self):
+        X, y = make_binary()
+        bst = lgb.train(
+            dict(BASE, objective="binary", bagging_fraction=0.6, bagging_freq=1,
+                 feature_fraction=0.7),
+            lgb.Dataset(X, label=y), 20)
+        assert auc_of(y, bst.predict(X)) > 0.95
+
+    def test_early_stopping_and_best_iteration(self):
+        X, y = make_binary(3000)
+        res = {}
+        tr = lgb.Dataset(X[:2000], label=y[:2000])
+        bst = lgb.train(
+            dict(BASE, objective="binary", metric="binary_logloss"),
+            tr, 300,
+            valid_sets=[lgb.Dataset(X[2000:], label=y[2000:], reference=tr)],
+            early_stopping_rounds=5, evals_result=res, verbose_eval=False)
+        assert bst.best_iteration < 300
+        assert len(res["valid_0"]["binary_logloss"]) <= 300
+
+    def test_weights_change_model(self):
+        X, y = make_binary(1000)
+        w = np.where(y > 0, 10.0, 1.0)
+        b1 = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y), 10)
+        b2 = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y, weight=w), 10)
+        p1, p2 = b1.predict(X), b2.predict(X)
+        assert np.mean(p2) > np.mean(p1)  # upweighted positives raise probabilities
+
+    def test_continued_training(self):
+        X, y = make_binary()
+        ds = lgb.Dataset(X, label=y)
+        m1 = lgb.train(dict(BASE, objective="binary"), ds, 10)
+        m2 = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y), 10, init_model=m1)
+        assert m2.num_trees() == 20
+        assert auc_of(y, m2.predict(X)) >= auc_of(y, m1.predict(X)) - 1e-9
+
+    def test_boosting_variants(self):
+        X, y = make_binary(1500)
+        for extra in (
+            {"boosting": "dart"},
+            {"boosting": "goss"},
+            {"boosting": "rf", "bagging_freq": 1, "bagging_fraction": 0.7},
+        ):
+            bst = lgb.train(dict(BASE, objective="binary", **extra), lgb.Dataset(X, label=y), 15)
+            assert auc_of(y, bst.predict(X)) > 0.9, extra
+
+    def test_cv(self):
+        X, y = make_binary(1000)
+        res = lgb.cv(dict(BASE, objective="binary", metric="auc"), lgb.Dataset(X, label=y),
+                     num_boost_round=5, nfold=3)
+        assert "auc-mean" in res
+        assert len(res["auc-mean"]) == 5
+        assert res["auc-mean"][-1] > 0.9

