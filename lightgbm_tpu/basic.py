@@ -377,8 +377,20 @@ class Booster:
         if pred_leaf:
             return self._gbdt.predict_leaf_index(X, num_iteration)
         if pred_contrib:
-            raise LightGBMError("predict_contrib is not implemented yet in lightgbm_tpu")
-        return self._gbdt.predict(X, num_iteration, raw_score=raw_score)
+            return self._gbdt.predict_contrib(X, num_iteration)
+        early_stop = None
+        pred_early_stop = kwargs.get("pred_early_stop", self.config.pred_early_stop)
+        obj_name = self._gbdt.objective.name if self._gbdt.objective is not None else ""
+        if pred_early_stop and obj_name in ("binary", "multiclass", "multiclassova", "cross_entropy"):
+            from .prediction_early_stop import create_prediction_early_stop_instance
+
+            es_type = "multiclass" if self._gbdt.num_tree_per_iteration > 1 else "binary"
+            early_stop = create_prediction_early_stop_instance(
+                es_type,
+                int(kwargs.get("pred_early_stop_freq", self.config.pred_early_stop_freq)),
+                float(kwargs.get("pred_early_stop_margin", self.config.pred_early_stop_margin)),
+            )
+        return self._gbdt.predict(X, num_iteration, raw_score=raw_score, early_stop=early_stop)
 
     # -- model IO --------------------------------------------------------
 

@@ -34,7 +34,15 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
 
 
 def _load_dataset(path: str, config: Config, reference: Optional[Dataset] = None) -> Dataset:
-    X, y, names = load_text_file(path, has_header=config.header, label_column=config.label_column)
+    # valid files must come out as wide as the train set (sparse libsvm rows
+    # may never reach the highest train feature index)
+    ref_width = reference.num_feature() if reference is not None else None
+    X, y, names = load_text_file(
+        path,
+        has_header=config.header,
+        label_column=config.label_column,
+        model_num_features=ref_width,
+    )
     weight = load_sidecar(path, "weight")
     group = load_sidecar(path, "query")
     init_score = load_sidecar(path, "init")
@@ -100,6 +108,10 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
         num_iteration=config.num_iteration_predict,
         raw_score=config.predict_raw_score,
         pred_leaf=config.predict_leaf_index,
+        pred_contrib=config.predict_contrib,
+        pred_early_stop=config.pred_early_stop,
+        pred_early_stop_freq=config.pred_early_stop_freq,
+        pred_early_stop_margin=config.pred_early_stop_margin,
     )
     out = np.asarray(preds)
     with open(config.output_result, "w") as fh:

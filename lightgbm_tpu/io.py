@@ -27,6 +27,9 @@ def _sniff_format(lines: List[str]) -> str:
     return "csv"
 
 
+_MISSING_TOKENS = frozenset(("", "NA", "na", "NaN", "nan", "N/A", "null", "NULL", "None"))
+
+
 def _is_number(s: str) -> bool:
     try:
         float(s)
@@ -58,8 +61,10 @@ def load_text_file(
     fmt = _sniff_format(sample)
     sep = "\t" if fmt == "tsv" else ","
     if fmt != "libsvm":
+        # a first row is a header only if it has tokens that are neither
+        # numbers nor missing-value markers (a row like "NA,1,0" is data)
         first_toks = [t.strip() for t in first.split(sep)]
-        auto_header = not all(_is_number(t) or t == "" for t in first_toks)
+        auto_header = not all(_is_number(t) or t in _MISSING_TOKENS for t in first_toks)
     else:
         auto_header = False
     use_header = has_header or auto_header
@@ -80,7 +85,7 @@ def load_text_file(
             )
 
     if fmt == "libsvm":
-        return _parse_libsvm(raw_lines, label_idx) + (None,)
+        return _parse_libsvm(raw_lines, model_num_features) + (None,)
     return _parse_delimited(raw_lines, sep, label_idx, header)
 
 
@@ -100,8 +105,7 @@ def _parse_delimited(lines, sep, label_idx, header):
     labels = []
     for ln in lines:
         toks = ln.split(sep)
-        vals = [float(t) if t.strip() not in ("", "NA", "na", "NaN", "nan", "N/A") else np.nan
-                for t in toks]
+        vals = [float(t) if t.strip() not in _MISSING_TOKENS else np.nan for t in toks]
         if label_idx is not None:
             labels.append(vals[label_idx])
             del vals[label_idx]
@@ -114,15 +118,19 @@ def _parse_delimited(lines, sep, label_idx, header):
     return X, y, names
 
 
-def _parse_libsvm(lines, label_idx):
+def _parse_libsvm(lines, model_num_features=None):
+    # a leading token without ':' is the label; prediction files may omit it
+    has_label = bool(lines) and ":" not in lines[0].split()[0]
     labels = []
     entries = []
     max_idx = -1
     for ln in lines:
         toks = ln.split()
-        labels.append(float(toks[0]))
+        if has_label:
+            labels.append(float(toks[0]))
+            toks = toks[1:]
         row = []
-        for t in toks[1:]:
+        for t in toks:
             if ":" not in t:
                 continue
             i, v = t.split(":", 1)
@@ -130,11 +138,15 @@ def _parse_libsvm(lines, label_idx):
             row.append((i, float(v)))
             max_idx = max(max_idx, i)
         entries.append(row)
-    X = np.zeros((len(lines), max_idx + 1), np.float64)
+    # sparse files may not reach the model's highest feature index; pad width
+    width = max_idx + 1
+    if model_num_features is not None:
+        width = max(width, model_num_features)
+    X = np.zeros((len(lines), width), np.float64)
     for r, row in enumerate(entries):
         for i, v in row:
             X[r, i] = v
-    return X, np.asarray(labels, np.float64)
+    return X, (np.asarray(labels, np.float64) if has_label else None)
 
 
 def load_sidecar(path: str, kind: str) -> Optional[np.ndarray]:

@@ -283,18 +283,91 @@ class GBDT:
     def _train_tree(self, grad_k: jax.Array, hess_k: jax.Array):
         cfg = self.config
         fmask = self._sample_features()
-        return grow_tree(
-            self.bins_dev,
-            grad_k,
-            hess_k,
-            self._bag_mask,
-            fmask,
-            self.feature_meta,
+        learner = self._learner_kind()
+        common = dict(
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
             num_bins=self.num_bins,
             params=self.split_params,
             chunk=cfg.tpu_hist_chunk,
+        )
+        if learner == "serial":
+            return grow_tree(
+                self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
+                self.feature_meta, **common,
+            )
+        mesh = self._mesh()
+        if learner == "feature":
+            from ..parallel.feature_parallel import grow_tree_feature_parallel
+
+            return grow_tree_feature_parallel(
+                mesh, self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
+                self.feature_meta, **common,
+            )
+        from ..parallel.data_parallel import grow_tree_data_parallel
+        from ..parallel.voting_parallel import grow_tree_voting_parallel
+
+        bins_s, grad_s, hess_s, bag_s = self._shard_rows(grad_k, hess_k)
+        if learner == "voting":
+            tree, leaf_id = grow_tree_voting_parallel(
+                mesh, bins_s, grad_s, hess_s, bag_s, fmask, self.feature_meta,
+                top_k=cfg.top_k, **common,
+            )
+        else:
+            tree, leaf_id = grow_tree_data_parallel(
+                mesh, bins_s, grad_s, hess_s, bag_s, fmask, self.feature_meta,
+                **common,
+            )
+        # drop shard-padding rows so score updates stay [N]-shaped
+        return tree, leaf_id[: self.num_data]
+
+    def _learner_kind(self) -> str:
+        """tree_learner dispatch (TreeLearner::CreateTreeLearner,
+        tree_learner.cpp:13-36): parallel learners engage when >1 device."""
+        kind = self.config.tree_learner
+        if kind in ("data", "feature", "voting") and len(jax.devices()) > 1:
+            return kind
+        return "serial"
+
+    def _mesh(self):
+        if getattr(self, "_mesh_cache", None) is None:
+            from ..parallel.feature_parallel import feature_mesh
+            from ..parallel.mesh import data_mesh
+
+            if self._learner_kind() == "feature":
+                self._mesh_cache = feature_mesh()
+            else:
+                self._mesh_cache = data_mesh()
+        return self._mesh_cache
+
+    def _shard_rows(self, grad_k, hess_k):
+        """Row-shard bins/grad/hess/bag over the data mesh (pads rows to the
+        shard count; padded rows carry zero bag weight so they are inert)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._mesh()
+        n_sh = mesh.shape["data"]
+        N = self.num_data
+        pad = (-N) % n_sh
+        if getattr(self, "_sharded_bins", None) is None:
+            bins = self.bins_dev
+            if pad:
+                bins = jnp.pad(bins, ((0, 0), (0, pad)))
+            self._sharded_bins = jax.device_put(
+                bins, NamedSharding(mesh, P(None, "data"))
+            )
+        row = NamedSharding(mesh, P("data"))
+        if pad:
+            grad_k = jnp.pad(grad_k, (0, pad))
+            hess_k = jnp.pad(hess_k, (0, pad))
+            bag = jnp.pad(self._bag_mask, (0, pad))
+        else:
+            bag = self._bag_mask
+        return (
+            self._sharded_bins,
+            jax.device_put(grad_k, row),
+            jax.device_put(hess_k, row),
+            jax.device_put(bag, row),
         )
 
     def _renew_and_shrink(self, tree_arrays, leaf_id, class_id: int):
@@ -431,8 +504,15 @@ class GBDT:
         self._materialize()
         return self.models
 
-    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        """Raw scores [N] or [N, K] (PredictRaw, gbdt_prediction.cpp:13)."""
+    def predict_raw(
+        self, X: np.ndarray, num_iteration: int = -1, early_stop=None
+    ) -> np.ndarray:
+        """Raw scores [N] or [N, K] (PredictRaw, gbdt_prediction.cpp:13-51).
+
+        ``early_stop`` is a PredictionEarlyStopInstance; every round_period
+        iterations, rows whose margin passes the threshold stop accumulating
+        trees (the reference's per-row callback, vectorized as an active mask).
+        """
         self._materialize()
         X = np.asarray(X, np.float64)
         N = X.shape[0]
@@ -441,15 +521,38 @@ class GBDT:
         if num_iteration is not None and num_iteration > 0:
             use = min(use, num_iteration * K)
         out = np.zeros((K, N), np.float64)
-        for i in range(use):
-            k = i % K
-            out[k] += self.models[i].predict_fast(X)
+        if early_stop is None or early_stop.round_period >= (use + K - 1) // K:
+            for i in range(use):
+                out[i % K] += self.models[i].predict_fast(X)
+        else:
+            active = np.arange(N)
+            counter = 0
+            for it in range(use // K + (1 if use % K else 0)):
+                Xa = X[active]
+                for k in range(K):
+                    i = it * K + k
+                    if i >= use:
+                        break
+                    out[k, active] += self.models[i].predict_fast(Xa)
+                counter += 1
+                if counter == early_stop.round_period:
+                    stop = early_stop.callback(out[:, active].T)
+                    active = active[~stop]
+                    counter = 0
+                    if len(active) == 0:
+                        break
         if self.average_output and use > 0:
             out /= max(use // K, 1)
         return out[0] if K == 1 else out.T
 
-    def predict(self, X: np.ndarray, num_iteration: int = -1, raw_score: bool = False) -> np.ndarray:
-        raw = self.predict_raw(X, num_iteration)
+    def predict(
+        self,
+        X: np.ndarray,
+        num_iteration: int = -1,
+        raw_score: bool = False,
+        early_stop=None,
+    ) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, early_stop=early_stop)
         if raw_score or self.objective is None:
             return raw
         return self.objective.convert_output(raw)
@@ -463,6 +566,32 @@ class GBDT:
         return np.stack(
             [self.models[i].predict_leaf_fast(X) for i in range(use)], axis=1
         ).astype(np.int32)
+
+    def predict_contrib(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        """SHAP feature contributions (GBDT::PredictContrib, gbdt.cpp:566-585).
+
+        Returns [N, F+1] for single-class models or [N, K*(F+1)] for multiclass,
+        last column per class block = expected value; rows sum to the raw score.
+        """
+        self._materialize()
+        X = np.asarray(X, np.float64)
+        N = X.shape[0]
+        K = self.num_tree_per_iteration
+        F = self.max_feature_idx + 1
+        use = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            use = min(use, num_iteration * K)
+        out = np.zeros((K, N, F + 1), np.float64)
+        for i in range(use):
+            t = self.models[i]
+            if t is None:
+                continue
+            out[i % K] += t.predict_contrib(X, F)
+        if self.average_output and use > 0:
+            out /= max(use // K, 1)
+        if K == 1:
+            return out[0]
+        return out.transpose(1, 0, 2).reshape(N, K * (F + 1))
 
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:415-431)."""

@@ -312,3 +312,134 @@ class Tree:
             return max(depth(int(self.left_child[node]), d + 1), depth(int(self.right_child[node]), d + 1))
 
         return depth(0, 0)
+
+    # -- SHAP feature contributions (Tree::PredictContrib, tree.h:123,470) -
+
+    def _data_count(self, node: int) -> float:
+        if node < 0:
+            return float(self.leaf_count[-(node + 1)])
+        return float(self.internal_count[node])
+
+    def expected_value(self) -> float:
+        """Coverage-weighted mean output (Tree::ExpectedValue, tree.cpp)."""
+        if self.num_leaves == 1:
+            return float(self.leaf_value[0])
+        total = float(self.internal_count[0])
+        if total <= 0:
+            return 0.0
+        return float(np.dot(self.leaf_count[: self.num_leaves], self.leaf_value[: self.num_leaves]) / total)
+
+    def predict_contrib_row(self, x: np.ndarray, phi: np.ndarray) -> None:
+        """Add this tree's exact SHAP values for one row into ``phi`` [F+1].
+
+        TreeSHAP (Lundberg et al.) exactly as the reference's Tree::TreeSHAP /
+        ExtendPath / UnwindPath / UnwoundPathSum (tree.h:286-470): a decision-path
+        walk maintaining, per unique feature on the path, the fraction of training
+        rows flowing through when the feature is unknown (zero_fraction) vs. taken
+        (one_fraction), with permutation weights (pweight) updated incrementally.
+        """
+        phi[-1] += self._expected_value_cached()
+        if self.num_leaves == 1:
+            return
+        maxd = self._max_depth_cached() + 2
+        # path arrays: feature_index / zero_fraction / one_fraction / pweight
+        fidx = np.full(maxd * (maxd + 1) // 2 + maxd, -1, dtype=np.int64)
+        zf = np.zeros_like(fidx, dtype=np.float64)
+        of = np.zeros_like(zf)
+        pw = np.zeros_like(zf)
+
+        def extend(off: int, depth: int, pzf: float, pof: float, pfi: int) -> None:
+            fidx[off + depth] = pfi
+            zf[off + depth] = pzf
+            of[off + depth] = pof
+            pw[off + depth] = 1.0 if depth == 0 else 0.0
+            for i in range(depth - 1, -1, -1):
+                pw[off + i + 1] += pof * pw[off + i] * (i + 1) / (depth + 1)
+                pw[off + i] = pzf * pw[off + i] * (depth - i) / (depth + 1)
+
+        def unwind(off: int, depth: int, pi: int) -> None:
+            one = of[off + pi]
+            zero = zf[off + pi]
+            nxt = pw[off + depth]
+            for i in range(depth - 1, -1, -1):
+                if one != 0.0:
+                    tmp = pw[off + i]
+                    pw[off + i] = nxt * (depth + 1) / ((i + 1) * one)
+                    nxt = tmp - pw[off + i] * zero * (depth - i) / (depth + 1)
+                else:
+                    pw[off + i] = pw[off + i] * (depth + 1) / (zero * (depth - i))
+            for i in range(pi, depth):
+                fidx[off + i] = fidx[off + i + 1]
+                zf[off + i] = zf[off + i + 1]
+                of[off + i] = of[off + i + 1]
+
+        def unwound_sum(off: int, depth: int, pi: int) -> float:
+            one = of[off + pi]
+            zero = zf[off + pi]
+            nxt = pw[off + depth]
+            total = 0.0
+            for i in range(depth - 1, -1, -1):
+                if one != 0.0:
+                    tmp = nxt * (depth + 1) / ((i + 1) * one)
+                    total += tmp
+                    nxt = pw[off + i] - tmp * zero * ((depth - i) / (depth + 1))
+                else:
+                    total += (pw[off + i] / zero) / ((depth - i) / (depth + 1))
+            return total
+
+        def shap(node: int, depth: int, parent_off: int, pzf: float, pof: float, pfi: int) -> None:
+            off = parent_off + depth
+            fidx[off : off + depth] = fidx[parent_off : parent_off + depth]
+            zf[off : off + depth] = zf[parent_off : parent_off + depth]
+            of[off : off + depth] = of[parent_off : parent_off + depth]
+            pw[off : off + depth] = pw[parent_off : parent_off + depth]
+            extend(off, depth, pzf, pof, pfi)
+            if node < 0:
+                leaf_out = float(self.leaf_value[-(node + 1)])
+                for i in range(1, depth + 1):
+                    w = unwound_sum(off, depth, i)
+                    phi[fidx[off + i]] += w * (of[off + i] - zf[off + i]) * leaf_out
+                return
+            f = int(self.split_feature[node])
+            goes_left = self._decide(node, float(x[f]))
+            hot = int(self.left_child[node] if goes_left else self.right_child[node])
+            cold = int(self.right_child[node] if goes_left else self.left_child[node])
+            w = self._data_count(node)
+            hot_zf = (self._data_count(hot) / w) if w > 0 else 0.0
+            cold_zf = (self._data_count(cold) / w) if w > 0 else 0.0
+            inc_zf = 1.0
+            inc_of = 1.0
+            d = depth
+            # if we have already split on this feature, undo that extension
+            pi = 0
+            while pi <= d:
+                if fidx[off + pi] == f:
+                    break
+                pi += 1
+            if pi != d + 1:
+                inc_zf = zf[off + pi]
+                inc_of = of[off + pi]
+                unwind(off, d, pi)
+                d -= 1
+            shap(hot, d + 1, off, hot_zf * inc_zf, inc_of, f)
+            shap(cold, d + 1, off, cold_zf * inc_zf, 0.0, f)
+
+        shap(0, 0, 0, 1.0, 1.0, -1)
+
+    def _expected_value_cached(self) -> float:
+        if not hasattr(self, "_exp_value"):
+            self._exp_value = self.expected_value()
+        return self._exp_value
+
+    def _max_depth_cached(self) -> int:
+        if not hasattr(self, "_max_depth"):
+            self._max_depth = self.max_depth()
+        return self._max_depth
+
+    def predict_contrib(self, X: np.ndarray, num_features: int) -> np.ndarray:
+        """[n, num_features+1] SHAP matrix for this tree (last col = expected)."""
+        X = np.asarray(X, np.float64)
+        out = np.zeros((X.shape[0], num_features + 1), np.float64)
+        for r in range(X.shape[0]):
+            self.predict_contrib_row(X[r], out[r])
+        return out

@@ -71,15 +71,6 @@ class GrowState(NamedTuple):
     hist: jax.Array  # [M, F, B, 3]
 
 
-def _vmapped_split(params: SplitParams):
-    return jax.vmap(
-        lambda h, sg, sh, nd, mnc, mxc, meta, fmask: find_best_split(
-            h, sg, sh, nd, mnc, mxc, meta, fmask, params
-        ),
-        in_axes=(0, 0, 0, 0, 0, 0, None, None),
-    )
-
-
 def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat):
     """Bin-space split decision (dense_bin.hpp Split / CategoricalDecision)."""
     go_left = col <= threshold
@@ -94,7 +85,10 @@ def _decision_go_left(col, threshold, default_left, missing_type, default_bin, n
 
 @functools.partial(
     jax.jit,
-    static_argnames=("num_leaves", "max_depth", "num_bins", "params", "chunk", "axis_name"),
+    static_argnames=(
+        "num_leaves", "max_depth", "num_bins", "params", "chunk", "axis_name",
+        "split_fn", "psum_hist",
+    ),
 )
 def grow_tree(
     bins: jax.Array,  # [F, N] uint8/int32
@@ -109,21 +103,48 @@ def grow_tree(
     params: SplitParams,
     chunk: int = 4096,
     axis_name: Optional[str] = None,
+    split_fn=None,
+    psum_hist: bool = True,
 ):
-    """Grow one tree; returns (TreeArrays, leaf_id [N])."""
+    """Grow one tree; returns (TreeArrays, leaf_id [N]).
+
+    ``split_fn(hist, sum_g, sum_h, num_data, min_c, max_c, feature_meta,
+    feature_mask, params) -> SplitResult`` overrides the best-split search —
+    the hook where the voting-parallel learner's top-k vote + reduced psum
+    plugs in (voting_parallel_tree_learner.cpp:262-375). With ``axis_name``
+    set and ``psum_hist=False``, per-leaf histograms stay shard-local (only
+    root totals are psum'd); the split_fn is then responsible for combining
+    shard histograms.
+    """
     F, N = bins.shape
     M = num_leaves
     B = num_bins
     f32 = jnp.float32
 
-    vsplit = _vmapped_split(params)
+    if split_fn is None:
+        split_fn = find_best_split
+    hist_axis = axis_name if psum_hist else None
+
+    def split2(hist2, sg2, sh2, nd2, mn2, mx2):
+        """Best splits for the two children (unrolled: split_fn may contain
+        collectives, which don't vmap under shard_map)."""
+        results = [
+            split_fn(
+                hist2[k], sg2[k], sh2[k], nd2[k], mn2[k], mx2[k],
+                feature_meta, feature_mask, params,
+            )
+            for k in range(2)
+        ]
+        return SplitResult(
+            *[jnp.stack([getattr(r, n) for r in results]) for n in SplitResult._fields]
+        )
 
     def masked_values(mask_f32):
         return leaf_values(grad, hess, mask_f32 * bag_mask)
 
     # ---- root ----------------------------------------------------------
     root_vals = masked_values(jnp.ones((N,), f32))
-    root_hist = leaf_histogram(bins, root_vals, B, chunk=chunk, axis_name=axis_name)
+    root_hist = leaf_histogram(bins, root_vals, B, chunk=chunk, axis_name=hist_axis)
     # Root totals from the histogram of feature 0 would miss rows in padded bins;
     # sum the mask directly instead (psum'd under shard_map like GBDT's root sync,
     # serial_tree_learner.cpp:271 BeforeTrain).
@@ -139,7 +160,7 @@ def grow_tree(
     no_con_min = jnp.full((M,), -jnp.inf, f32)
     no_con_max = jnp.full((M,), jnp.inf, f32)
 
-    root_split = find_best_split(
+    root_split = split_fn(
         root_hist,
         root_g,
         root_h,
@@ -313,7 +334,7 @@ def grow_tree(
         large_idx = jnp.where(left_smaller, new_leaf, best_leaf)
         small_mask = (leaf_id == small_idx).astype(f32)
         small_hist = leaf_histogram(
-            bins, masked_values(small_mask), B, chunk=chunk, axis_name=axis_name
+            bins, masked_values(small_mask), B, chunk=chunk, axis_name=hist_axis
         )
         parent_hist = s.hist[best_leaf]
         large_hist = parent_hist - small_hist
@@ -327,7 +348,7 @@ def grow_tree(
         ch_nd = lnd[child_idx]
         ch_min = min_con[child_idx]
         ch_max = max_con[child_idx]
-        ch_split = vsplit(ch_hist, ch_sg, ch_sh, ch_nd, ch_min, ch_max, feature_meta, feature_mask)
+        ch_split = split2(ch_hist, ch_sg, ch_sh, ch_nd, ch_min, ch_max)
         ch_gain = depth_gate(ch_split.gain, depth_child)
 
         def upd(field_arr, child_vals):

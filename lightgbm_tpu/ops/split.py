@@ -94,8 +94,27 @@ class SplitResult(NamedTuple):
     right_output: jax.Array
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
-def find_best_split(
+class _ScanOut(NamedTuple):
+    """Per-feature best candidates + side-sum arrays for recovery."""
+
+    g_best: jax.Array  # [F]
+    t_best: jax.Array  # [F]
+    dl_best: jax.Array  # [F]
+    use_pos: jax.Array  # [F]
+    is_cat: jax.Array  # [F]
+    lg_pos: jax.Array  # [F, B]
+    lh_pos: jax.Array
+    lc_pos: jax.Array
+    lg_neg: jax.Array
+    lh_neg: jax.Array
+    lc_neg: jax.Array
+    cat_lg: jax.Array
+    cat_lh: jax.Array
+    cat_lc: jax.Array
+    min_gain_shift: jax.Array
+
+
+def _scan_candidates(
     hist: jax.Array,  # [F, B, 3] (sum_grad, sum_hess, count)
     sum_grad: jax.Array,  # leaf totals (scalars)
     sum_hess: jax.Array,
@@ -103,10 +122,10 @@ def find_best_split(
     min_constraint: jax.Array,  # monotone constraint window for this leaf
     max_constraint: jax.Array,
     feature_meta: Dict[str, jax.Array],  # num_bin/missing_type/default_bin/monotone [F]
-    feature_mask: jax.Array,  # [F] bool: feature_fraction sample & usable
     params: SplitParams,
-) -> SplitResult:
-    """Best split for one leaf across all features (FindBestThresholdNumerical)."""
+) -> _ScanOut:
+    """Per-feature threshold scan; the shared core of find_best_split and the
+    voting-parallel local stage (voting_parallel_tree_learner.cpp:337)."""
     F, B, _ = hist.shape
     p = params
     num_bin = feature_meta["num_bin"].astype(jnp.int32)  # [F]
@@ -237,6 +256,73 @@ def find_best_split(
     t_best = jnp.where(is_cat, t_cat, t_best)
     dl_best = jnp.where(is_cat, False, dl_best)
     use_pos = jnp.where(is_cat, True, use_pos)  # pick() reads the prefix arrays
+
+    return _ScanOut(
+        g_best=g_best,
+        t_best=t_best,
+        dl_best=dl_best,
+        use_pos=use_pos,
+        is_cat=is_cat,
+        lg_pos=lg_pos,
+        lh_pos=lh_pos,
+        lc_pos=lc_pos,
+        lg_neg=lg_neg,
+        lh_neg=lh_neg,
+        lc_neg=lc_neg,
+        cat_lg=cat_lg,
+        cat_lh=cat_lh,
+        cat_lc=cat_lc,
+        min_gain_shift=min_gain_shift,
+    )
+
+
+def per_feature_best_gain(
+    hist: jax.Array,
+    sum_grad: jax.Array,
+    sum_hess: jax.Array,
+    num_data: jax.Array,
+    min_constraint: jax.Array,
+    max_constraint: jax.Array,
+    feature_meta: Dict[str, jax.Array],
+    feature_mask: jax.Array,
+    params: SplitParams,
+) -> jax.Array:
+    """[F] best gain per feature (-inf where none) — the voting-parallel
+    local-voting stage (LightSplitInfo gains, voting_parallel_tree_learner.cpp:337)."""
+    sc = _scan_candidates(
+        hist, sum_grad, sum_hess, num_data, min_constraint, max_constraint,
+        feature_meta, params,
+    )
+    return jnp.where(feature_mask, sc.g_best, K_MIN_SCORE)
+
+
+@functools.partial(jax.jit, static_argnames=("params",))
+def find_best_split(
+    hist: jax.Array,  # [F, B, 3] (sum_grad, sum_hess, count)
+    sum_grad: jax.Array,  # leaf totals (scalars)
+    sum_hess: jax.Array,
+    num_data: jax.Array,
+    min_constraint: jax.Array,  # monotone constraint window for this leaf
+    max_constraint: jax.Array,
+    feature_meta: Dict[str, jax.Array],  # num_bin/missing_type/default_bin/monotone [F]
+    feature_mask: jax.Array,  # [F] bool: feature_fraction sample & usable
+    params: SplitParams,
+) -> SplitResult:
+    """Best split for one leaf across all features (FindBestThresholdNumerical)."""
+    p = params
+    sum_hess_eff = sum_hess + 2 * K_EPSILON  # feature_histogram.hpp:87
+    sc = _scan_candidates(
+        hist, sum_grad, sum_hess, num_data, min_constraint, max_constraint,
+        feature_meta, params,
+    )
+    (g_best, t_best, dl_best, use_pos, is_cat) = (
+        sc.g_best, sc.t_best, sc.dl_best, sc.use_pos, sc.is_cat,
+    )
+    (lg_pos, lh_pos, lc_pos, lg_neg, lh_neg, lc_neg, cat_lg, cat_lh, cat_lc) = (
+        sc.lg_pos, sc.lh_pos, sc.lc_pos, sc.lg_neg, sc.lh_neg, sc.lc_neg,
+        sc.cat_lg, sc.cat_lh, sc.cat_lc,
+    )
+    min_gain_shift = sc.min_gain_shift
 
     g_best = jnp.where(feature_mask, g_best, K_MIN_SCORE)
 

@@ -1,0 +1,64 @@
+"""Margin-based prediction early stopping.
+
+TPU-native counterpart of the reference's per-row early exit
+(/root/reference/src/boosting/prediction_early_stop.cpp:1-94,
+include/LightGBM/prediction_early_stop.h). The reference installs a per-row
+callback checked every ``round_period`` trees; here prediction is vectorized
+over rows per tree, so the same semantics become a row-active mask updated
+every ``round_period`` trees — rows whose margin already exceeds the threshold
+stop accumulating further trees.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+
+class PredictionEarlyStopInstance(NamedTuple):
+    """(callback, round_period): callback maps [N, K] raw scores -> [N] bool
+    "stop" mask (True = this row's margin passed the threshold)."""
+
+    callback: Callable[[np.ndarray], np.ndarray]
+    round_period: int
+
+
+def _none_instance() -> PredictionEarlyStopInstance:
+    return PredictionEarlyStopInstance(
+        lambda pred: np.zeros(pred.shape[0], dtype=bool), np.iinfo(np.int32).max
+    )
+
+
+def _binary_instance(margin_threshold: float, round_period: int) -> PredictionEarlyStopInstance:
+    def cb(pred: np.ndarray) -> np.ndarray:
+        if pred.shape[1] != 1:
+            raise ValueError("Binary early stopping needs predictions to be of length one")
+        return 2.0 * np.abs(pred[:, 0]) > margin_threshold
+
+    return PredictionEarlyStopInstance(cb, round_period)
+
+
+def _multiclass_instance(margin_threshold: float, round_period: int) -> PredictionEarlyStopInstance:
+    def cb(pred: np.ndarray) -> np.ndarray:
+        if pred.shape[1] < 2:
+            raise ValueError(
+                "Multiclass early stopping needs predictions to be of length two or larger"
+            )
+        part = np.partition(pred, -2, axis=1)
+        margin = part[:, -1] - part[:, -2]
+        return margin > margin_threshold
+
+    return PredictionEarlyStopInstance(cb, round_period)
+
+
+def create_prediction_early_stop_instance(
+    type_: str, round_period: int, margin_threshold: float
+) -> PredictionEarlyStopInstance:
+    """CreatePredictionEarlyStopInstance (prediction_early_stop.cpp:78-92)."""
+    if type_ == "none":
+        return _none_instance()
+    if type_ == "binary":
+        return _binary_instance(margin_threshold, round_period)
+    if type_ == "multiclass":
+        return _multiclass_instance(margin_threshold, round_period)
+    raise ValueError("Unknown early stopping type: %s" % type_)

@@ -1,0 +1,126 @@
+"""SHAP feature-contribution tests (Tree::PredictContrib parity).
+
+Checks the two defining properties of exact TreeSHAP:
+ * local accuracy / efficiency: contributions (+ expected-value column) sum to
+   the raw model output for every row;
+ * exact match with a brute-force Shapley computation over the coverage-weighted
+   conditional expectation (the EXPVALUE function of the TreeSHAP paper), which
+   is what the reference's Tree::TreeSHAP computes (tree.h:286-470).
+"""
+import itertools
+import math
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _make_binary(n=400, f=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = ((X[:, 0] + 0.5 * X[:, 1] * X[:, 2] + 0.2 * rng.randn(n)) > 0).astype(np.float64)
+    return X, y
+
+
+def _expvalue(tree, x, subset, node=0):
+    """Conditional expectation with features outside `subset` marginalized by
+    training coverage (TreeSHAP paper Algorithm 1 EXPVALUE)."""
+    if node < 0:
+        return float(tree.leaf_value[-(node + 1)])
+    f = int(tree.split_feature[node])
+    left = int(tree.left_child[node])
+    right = int(tree.right_child[node])
+    if f in subset:
+        nxt = left if tree._decide(node, float(x[f])) else right
+        return _expvalue(tree, x, subset, nxt)
+    wl = tree._data_count(left)
+    wr = tree._data_count(right)
+    w = wl + wr
+    return (wl * _expvalue(tree, x, subset, left) + wr * _expvalue(tree, x, subset, right)) / w
+
+
+def _brute_shap(tree, x, num_features):
+    """Exact Shapley values by subset enumeration."""
+    phi = np.zeros(num_features + 1)
+    feats = list(range(num_features))
+    nf = len(feats)
+    for i in feats:
+        others = [f for f in feats if f != i]
+        for k in range(nf):
+            for S in itertools.combinations(others, k):
+                wgt = math.factorial(k) * math.factorial(nf - k - 1) / math.factorial(nf)
+                phi[i] += wgt * (_expvalue(tree, x, set(S) | {i}) - _expvalue(tree, x, set(S)))
+    phi[-1] = _expvalue(tree, x, set())
+    return phi
+
+
+def test_contrib_matches_brute_force():
+    X, y = _make_binary(n=300, f=4)
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 8, "min_data_in_leaf": 10, "verbosity": -1},
+        train_set=ds,
+    )
+    booster.update()
+    tree = booster._gbdt.trees()[0]
+    assert tree.num_leaves > 2
+    for r in range(5):
+        got = np.zeros(X.shape[1] + 1)
+        tree.predict_contrib_row(X[r], got)
+        want = _brute_shap(tree, X[r], X.shape[1])
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+
+
+def test_contrib_sums_to_raw_prediction_binary():
+    X, y = _make_binary()
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 15, "verbosity": -1}, train_set=ds
+    )
+    for _ in range(10):
+        booster.update()
+    contrib = booster.predict(X[:50], pred_contrib=True)
+    assert contrib.shape == (50, X.shape[1] + 1)
+    raw = booster.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-6)
+
+
+def test_contrib_sums_to_raw_prediction_multiclass():
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 6)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5).astype(int)
+    ds = lgb.Dataset(X, label=y.astype(np.float64))
+    booster = lgb.Booster(
+        params={
+            "objective": "multiclass",
+            "num_class": 3,
+            "num_leaves": 7,
+            "verbosity": -1,
+        },
+        train_set=ds,
+    )
+    for _ in range(5):
+        booster.update()
+    contrib = booster.predict(X[:30], pred_contrib=True)
+    F1 = X.shape[1] + 1
+    assert contrib.shape == (30, 3 * F1)
+    raw = booster.predict(X[:30], raw_score=True)
+    per_class = contrib.reshape(30, 3, F1).sum(axis=2)
+    np.testing.assert_allclose(per_class, raw, rtol=1e-6, atol=1e-6)
+
+
+def test_contrib_handles_nan_rows():
+    X, y = _make_binary(n=300, f=4)
+    X = X.copy()
+    X[::7, 1] = np.nan
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 8, "verbosity": -1}, train_set=ds
+    )
+    for _ in range(5):
+        booster.update()
+    Xq = X[:20]
+    contrib = booster.predict(Xq, pred_contrib=True)
+    raw = booster.predict(Xq, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6, atol=1e-6)

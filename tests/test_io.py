@@ -1,0 +1,48 @@
+"""Text IO parser tests (Parser / DatasetLoader file-side semantics)."""
+import numpy as np
+
+from lightgbm_tpu.io import load_text_file
+
+
+def test_na_first_row_is_not_header(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("NA,1,0\n1.5,2,1\n2.5,3,0\n")
+    X, y, names = load_text_file(str(p))
+    assert X.shape == (3, 2)  # all three rows kept; none eaten as a header
+    assert np.isnan(y[0]) and X[0, 0] == 1.0
+    assert names is None
+
+
+def test_header_auto_detected(tmp_path):
+    p = tmp_path / "d.csv"
+    p.write_text("label,f0,f1\n1,2.0,3.0\n0,4.0,5.0\n")
+    X, y, names = load_text_file(str(p))
+    assert X.shape == (2, 2)
+    np.testing.assert_array_equal(y, [1.0, 0.0])
+    assert names == ["f0", "f1"]
+
+
+def test_libsvm_with_label(tmp_path):
+    p = tmp_path / "d.txt"
+    p.write_text("1 0:1.5 2:2.0\n0 1:3.0\n")
+    X, y, _ = load_text_file(str(p))
+    assert X.shape == (2, 3)
+    np.testing.assert_array_equal(y, [1.0, 0.0])
+    assert X[0, 0] == 1.5 and X[0, 2] == 2.0 and X[1, 1] == 3.0
+
+
+def test_libsvm_without_label_pads_to_model_width(tmp_path):
+    p = tmp_path / "d.txt"
+    p.write_text("0:1.5 2:2.0\n1:3.0\n")
+    X, y, _ = load_text_file(str(p), model_num_features=5)
+    assert y is None
+    assert X.shape == (2, 5)
+    assert X[0, 0] == 1.5 and X[0, 2] == 2.0
+
+
+def test_libsvm_sparse_label_file_pads(tmp_path):
+    p = tmp_path / "d.txt"
+    p.write_text("1 0:1.0\n0 0:2.0\n")
+    X, y, _ = load_text_file(str(p), model_num_features=4)
+    assert X.shape == (2, 4)
+    np.testing.assert_array_equal(y, [1.0, 0.0])

@@ -16,6 +16,8 @@ from lightgbm_tpu.dataset import construct_dataset
 from lightgbm_tpu.ops.grow import grow_tree
 from lightgbm_tpu.ops.split import SplitParams
 from lightgbm_tpu.parallel import data_mesh, grow_tree_data_parallel
+from lightgbm_tpu.parallel.feature_parallel import feature_mesh, grow_tree_feature_parallel
+from lightgbm_tpu.parallel.voting_parallel import grow_tree_voting_parallel
 
 PARAMS = SplitParams(
     lambda_l1=0.0,
@@ -110,3 +112,90 @@ class TestDataParallel:
             np.asarray(tree_sh.split_feature)[: nl - 1],
         )
         np.testing.assert_array_equal(np.asarray(leaf_single), np.asarray(leaf_sh))
+
+
+def _serial_and_inputs(n=1024, f=6, num_leaves=15):
+    ds, meta, grad, hess = _setup(n=n, f=f)
+    kw = dict(num_leaves=num_leaves, max_depth=-1, num_bins=ds.max_num_bin, params=PARAMS, chunk=256)
+    ones = jnp.ones((ds.num_data,), jnp.float32)
+    fmask = jnp.ones((ds.num_features,), bool)
+    bins = jnp.asarray(ds.bins)
+    tree_s, leaf_s = grow_tree(bins, grad, hess, ones, fmask, meta, **kw)
+    return ds, meta, grad, hess, kw, ones, fmask, bins, tree_s, leaf_s
+
+
+def _assert_same_tree(tree_a, tree_b, leaf_a=None, leaf_b=None):
+    assert int(tree_a.num_leaves) == int(tree_b.num_leaves)
+    nl = int(tree_a.num_leaves)
+    np.testing.assert_array_equal(
+        np.asarray(tree_a.split_feature)[: nl - 1], np.asarray(tree_b.split_feature)[: nl - 1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tree_a.threshold_bin)[: nl - 1], np.asarray(tree_b.threshold_bin)[: nl - 1]
+    )
+    np.testing.assert_allclose(
+        np.asarray(tree_a.leaf_value)[:nl], np.asarray(tree_b.leaf_value)[:nl],
+        rtol=2e-4, atol=2e-6,
+    )
+    if leaf_a is not None:
+        np.testing.assert_array_equal(np.asarray(leaf_a), np.asarray(leaf_b))
+
+
+class TestFeatureParallel:
+    def test_same_tree_as_single_device(self):
+        """feature_parallel_tree_learner.cpp semantics: identical tree, features sharded."""
+        ds, meta, grad, hess, kw, ones, fmask, bins, tree_s, leaf_s = _serial_and_inputs()
+        mesh = feature_mesh(jax.devices()[:4])  # 6 features / 4 shards -> padding path
+        tree_fp, leaf_fp = grow_tree_feature_parallel(
+            mesh, bins, grad, hess, ones, fmask, meta, **kw
+        )
+        _assert_same_tree(tree_s, tree_fp, leaf_s, leaf_fp)
+
+
+class TestVotingParallel:
+    def test_exact_when_topk_covers_features(self):
+        """With top_k >= F every feature is elected -> identical to serial
+        (PV-tree reduces to data-parallel, voting_parallel_tree_learner.cpp:170)."""
+        ds, meta, grad, hess, kw, ones, fmask, bins, tree_s, leaf_s = _serial_and_inputs()
+        mesh = data_mesh(8)
+        tree_vp, leaf_vp = grow_tree_voting_parallel(
+            mesh, bins, grad, hess, ones, fmask, meta, top_k=ds.num_features, **kw
+        )
+        _assert_same_tree(tree_s, tree_vp, leaf_s, leaf_vp)
+
+    def test_small_topk_still_grows_good_tree(self):
+        """With top_k < F the tree may differ but must train (approximate voting)."""
+        ds, meta, grad, hess, kw, ones, fmask, bins, tree_s, leaf_s = _serial_and_inputs()
+        mesh = data_mesh(8)
+        tree_vp, leaf_vp = grow_tree_voting_parallel(
+            mesh, bins, grad, hess, ones, fmask, meta, top_k=2, **kw
+        )
+        assert int(tree_vp.num_leaves) >= 2
+        # root split must agree with serial: the top-voted feature is the global best
+        np.testing.assert_array_equal(
+            np.asarray(tree_s.split_feature)[:1], np.asarray(tree_vp.split_feature)[:1]
+        )
+
+
+class TestLearnerDispatch:
+    @pytest.mark.parametrize("learner", ["data", "voting", "feature"])
+    def test_booster_trains_with_parallel_learner(self, learner):
+        import lightgbm_tpu as lgb
+
+        rng = np.random.RandomState(9)
+        X = rng.randn(640, 5)
+        y = (X[:, 0] > 0).astype(np.float64)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.Booster(
+            params={
+                "objective": "binary",
+                "num_leaves": 7,
+                "tree_learner": learner,
+                "verbosity": -1,
+            },
+            train_set=ds,
+        )
+        for _ in range(3):
+            bst.update()
+        auc_in = np.mean((bst.predict(X) > 0.5) == y)
+        assert auc_in > 0.9

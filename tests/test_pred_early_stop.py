@@ -1,0 +1,61 @@
+"""Prediction early-stopping tests (prediction_early_stop.cpp parity)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.prediction_early_stop import create_prediction_early_stop_instance
+
+
+def test_factory_semantics():
+    none = create_prediction_early_stop_instance("none", 10, 1.0)
+    assert not none.callback(np.array([[100.0]])).any()
+
+    binary = create_prediction_early_stop_instance("binary", 5, 4.0)
+    stop = binary.callback(np.array([[1.0], [3.0], [-3.0], [2.0001]]))
+    # margin = 2*|p|; threshold 4.0 strictly
+    np.testing.assert_array_equal(stop, [False, True, True, True])
+
+    multi = create_prediction_early_stop_instance("multiclass", 5, 1.5)
+    stop = multi.callback(np.array([[3.0, 1.0, 0.0], [2.0, 1.0, 0.0]]))
+    np.testing.assert_array_equal(stop, [True, False])
+
+
+def _train_binary(n=500, f=6, rounds=40):
+    rng = np.random.RandomState(5)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(
+        params={"objective": "binary", "num_leaves": 15, "verbosity": -1}, train_set=ds
+    )
+    for _ in range(rounds):
+        booster.update()
+    return booster, X, y
+
+
+def test_early_stop_binary_close_to_full():
+    booster, X, y = _train_binary()
+    full = booster.predict(X)
+    es = booster.predict(X, pred_early_stop=True, pred_early_stop_freq=5, pred_early_stop_margin=1.5)
+    # early-stopped probabilities may differ but must agree on the decision for
+    # confidently-classified rows and be close overall
+    assert np.mean((full > 0.5) == (es > 0.5)) > 0.95
+    # with a huge margin threshold nothing stops early -> identical
+    same = booster.predict(X, pred_early_stop=True, pred_early_stop_freq=5, pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(same, full, rtol=1e-12)
+
+
+def test_early_stop_multiclass_runs():
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 5)
+    y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+    ds = lgb.Dataset(X, label=y.astype(np.float64))
+    booster = lgb.Booster(
+        params={"objective": "multiclass", "num_class": 3, "num_leaves": 7, "verbosity": -1},
+        train_set=ds,
+    )
+    for _ in range(15):
+        booster.update()
+    full = booster.predict(X)
+    es = booster.predict(X, pred_early_stop=True, pred_early_stop_freq=3, pred_early_stop_margin=2.0)
+    assert es.shape == full.shape
+    assert np.mean(np.argmax(full, axis=1) == np.argmax(es, axis=1)) > 0.95
