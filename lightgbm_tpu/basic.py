@@ -419,6 +419,50 @@ class Booster:
         self._gbdt.reset_parameter(params)
         return self
 
+    def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
+        """Refit the existing Booster on new data (basic.py:2290-2332):
+        keep every tree's structure, recompute leaf values from the new data's
+        gradients, blended ``decay_rate*old + (1-decay_rate)*new``."""
+        if self._gbdt.objective is None:
+            raise LightGBMError("Cannot refit due to null objective function.")
+        leaf_preds = self.predict(data, num_iteration=-1, pred_leaf=True, **kwargs)
+        # carry the model's objective (with its params) and class count so a
+        # loaded model refits under its own config — the reference aborts via
+        # CHECK(num_tree_per_iteration == NumModelPerIteration) when these
+        # drift (gbdt.cpp ResetTrainingData); here they are inherited instead.
+        params = dict(self.params)
+        obj_str = self._gbdt.objective.to_string()
+        tokens = obj_str.split()
+        params.setdefault("objective", tokens[0])
+        for tok in tokens[1:]:
+            if ":" in tok:
+                k, v = tok.split(":", 1)
+                params.setdefault(k, v)
+            elif tok == "sqrt":
+                params.setdefault("reg_sqrt", True)
+        params.setdefault("num_class", self._gbdt.num_class)
+        train_set = Dataset(data, label=label, params=params)
+        new_booster = Booster(params, train_set)
+        if (
+            new_booster._gbdt.num_tree_per_iteration
+            != self._gbdt.num_tree_per_iteration
+        ):
+            raise LightGBMError(
+                "Cannot refit: the new objective trains %d models per iteration "
+                "but the loaded model has %d"
+                % (
+                    new_booster._gbdt.num_tree_per_iteration,
+                    self._gbdt.num_tree_per_iteration,
+                )
+            )
+        new_booster._gbdt.merge_models_from(self._gbdt)
+        new_booster._gbdt.refit(np.asarray(leaf_preds), decay_rate)
+        return new_booster
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Output of one leaf (LGBM_BoosterGetLeafValue, c_api.h)."""
+        return float(self._gbdt.trees()[tree_id].leaf_value[leaf_id])
+
     def __getstate__(self):
         return {"model_str": self.model_to_string(), "params": self.params}
 

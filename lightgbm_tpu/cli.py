@@ -124,6 +124,41 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
     log.info("Finished prediction; results saved to %s" % config.output_result)
 
 
+def run_convert_model(config: Config, params: Dict[str, str]) -> None:
+    """task=convert_model (application.cpp:258-262): model file → standalone
+    C++ source (if-else codegen)."""
+    if not config.input_model:
+        log.fatal("No model file specified (input_model=...)")
+    from .models.model_codegen import save_model_to_ifelse
+
+    booster = Booster(model_file=config.input_model)
+    code = save_model_to_ifelse(booster._gbdt, num_iteration=-1)
+    with open(config.convert_model, "w") as fh:
+        fh.write(code)
+    log.info("Finished converting model; source saved to %s" % config.convert_model)
+
+
+def run_refit(config: Config, params: Dict[str, str]) -> None:
+    """task=refit (application.cpp:214-239): load model, predict leaves on
+    data, refit leaf values on its labels, save."""
+    if not config.data:
+        log.fatal("No refit data specified (data=...)")
+    if not config.input_model:
+        log.fatal("No model file specified (input_model=...)")
+    booster = Booster(model_file=config.input_model, params=dict(params))
+    X, y, _ = load_text_file(
+        config.data,
+        has_header=config.header,
+        label_column=config.label_column,
+        model_num_features=booster.num_feature(),
+    )
+    if y is None:
+        log.fatal("Refit data must contain a label column")
+    refitted = booster.refit(X, y, decay_rate=config.refit_decay_rate)
+    refitted.save_model(config.output_model)
+    log.info("Finished RefitTree; model saved to %s" % config.output_model)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     params = parse_args(argv)
@@ -133,9 +168,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     elif config.task in ("predict", "prediction", "test"):
         run_predict(config, params)
     elif config.task == "convert_model":
-        log.fatal("convert_model task is not implemented yet in lightgbm_tpu")
+        run_convert_model(config, params)
     elif config.task == "refit":
-        log.fatal("refit task is not implemented yet in lightgbm_tpu")
+        run_refit(config, params)
     else:
         log.fatal("Unknown task: %s" % config.task)
     return 0

@@ -36,6 +36,15 @@ from .tree import Tree
 K_EPSILON = 1e-15
 
 
+def _leaf_output_np(sum_grad, sum_hess, l1: float, l2: float, max_delta_step: float):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:451) in numpy."""
+    num = -np.sign(sum_grad) * np.maximum(np.abs(sum_grad) - l1, 0.0)
+    out = num / (sum_hess + l2)
+    if max_delta_step > 0:
+        out = np.clip(out, -max_delta_step, max_delta_step)
+    return out
+
+
 class GBDT:
     """Gradient Boosting Decision Tree trainer/model (gbdt.h:37-501)."""
 
@@ -592,6 +601,72 @@ class GBDT:
         if K == 1:
             return out[0]
         return out.transpose(1, 0, 2).reshape(N, K * (F + 1))
+
+    def merge_models_from(self, other: "GBDT") -> None:
+        """Copy the predictor's trees into this (freshly created) trainer —
+        the LGBM_BoosterMerge step of Booster.refit (basic.py:2320)."""
+        import copy as _copy
+
+        other._materialize()
+        K = max(self.num_tree_per_iteration, 1)
+        self.models = [_copy.deepcopy(t) for t in other.models]
+        self._device_trees = [(None, i % K) for i in range(len(self.models))]
+        self.iter_ = len(self.models) // K
+        self.shrinkage_rate = other.shrinkage_rate
+        self.average_output = other.average_output
+
+    def refit(self, leaf_preds: np.ndarray, decay_rate: Optional[float] = None) -> None:
+        """Refit leaf values on this trainer's dataset, keeping tree structure.
+
+        GBDT::RefitTree (gbdt.cpp:262-285): iterate stored trees in boosting
+        order; per iteration, gradients come from the objective at the current
+        (progressively rebuilt) scores; per tree, leaf grad/hess sums give
+        FitByExistingTree's regularized output (serial_tree_learner.cpp:239-268)
+        blended with the old value by ``refit_decay_rate``.
+        """
+        cfg = self.config
+        if decay_rate is None:
+            decay_rate = cfg.refit_decay_rate
+        self._materialize()
+        K = self.num_tree_per_iteration
+        N = self.num_data
+        leaf_preds = np.asarray(leaf_preds)
+        if leaf_preds.ndim == 1:
+            leaf_preds = leaf_preds.reshape(N, -1)
+        if leaf_preds.shape[0] != N:
+            raise ValueError(
+                "leaf_preds has %d rows, dataset has %d" % (leaf_preds.shape[0], N)
+            )
+        if leaf_preds.shape[1] != len(self.models):
+            raise ValueError(
+                "leaf_preds has %d trees, model has %d"
+                % (leaf_preds.shape[1], len(self.models))
+            )
+        # scores rebuild from zero on the refit dataset (fresh ScoreUpdater)
+        self.scores = jnp.zeros((K, N), jnp.float32)
+        num_iterations = len(self.models) // K
+        for it in range(num_iterations):
+            grad, hess = self._compute_gradients([0.0] * K)
+            grad_np = np.asarray(grad, np.float64)
+            hess_np = np.asarray(hess, np.float64)
+            for k in range(K):
+                mi = it * K + k
+                tree = self.models[mi]
+                nl = tree.num_leaves
+                lp = leaf_preds[:, mi].astype(np.int64)
+                sum_g = np.bincount(lp, weights=grad_np[k], minlength=nl)
+                sum_h = np.bincount(lp, weights=hess_np[k], minlength=nl) + K_EPSILON
+                out = _leaf_output_np(
+                    sum_g, sum_h, cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step
+                )
+                new_out = out * tree.shrinkage
+                tree.leaf_value = (
+                    decay_rate * tree.leaf_value + (1.0 - decay_rate) * new_out
+                )
+                self._device_trees[mi] = (None, k)
+                self.scores = self.scores.at[k].add(
+                    jnp.asarray(tree.leaf_value[lp], jnp.float32)
+                )
 
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:415-431)."""

@@ -29,6 +29,10 @@ def _feature_infos(gbdt) -> List[str]:
                 infos[j] = ":".join(str(c) for c in m.bin_2_categorical)
             else:
                 infos[j] = "[%s:%s]" % (_short_float(m.min_val), _short_float(m.max_val))
+    elif getattr(gbdt, "feature_infos", None):
+        # loaded model: echo the loaded infos so save round-trips bitwise
+        loaded = gbdt.feature_infos
+        infos[: len(loaded)] = loaded
     return infos
 
 
@@ -79,11 +83,16 @@ def save_model_to_string(gbdt, start_iteration: int = 0, num_iteration: int = -1
     for cnt, name in pairs:
         body += "%s=%d\n" % (name, cnt)
     body += "\nparameters:\n"
-    cfg = gbdt.config
-    for k, v in cfg.to_dict().items():
-        if isinstance(v, list):
-            v = ",".join(str(x) for x in v)
-        body += "[%s: %s]\n" % (k, v)
+    if gbdt.train_set is None and getattr(gbdt, "loaded_parameter", ""):
+        # loaded model: echo the loaded parameter block
+        # (gbdt_model_text.cpp:328-331)
+        body += gbdt.loaded_parameter + "\n"
+    else:
+        cfg = gbdt.config
+        for k, v in cfg.to_dict().items():
+            if isinstance(v, list):
+                v = ",".join(str(x) for x in v)
+            body += "[%s: %s]\n" % (k, v)
     body += "end of parameters\n"
     return body
 
@@ -141,6 +150,16 @@ def load_model_from_string(text: str, gbdt_cls, config) -> "object":
     gbdt.models = trees
     gbdt._device_trees = [(None, idx % max(gbdt.num_tree_per_iteration, 1)) for idx in range(len(trees))]
     gbdt.iter_ = len(trees) // max(gbdt.num_tree_per_iteration, 1)
+
+    # capture the parameters block verbatim (loaded_parameter_,
+    # gbdt_model_text.cpp:496-508) so a loaded model saves it back unchanged
+    try:
+        rest = text[text.index("end of trees"):]
+        p0 = rest.index("parameters:")
+        p1 = rest.index("end of parameters")
+        gbdt.loaded_parameter = rest[p0 + len("parameters:"): p1].strip("\n")
+    except ValueError:
+        gbdt.loaded_parameter = ""
     return gbdt
 
 

@@ -1,0 +1,219 @@
+"""Refit (leaf-value re-estimation on new data) and if-else C++ codegen tests.
+
+Mirrors the reference's refit test (tests/python_package_test/test_engine.py:759)
+and the cpp_test codegen consistency check (tests/cpp_test/test.py, SURVEY.md §4:
+train -> convert_model_language=cpp -> compile -> predictions must match).
+"""
+import ctypes
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+BASE = {"verbosity": -1, "num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 5}
+
+
+def make_binary(n=1200, f=8, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = 2 * X[:, 0] + X[:, 1] - 0.5 * X[:, 2] + 0.3 * rng.randn(n)
+    return X, (logit > 0).astype(np.float64)
+
+
+class TestRefit:
+    def test_refit_changes_leaves_keeps_structure(self):
+        X, y = make_binary()
+        bst = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y), 20)
+        err_before = np.mean((bst.predict(X) > 0.5) != y)
+        # refit on flipped labels: structure identical, leaf values move
+        new = bst.refit(X, 1 - y, decay_rate=0.5)
+        assert new.num_trees() == bst.num_trees()
+        t_old = bst._gbdt.trees()[0]
+        t_new = new._gbdt.trees()[0]
+        np.testing.assert_array_equal(t_old.split_feature, t_new.split_feature)
+        np.testing.assert_array_equal(t_old.threshold, t_new.threshold)
+        assert not np.allclose(t_old.leaf_value, t_new.leaf_value)
+        # refit toward flipped labels must increase error on the original labels
+        err_after = np.mean((new.predict(X) > 0.5) != y)
+        assert err_after > err_before
+
+    def test_refit_same_data_decay1_is_identity(self):
+        X, y = make_binary(seed=4)
+        bst = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y), 10)
+        new = bst.refit(X, y, decay_rate=1.0)
+        np.testing.assert_allclose(new.predict(X), bst.predict(X), rtol=1e-12)
+
+    def test_refit_multiclass(self):
+        rng = np.random.RandomState(5)
+        X = rng.randn(900, 6)
+        y = (X[:, 0] + 0.3 * rng.randn(900) > 0).astype(int) + (
+            X[:, 1] > 0.5
+        ).astype(int)
+        params = dict(BASE, objective="multiclass", num_class=3)
+        bst = lgb.train(params, lgb.Dataset(X, label=y), 10)
+        new = bst.refit(X, y, decay_rate=0.9)
+        assert new.num_trees() == bst.num_trees()
+        acc = np.mean(np.argmax(new.predict(X), axis=1) == y)
+        assert acc > 0.8
+
+    def test_refit_cli_task(self, tmp_path):
+        X, y = make_binary(seed=6)
+        data = np.column_stack([y, X])
+        train_file = tmp_path / "refit.train"
+        np.savetxt(train_file, data, delimiter="\t")
+        model_file = tmp_path / "model.txt"
+        bst = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y), 10)
+        bst.save_model(str(model_file))
+        out_file = tmp_path / "model.refit.txt"
+        from lightgbm_tpu.cli import main
+
+        main([
+            "task=refit",
+            "data=%s" % train_file,
+            "input_model=%s" % model_file,
+            "output_model=%s" % out_file,
+            "verbosity=-1",
+        ])
+        assert out_file.exists()
+        refitted = lgb.Booster(model_file=str(out_file))
+        assert refitted.num_trees() == bst.num_trees()
+
+    def test_refit_loaded_model_keeps_objective(self, tmp_path):
+        """A loaded model refits under its own objective/num_class even when
+        params omit them (the reference CHECKs this; we inherit)."""
+        rng = np.random.RandomState(11)
+        X = rng.randn(600, 5)
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+        bst = lgb.train(
+            dict(BASE, objective="multiclass", num_class=3),
+            lgb.Dataset(X, label=y),
+            8,
+        )
+        model_file = tmp_path / "mc.txt"
+        bst.save_model(str(model_file))
+        loaded = lgb.Booster(model_file=str(model_file))  # no params at all
+        new = loaded.refit(X, y)
+        assert new._gbdt.num_tree_per_iteration == 3
+        assert new.num_trees() == bst.num_trees()
+        assert new.predict(X[:4]).shape == (4, 3)
+        # and the refitted model round-trips with the right header
+        out2 = tmp_path / "mc.refit.txt"
+        new.save_model(str(out2))
+        again = lgb.Booster(model_file=str(out2))
+        assert again.predict(X[:4]).shape == (4, 3)
+
+
+class TestIfElseCodegen:
+    def _compile(self, code: str, tmpdir: str) -> str:
+        src = os.path.join(tmpdir, "model.cpp")
+        lib = os.path.join(tmpdir, "model.so")
+        wrapper = (
+            '\nextern "C" {\n'
+            "void predict(const double* f, double* o) { lightgbm_tpu_model::Predict(f, o); }\n"
+            "void predict_raw(const double* f, double* o) { lightgbm_tpu_model::PredictRaw(f, o); }\n"
+            "void predict_leaf(const double* f, double* o) { lightgbm_tpu_model::PredictLeafIndex(f, o); }\n"
+            "}\n"
+        )
+        with open(src, "w") as fh:
+            fh.write(code + wrapper)
+        subprocess.check_call(
+            ["g++", "-O1", "-shared", "-fPIC", "-o", lib, src]
+        )
+        return lib
+
+    @pytest.mark.parametrize("objective", ["binary", "regression"])
+    def test_codegen_matches_python(self, objective):
+        X, y = make_binary(n=600)
+        if objective == "regression":
+            y = X[:, 0] * 2 + np.abs(X[:, 1])
+        # include NaNs to exercise missing paths
+        Xm = X.copy()
+        Xm[::7, 0] = np.nan
+        bst = lgb.train(
+            dict(BASE, objective=objective, use_missing=True),
+            lgb.Dataset(Xm, label=y),
+            8,
+        )
+        from lightgbm_tpu.models.model_codegen import save_model_to_ifelse
+
+        code = save_model_to_ifelse(bst._gbdt)
+        with tempfile.TemporaryDirectory() as td:
+            lib = ctypes.CDLL(self._compile(code, td))
+            n = 64
+            Xq = Xm[:n]
+            got = np.zeros(n)
+            got_raw = np.zeros(n)
+            leaves = np.zeros((n, bst.num_trees()))
+            for i in range(n):
+                row = np.ascontiguousarray(Xq[i], dtype=np.float64)
+                out = np.zeros(1)
+                lib.predict(
+                    row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                )
+                got[i] = out[0]
+                lib.predict_raw(
+                    row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                )
+                got_raw[i] = out[0]
+                lrow = np.zeros(bst.num_trees())
+                lib.predict_leaf(
+                    row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    lrow.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                )
+                leaves[i] = lrow
+            np.testing.assert_array_almost_equal(got, bst.predict(Xq), decimal=5)
+            np.testing.assert_array_almost_equal(
+                got_raw, bst.predict(Xq, raw_score=True), decimal=5
+            )
+            np.testing.assert_array_equal(
+                leaves.astype(np.int32), bst.predict(Xq, pred_leaf=True)
+            )
+
+    def test_codegen_multiclass_softmax(self):
+        rng = np.random.RandomState(9)
+        X = rng.randn(500, 5)
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.6).astype(int)
+        bst = lgb.train(
+            dict(BASE, objective="multiclass", num_class=3),
+            lgb.Dataset(X, label=y),
+            5,
+        )
+        from lightgbm_tpu.models.model_codegen import save_model_to_ifelse
+
+        code = save_model_to_ifelse(bst._gbdt)
+        with tempfile.TemporaryDirectory() as td:
+            lib = ctypes.CDLL(self._compile(code, td))
+            n = 32
+            got = np.zeros((n, 3))
+            for i in range(n):
+                row = np.ascontiguousarray(X[i], dtype=np.float64)
+                out = np.zeros(3)
+                lib.predict(
+                    row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                )
+                got[i] = out
+            np.testing.assert_array_almost_equal(got, bst.predict(X[:n]), decimal=5)
+
+    def test_convert_model_cli(self, tmp_path):
+        X, y = make_binary(n=400, seed=8)
+        bst = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y), 5)
+        model_file = tmp_path / "model.txt"
+        bst.save_model(str(model_file))
+        out_cpp = tmp_path / "pred.cpp"
+        from lightgbm_tpu.cli import main
+
+        main([
+            "task=convert_model",
+            "input_model=%s" % model_file,
+            "convert_model=%s" % out_cpp,
+            "verbosity=-1",
+        ])
+        text = out_cpp.read_text()
+        assert "PredictTree0" in text and "void Predict(" in text
