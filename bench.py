@@ -46,11 +46,42 @@ def make_higgs_like(n: int, f: int, seed: int = 7):
     return X, y
 
 
+def _watchdog(limit_s: float) -> None:
+    """Emit a failure JSON line and hard-exit if the bench stalls (e.g. the TPU
+    tunnel hangs at backend init) — the driver must always get its one line."""
+    import os
+    import sys
+    import threading
+
+    def fire():
+        print(
+            json.dumps(
+                {
+                    "metric": "higgs1m_boost_iters_per_sec",
+                    "value": 0.0,
+                    "unit": "iters/s (binary, 1M x 28, 255 leaves, 255 bins)",
+                    "vs_baseline": 0.0,
+                }
+            ),
+            flush=True,
+        )
+        print("bench watchdog fired after %.0fs - backend hang?" % limit_s, file=sys.stderr)
+        os._exit(2)
+
+    t = threading.Timer(limit_s, fire)
+    t.daemon = True
+    t.start()
+
+
 def main() -> None:
+    import sys
+
+    _watchdog(float(__import__("os").environ.get("BENCH_TIMEOUT_S", 2400)))
     import lightgbm_tpu as lgb
     from lightgbm_tpu.metric import AUCMetric
 
     X, y = make_higgs_like(N_ROWS, N_FEATURES)
+    print("bench: data ready", file=sys.stderr, flush=True)
 
     params = {
         "objective": "binary",
@@ -64,12 +95,14 @@ def main() -> None:
     ds = lgb.Dataset(X, label=y)
     booster = lgb.Booster(params=params, train_set=ds)
     bin_time = time.time() - t0
+    print("bench: binned in %.1fs" % bin_time, file=sys.stderr, flush=True)
 
     # warmup (jit compile)
     t0 = time.time()
     for _ in range(WARMUP_ITERS):
         booster.update()
     warmup_time = time.time() - t0
+    print("bench: warmed up in %.1fs" % warmup_time, file=sys.stderr, flush=True)
 
     t0 = time.time()
     for _ in range(BENCH_ITERS):

@@ -18,6 +18,13 @@ from .engine import CVBooster, cv, train
 from .utils.log import LightGBMError
 
 try:
+    from .plotting import create_tree_digraph, plot_importance, plot_metric, plot_tree
+
+    _PLOT = ["plot_importance", "plot_metric", "plot_tree", "create_tree_digraph"]
+except ImportError:  # pragma: no cover - matplotlib/graphviz not installed
+    _PLOT = []
+
+try:
     from .sklearn import LGBMClassifier, LGBMModel, LGBMRanker, LGBMRegressor
 
     _SKLEARN = ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
@@ -38,4 +45,4 @@ __all__ = [
     "print_evaluation",
     "record_evaluation",
     "reset_parameter",
-] + _SKLEARN
+] + _SKLEARN + _PLOT

@@ -73,6 +73,35 @@ class Dataset:
             return self
         if config is None:
             config = Config.from_params(self.params)
+        if isinstance(self.data, str):
+            # file path: binary fast path (LoadFromBinFile) or text load
+            from .dataset import is_binary_dataset_file, load_binary_dataset
+
+            if is_binary_dataset_file(self.data):
+                self._binned = load_binary_dataset(self.data)
+                if self.label is not None:
+                    self._binned.metadata.label = np.asarray(
+                        self.label, np.float32
+                    ).reshape(-1)
+                self._config = config
+                return self
+            from .io import load_sidecar, load_text_file
+
+            X, y, names = load_text_file(
+                self.data, has_header=config.header, label_column=config.label_column
+            )
+            if self.label is None and y is not None:
+                self.label = y
+            if self.weight is None:
+                self.weight = load_sidecar(self.data, "weight")
+            if self.group is None:
+                g = load_sidecar(self.data, "query")
+                self.group = None if g is None else g.astype(np.int64)
+            if self.init_score is None:
+                self.init_score = load_sidecar(self.data, "init")
+            if names and self.feature_name == "auto":
+                self.feature_name = names
+            self.data = X
         data = _to_2d_float(self.data)
         feature_names = None
         if isinstance(self.feature_name, (list, tuple)):
@@ -163,13 +192,28 @@ class Dataset:
     def get_init_score(self):
         return self.init_score
 
+    def save_binary(self, filename: str) -> "Dataset":
+        """Save the constructed (binned) dataset for fast reload
+        (Dataset.save_binary, basic.py:1517; LGBM_DatasetSaveBinary)."""
+        from .dataset import save_binary_dataset
+
+        self.construct()
+        save_binary_dataset(self._binned, filename)
+        return self
+
     def num_data(self) -> int:
         if self._binned is not None:
+            return self._binned.num_data
+        if isinstance(self.data, str):
+            self.construct()
             return self._binned.num_data
         return _to_2d_float(self.data).shape[0]
 
     def num_feature(self) -> int:
         if self._binned is not None:
+            return self._binned.num_total_features
+        if isinstance(self.data, str):
+            self.construct()
             return self._binned.num_total_features
         return _to_2d_float(self.data).shape[1]
 

@@ -67,36 +67,59 @@ def greedy_find_bin(
     mean_bin_size = total_cnt / max_bin
 
     # values with count >= mean get a dedicated bin
-    rest_bin_cnt = max_bin
-    rest_sample_cnt = total_cnt
+    counts = np.asarray(counts, dtype=np.int64)
     is_big = counts >= mean_bin_size
-    rest_bin_cnt -= int(is_big.sum())
-    rest_sample_cnt -= int(counts[is_big].sum())
+    rest_bin_cnt = max_bin - int(is_big.sum())
+    rest0 = total_cnt - int(counts[is_big].sum())
+    rest_sample_cnt = rest0
     mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+
+    # The reference walks every distinct value (bin.cpp:101-137); a bin closes at
+    # index i when is_big[i], the running count reaches mean_bin_size, or the
+    # next value is big and the count reached mean/2. Each close point is the
+    # minimum of three searchable candidates, so this walks per BIN instead.
+    csum = np.concatenate([[0], np.cumsum(counts)])  # csum[i] = counts[:i].sum()
+    csum_small = np.concatenate([[0], np.cumsum(counts * ~is_big)])
+    big_idx = np.nonzero(is_big)[0]
 
     upper_bounds = [_INF] * max_bin
     lower_bounds = [_INF] * max_bin
     bin_cnt = 0
     lower_bounds[0] = float(distinct_values[0])
-    cur_cnt_inbin = 0
-    for i in range(num_distinct - 1):
+    s = 0  # current bin's first distinct-value index
+    last_i = num_distinct - 2  # the loop never closes at the final value
+    while s <= last_i:
+        pos = np.searchsorted(big_idx, s)
+        b = int(big_idx[pos]) if pos < len(big_idx) else num_distinct
+        if b == s:
+            i = s
+        else:
+            # smallest i with counts[s..i].sum() >= mean_bin_size
+            i_mean = max(
+                int(np.searchsorted(csum, csum[s] + mean_bin_size, side="left")) - 1, s
+            )
+            cand = []
+            if i_mean <= last_i:
+                cand.append(i_mean)
+            if s <= b - 1 <= last_i and (
+                csum[b] - csum[s] >= max(1.0, mean_bin_size * 0.5)
+            ):
+                cand.append(b - 1)
+            if b <= last_i:
+                cand.append(b)
+            if not cand:
+                break  # tail accumulates into the final open bin
+            i = min(cand)
+        upper_bounds[bin_cnt] = float(distinct_values[i])
+        bin_cnt += 1
+        lower_bounds[bin_cnt] = float(distinct_values[i + 1])
+        if bin_cnt >= max_bin - 1:
+            break
         if not is_big[i]:
-            rest_sample_cnt -= int(counts[i])
-        cur_cnt_inbin += int(counts[i])
-        if (
-            is_big[i]
-            or cur_cnt_inbin >= mean_bin_size
-            or (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))
-        ):
-            upper_bounds[bin_cnt] = float(distinct_values[i])
-            bin_cnt += 1
-            lower_bounds[bin_cnt] = float(distinct_values[i + 1])
-            if bin_cnt >= max_bin - 1:
-                break
-            cur_cnt_inbin = 0
-            if not is_big[i]:
-                rest_bin_cnt -= 1
-                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+            rest_bin_cnt -= 1
+            rest_sample_cnt = rest0 - int(csum_small[i + 1])
+            mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+        s = i + 1
     bin_cnt += 1
     bin_upper_bound = []
     for i in range(bin_cnt - 1):
@@ -268,12 +291,14 @@ class BinMapper:
                 )
                 self.bin_upper_bound.append(float("nan"))
             self.num_bin = len(self.bin_upper_bound)
-            cnt_in_bin = [0] * self.num_bin
-            i_bin = 0
-            for i in range(num_distinct):
-                if distinct_values[i] > self.bin_upper_bound[i_bin]:
-                    i_bin += 1
-                cnt_in_bin[i_bin] += int(counts[i])
+            n_real = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+            ub = np.asarray(self.bin_upper_bound[:n_real], dtype=np.float64)
+            idx = np.minimum(
+                np.searchsorted(ub, distinct_values, side="left"), n_real - 1
+            )
+            cnt_in_bin = list(
+                np.bincount(idx, weights=counts, minlength=self.num_bin).astype(np.int64)
+            )
             if self.missing_type == MISSING_NAN:
                 cnt_in_bin[self.num_bin - 1] = na_cnt
             assert self.num_bin <= max_bin
@@ -284,14 +309,13 @@ class BinMapper:
             if neg.any():
                 na_cnt += int(counts[neg].sum())
                 log.warning("Met negative value in categorical features, will convert it to NaN")
-            dv_int: List[int] = []
-            cnt_int: List[int] = []
-            for v, c in zip(ints[~neg], counts[~neg]):
-                if dv_int and int(v) == dv_int[-1]:
-                    cnt_int[-1] += int(c)
-                else:
-                    dv_int.append(int(v))
-                    cnt_int.append(int(c))
+            keep_i = ints[~neg]
+            keep_c = counts[~neg]
+            # distinct floats can truncate to the same int; merge (sorted already)
+            uniq, inv = np.unique(keep_i, return_inverse=True)
+            merged_c = np.bincount(inv, weights=keep_c, minlength=len(uniq)).astype(np.int64)
+            dv_int: List[int] = [int(v) for v in uniq]
+            cnt_int: List[int] = [int(c) for c in merged_c]
             self.num_bin = 0
             rest_cnt = total_sample_cnt - na_cnt
             if rest_cnt > 0:
@@ -348,34 +372,40 @@ class BinMapper:
     def _distinct_with_zero(values: np.ndarray, zero_cnt: int) -> Tuple[np.ndarray, np.ndarray]:
         """Sorted distinct values with the zero bucket inserted (bin.cpp:238-270).
 
-        Near-equal doubles (within one ulp, ordered) are merged keeping the larger
-        value, like the reference's CheckDoubleEqualOrdered merge loop.
+        Near-equal doubles (within one ulp, ordered) merge keeping the larger
+        value, like the reference's CheckDoubleEqualOrdered merge loop —
+        vectorized: within-ulp runs become groups via a cumulative break mask.
         """
-        values = np.sort(values, kind="stable")
-        distinct: List[float] = []
-        counts: List[int] = []
+        values = np.sort(np.asarray(values, dtype=np.float64), kind="stable")
         n = len(values)
-        if n == 0 or (values[0] > 0.0 and zero_cnt > 0):
-            distinct.append(0.0)
-            counts.append(zero_cnt)
-        if n > 0:
-            distinct.append(float(values[0]))
-            counts.append(1)
-        for i in range(1, n):
-            prev, cur = float(values[i - 1]), float(values[i])
-            if not _double_equal_ordered(prev, cur):
-                if prev < 0.0 and cur > 0.0:
-                    distinct.append(0.0)
-                    counts.append(zero_cnt)
-                distinct.append(cur)
-                counts.append(1)
-            else:
-                distinct[-1] = cur
-                counts[-1] += 1
-        if n > 0 and values[n - 1] < 0.0 and zero_cnt > 0:
-            distinct.append(0.0)
-            counts.append(zero_cnt)
-        return np.asarray(distinct, dtype=np.float64), np.asarray(counts, dtype=np.int64)
+        if n == 0:
+            return np.asarray([0.0]), np.asarray([zero_cnt], dtype=np.int64)
+        if n == 1:
+            distinct = values
+            counts = np.asarray([1], dtype=np.int64)
+        else:
+            # group i+1 merges into i when values[i+1] <= nextafter(values[i], inf)
+            merged = values[1:] <= np.nextafter(values[:-1], np.inf)
+            breaks = np.nonzero(~merged)[0]  # values[b+1] starts a new group
+            starts = np.concatenate([[0], breaks + 1])
+            ends = np.concatenate([breaks, [n - 1]])
+            distinct = values[ends]  # larger (last) value of each run wins
+            counts = (ends - starts + 1).astype(np.int64)
+        # zero-bucket insertion (values exclude zeros by the caller's contract)
+        if distinct[0] > 0.0 and zero_cnt > 0:
+            distinct = np.concatenate([[0.0], distinct])
+            counts = np.concatenate([[zero_cnt], counts])
+        elif distinct[-1] < 0.0:
+            if zero_cnt > 0:
+                distinct = np.concatenate([distinct, [0.0]])
+                counts = np.concatenate([counts, [zero_cnt]])
+        else:
+            sign_change = np.nonzero((distinct[:-1] < 0.0) & (distinct[1:] > 0.0))[0]
+            if len(sign_change):
+                j = int(sign_change[0]) + 1
+                distinct = np.concatenate([distinct[:j], [0.0], distinct[j:]])
+                counts = np.concatenate([counts[:j], [zero_cnt], counts[j:]])
+        return distinct, counts
 
     # -- mapping --------------------------------------------------------
 

@@ -34,6 +34,12 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
 
 
 def _load_dataset(path: str, config: Config, reference: Optional[Dataset] = None) -> Dataset:
+    from .dataset import is_binary_dataset_file
+
+    if is_binary_dataset_file(path):
+        # binary fast path (LoadFromBinFile, dataset_loader.cpp:268)
+        log.info("Loading binned dataset from binary file %s" % path)
+        return Dataset(path, reference=reference, params={})
     # valid files must come out as wide as the train set (sparse libsvm rows
     # may never reach the highest train feature index)
     ref_width = reference.num_feature() if reference is not None else None
@@ -64,6 +70,10 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         log.fatal("No training data specified (data=...)")
     log.info("Loading train data from %s" % config.data)
     train_set = _load_dataset(config.data, config)
+    if config.save_binary:
+        train_set.params.update(params)
+        train_set.save_binary(config.data + ".bin")
+        log.info("Saved binned dataset to %s.bin" % config.data)
     valid_sets = []
     valid_names = []
     for i, v in enumerate(config.valid):

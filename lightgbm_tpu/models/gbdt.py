@@ -29,7 +29,7 @@ from ..metric import Metric
 from ..objective import ObjectiveFunction
 from ..ops.grow import grow_tree
 from ..ops.predict import PredictTree, make_predict_tree, tree_predict_value
-from ..ops.split import SplitParams
+from ..ops.split import CegbParams, SplitParams
 from ..utils import log
 from .tree import Tree
 
@@ -124,6 +124,82 @@ class GBDT:
         self._is_constant_hessian = (
             self.objective.is_constant_hessian if self.objective is not None else False
         )
+        self._setup_cegb(train_set)
+        self._forced_splits = self._parse_forced_splits(train_set)
+
+    def _setup_cegb(self, train_set: BinnedDataset) -> None:
+        """CEGB penalty vectors mapped onto used features (config.h:389-405)."""
+        cfg = self.config
+        F = train_set.num_features
+        coupled = list(cfg.cegb_penalty_feature_coupled or [])
+        lazy = list(cfg.cegb_penalty_feature_lazy or [])
+        for name, vec in (("coupled", coupled), ("lazy", lazy)):
+            if vec and len(vec) != train_set.num_total_features:
+                log.fatal(
+                    "cegb_penalty_feature_%s has %d entries but the data has %d "
+                    "total features" % (name, len(vec), train_set.num_total_features)
+                )
+        self.cegb_params = CegbParams(
+            tradeoff=cfg.cegb_tradeoff,
+            penalty_split=cfg.cegb_penalty_split,
+            has_coupled=bool(coupled),
+            has_lazy=bool(lazy),
+        )
+        if coupled:
+            arr = np.array([coupled[j] for j in train_set.used_feature_idx], np.float32)
+            self.feature_meta["cegb_coupled"] = jnp.asarray(arr)
+        if lazy:
+            arr = np.array([lazy[j] for j in train_set.used_feature_idx], np.float32)
+            self.feature_meta["cegb_lazy"] = jnp.asarray(arr)
+        if self.cegb_params.enabled:
+            # per-TRAINING acquisition state (serial_tree_learner.cpp:107-115):
+            # features/rows already paid for stay paid across trees
+            self._cegb_state = (
+                jnp.zeros((F,), bool),
+                jnp.zeros((F, self.num_data) if self.cegb_params.has_lazy else (1, 1), bool),
+            )
+        else:
+            self._cegb_state = None
+
+    def _parse_forced_splits(self, train_set: BinnedDataset) -> tuple:
+        """forcedsplits_filename JSON -> static BFS tuple of
+        (leaf_idx, used_feature_idx, threshold_bin) (ForceSplits,
+        serial_tree_learner.cpp:597: left child keeps the leaf index, right
+        child takes the next one, exactly the grower's numbering)."""
+        fname = self.config.forcedsplits_filename
+        if not fname:
+            return ()
+        import json as _json
+
+        with open(fname) as fh:
+            root = _json.load(fh)
+        if not root:
+            return ()
+        feat_to_used = {j: i for i, j in enumerate(train_set.used_feature_idx)}
+        out = []
+        queue = [(root, 0)]
+        next_leaf = 1
+        while queue:
+            node, leaf = queue.pop(0)
+            f_orig = int(node["feature"])
+            thr = float(node["threshold"])
+            if f_orig not in feat_to_used:
+                log.warning(
+                    "Forced split on trivial/unknown feature %d ignored "
+                    "(and the rest of its subtree)" % f_orig
+                )
+                continue
+            f_used = feat_to_used[f_orig]
+            mapper = train_set.mappers[f_used]
+            thr_bin = int(mapper.value_to_bin(thr))
+            out.append((leaf, f_used, thr_bin))
+            right_leaf = next_leaf
+            next_leaf += 1
+            if isinstance(node.get("left"), dict):
+                queue.append((node["left"], leaf))
+            if isinstance(node.get("right"), dict):
+                queue.append((node["right"], right_leaf))
+        return tuple(out)
 
     def add_valid(self, valid_set: BinnedDataset, metrics: List[Metric], name: str) -> None:
         for m in metrics:
@@ -300,35 +376,73 @@ class GBDT:
             params=self.split_params,
             chunk=cfg.tpu_hist_chunk,
         )
+        cegb_on = self.cegb_params.enabled
         if learner == "serial":
-            return grow_tree(
+            out = grow_tree(
                 self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
-                self.feature_meta, **common,
+                self.feature_meta, forced_splits=self._forced_splits,
+                cegb=self.cegb_params, cegb_state=self._cegb_state, **common,
             )
+            if cegb_on:
+                tree, leaf_id, self._cegb_state = out
+                return tree, leaf_id
+            return out
         mesh = self._mesh()
         if learner == "feature":
             from ..parallel.feature_parallel import grow_tree_feature_parallel
 
-            return grow_tree_feature_parallel(
+            out = grow_tree_feature_parallel(
                 mesh, self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
-                self.feature_meta, **common,
+                self.feature_meta, forced_splits=self._forced_splits,
+                cegb=self.cegb_params, cegb_state=self._cegb_state, **common,
             )
+            if cegb_on:
+                tree, leaf_id, self._cegb_state = out
+                return tree, leaf_id
+            return out
         from ..parallel.data_parallel import grow_tree_data_parallel
         from ..parallel.voting_parallel import grow_tree_voting_parallel
 
         bins_s, grad_s, hess_s, bag_s = self._shard_rows(grad_k, hess_k)
         if learner == "voting":
+            if cegb_on:
+                log.fatal(
+                    "CEGB penalties are not supported with tree_learner=voting "
+                    "(the top-k vote bypasses the penalized full scan)"
+                )
             tree, leaf_id = grow_tree_voting_parallel(
                 mesh, bins_s, grad_s, hess_s, bag_s, fmask, self.feature_meta,
-                top_k=cfg.top_k, **common,
+                top_k=cfg.top_k, forced_splits=self._forced_splits, **common,
             )
         else:
-            tree, leaf_id = grow_tree_data_parallel(
+            out = grow_tree_data_parallel(
                 mesh, bins_s, grad_s, hess_s, bag_s, fmask, self.feature_meta,
-                **common,
+                forced_splits=self._forced_splits, cegb=self.cegb_params,
+                cegb_state=self._cegb_state_sharded(mesh), **common,
             )
+            if cegb_on:
+                tree, leaf_id, st = out
+                self._cegb_state = st
+            else:
+                tree, leaf_id = out
         # drop shard-padding rows so score updates stay [N]-shaped
         return tree, leaf_id[: self.num_data]
+
+    def _cegb_state_sharded(self, mesh):
+        """Row-shard the lazy used_in_data to match the sharded bins."""
+        if self._cegb_state is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        fu, uid = self._cegb_state
+        if self.cegb_params.has_lazy:
+            n_sh = mesh.shape["data"]
+            pad = (-self.num_data) % n_sh
+            if uid.shape[1] == self.num_data and pad:
+                uid = jnp.pad(uid, ((0, 0), (0, pad)))
+            uid = jax.device_put(uid, NamedSharding(mesh, P(None, "data")))
+        fu = jax.device_put(fu, NamedSharding(mesh, P()))
+        return (fu, uid)
 
     def _learner_kind(self) -> str:
         """tree_learner dispatch (TreeLearner::CreateTreeLearner,

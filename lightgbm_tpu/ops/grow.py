@@ -13,6 +13,14 @@ Differences from the reference are architectural, not semantic:
    feature_histogram.hpp:75 Subtract) is kept: per split, one masked histogram pass
    over the smaller child; the larger child's histogram is parent minus smaller.
  * Monotone-constraint windows per leaf mirror serial_tree_learner.cpp:841-850.
+ * Forced splits (ForceSplits, serial_tree_learner.cpp:597-757) are a statically
+   unrolled preamble: the JSON's BFS order fixes each forced split's leaf index at
+   trace time; each applies under ``lax.cond`` with the reference's
+   abort-on-worsening-gain semantics.
+ * CEGB (cost-effective gradient boosting) penalties re-rank candidate splits; with
+   coupled/lazy feature penalties the grower re-scans every leaf per iteration
+   (the reference instead patches its cached splits_per_leaf_,
+   serial_tree_learner.cpp:757-775 — same fixpoint, different mechanics).
  * With ``axis_name`` set (under shard_map), rows are sharded across the mesh and
    the histogram/root sums are combined with psum — the data-parallel learner's
    dataflow (data_parallel_tree_learner.cpp:149-257) collapsed onto XLA collectives.
@@ -23,7 +31,7 @@ converts thresholds to real values with the BinMappers for prediction on raw dat
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple, Optional
+from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -32,10 +40,12 @@ from .histogram import leaf_histogram, leaf_values
 from .split import (
     MISSING_NAN,
     MISSING_ZERO,
+    CegbParams,
     SplitParams,
     SplitResult,
     calculate_leaf_output,
     find_best_split,
+    gather_info_for_threshold,
 )
 
 
@@ -69,6 +79,9 @@ class GrowState(NamedTuple):
     min_con: jax.Array  # [M] monotone windows
     max_con: jax.Array
     hist: jax.Array  # [M, F, B, 3]
+    feature_used: jax.Array  # [F] bool (CEGB coupled bookkeeping)
+    unused_cnt: jax.Array  # [M, F] rows-not-yet-charged counts (CEGB lazy)
+    used_in_data: jax.Array  # [F, N] bool when lazy CEGB else [1, 1] dummy
 
 
 def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat):
@@ -87,7 +100,7 @@ def _decision_go_left(col, threshold, default_left, missing_type, default_bin, n
     jax.jit,
     static_argnames=(
         "num_leaves", "max_depth", "num_bins", "params", "chunk", "axis_name",
-        "split_fn", "psum_hist",
+        "split_fn", "psum_hist", "forced_splits", "cegb",
     ),
 )
 def grow_tree(
@@ -105,6 +118,9 @@ def grow_tree(
     axis_name: Optional[str] = None,
     split_fn=None,
     psum_hist: bool = True,
+    forced_splits: Tuple = (),
+    cegb: CegbParams = CegbParams(),
+    cegb_state: Optional[Tuple[jax.Array, jax.Array]] = None,
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [N]).
 
@@ -115,6 +131,15 @@ def grow_tree(
     set and ``psum_hist=False``, per-leaf histograms stay shard-local (only
     root totals are psum'd); the split_fn is then responsible for combining
     shard histograms.
+
+    ``forced_splits``: BFS-ordered static tuple of (leaf_idx, used_feature_idx,
+    threshold_bin) applied before best-gain growth (ForceSplits).
+    ``cegb``: static CegbParams; per-feature penalty vectors ride in
+    ``feature_meta["cegb_coupled"/"cegb_lazy"]``. ``cegb_state`` is the
+    (feature_used [F] bool, used_in_data [F, N] bool) pair carried across trees
+    — the reference initializes these once per *training*, not per tree
+    (serial_tree_learner.cpp:107-115), so acquisition penalties amortize. When
+    ``cegb.enabled`` the return is (tree, leaf_id, new_cegb_state).
     """
     F, N = bins.shape
     M = num_leaves
@@ -124,6 +149,15 @@ def grow_tree(
     if split_fn is None:
         split_fn = find_best_split
     hist_axis = axis_name if psum_hist else None
+    cegb_on = cegb.enabled
+    if cegb_on and split_fn is not find_best_split:
+        raise NotImplementedError(
+            "CEGB penalties are only supported with the serial/data-parallel "
+            "split search (the reference implements them in SerialTreeLearner)"
+        )
+
+    coupled_arr = feature_meta.get("cegb_coupled")
+    lazy_arr = feature_meta.get("cegb_lazy")
 
     def split2(hist2, sg2, sh2, nd2, mn2, mx2):
         """Best splits for the two children (unrolled: split_fn may contain
@@ -142,6 +176,44 @@ def grow_tree(
     def masked_values(mask_f32):
         return leaf_values(grad, hess, mask_f32 * bag_mask)
 
+    neg_inf = jnp.float32(-jnp.inf)
+
+    def depth_gate(gain, depth):
+        if max_depth > 0:
+            return jnp.where(depth >= max_depth, neg_inf, gain)
+        return gain
+
+    # ---- CEGB penalty machinery -----------------------------------------
+    def leaf_penalties(lnd_all, feature_used, unused_cnt):
+        """[M, F] gain penalties (serial_tree_learner.cpp:537-543,568-573)."""
+        pen = cegb.tradeoff * cegb.penalty_split * lnd_all[:, None]
+        pen = jnp.broadcast_to(pen, (M, F)).astype(f32)
+        if cegb.has_coupled:
+            pen = pen + cegb.tradeoff * coupled_arr[None, :] * (
+                ~feature_used
+            )[None, :].astype(f32)
+        if cegb.has_lazy:
+            pen = pen + cegb.tradeoff * lazy_arr[None, :] * unused_cnt
+        return pen
+
+    def rescan_all(tree, hist, lsg, lsh, lnd, mn, mx, feature_used, unused_cnt):
+        """Re-rank every leaf's best split under current CEGB penalties.
+
+        The reference keeps splits_per_leaf_ cached and patches gains when a
+        coupled feature first gets used (Split, serial_tree_learner.cpp:757-775);
+        re-scanning from the (resident) histograms reaches the same fixpoint.
+        """
+        pen = leaf_penalties(lnd, feature_used, unused_cnt)
+        res = jax.vmap(
+            lambda h, sg, sh, nd, mn1, mx1, pr: find_best_split(
+                h, sg, sh, nd, mn1, mx1, feature_meta, feature_mask, params, pr
+            )
+        )(hist, lsg, lsh, lnd, mn, mx, pen)
+        exists = jnp.arange(M, dtype=jnp.int32) < tree.num_leaves
+        gain = jnp.where(exists, res.gain, neg_inf)
+        gain = depth_gate(gain, tree.leaf_depth)
+        return res._replace(gain=gain)
+
     # ---- root ----------------------------------------------------------
     root_vals = masked_values(jnp.ones((N,), f32))
     root_hist = leaf_histogram(bins, root_vals, B, chunk=chunk, axis_name=hist_axis)
@@ -156,21 +228,21 @@ def grow_tree(
         root_h = jax.lax.psum(root_h, axis_name)
         root_n = jax.lax.psum(root_n, axis_name)
 
-    neg_inf = jnp.float32(-jnp.inf)
     no_con_min = jnp.full((M,), -jnp.inf, f32)
     no_con_max = jnp.full((M,), jnp.inf, f32)
 
-    root_split = split_fn(
-        root_hist,
-        root_g,
-        root_h,
-        root_n,
-        no_con_min[0],
-        no_con_max[0],
-        feature_meta,
-        feature_mask,
-        params,
-    )
+    if cegb_state is not None:
+        feature_used0, used_in_data0 = cegb_state
+    else:
+        feature_used0 = jnp.zeros((F,), bool)
+        used_in_data0 = jnp.zeros((F, N) if cegb.has_lazy else (1, 1), bool)
+    if cegb.has_lazy:
+        root_unused = (~used_in_data0).astype(f32) @ bag_mask  # [F]
+        if axis_name is not None:
+            root_unused = jax.lax.psum(root_unused, axis_name)
+        unused0 = jnp.zeros((M, F), f32).at[0].set(root_unused)
+    else:
+        unused0 = jnp.zeros((M, F), f32)
 
     def expand(res: SplitResult, idx: int) -> SplitResult:
         """Scatter a single-leaf SplitResult into [M]-sized per-leaf arrays."""
@@ -185,8 +257,6 @@ def grow_tree(
 
     def _field_init(name):
         return -jnp.inf if name == "gain" else 0
-
-    best0 = expand(root_split, 0)
 
     tree0 = TreeArrays(
         num_leaves=jnp.int32(1),
@@ -209,6 +279,23 @@ def grow_tree(
 
     hist0 = jnp.zeros((M, F, B, 3), f32).at[0].set(root_hist)
 
+    if cegb_on:
+        root_best = rescan_all(
+            tree0, hist0,
+            jnp.zeros((M,), f32).at[0].set(root_g),
+            jnp.zeros((M,), f32).at[0].set(root_h),
+            jnp.zeros((M,), f32).at[0].set(root_n),
+            no_con_min, no_con_max, feature_used0, unused0,
+        )
+        best0 = root_best
+    else:
+        root_split = split_fn(
+            root_hist, root_g, root_h, root_n,
+            no_con_min[0], no_con_max[0],
+            feature_meta, feature_mask, params,
+        )
+        best0 = expand(root_split, 0)
+
     state0 = GrowState(
         it=jnp.int32(0),
         leaf_id=jnp.zeros((N,), jnp.int32),
@@ -220,6 +307,9 @@ def grow_tree(
         min_con=no_con_min,
         max_con=no_con_max,
         hist=hist0,
+        feature_used=feature_used0,
+        unused_cnt=unused0,
+        used_in_data=used_in_data0,
     )
 
     num_bin_arr = feature_meta["num_bin"].astype(jnp.int32)
@@ -232,17 +322,9 @@ def grow_tree(
     else:
         is_cat_arr = is_cat_arr.astype(bool)
 
-    def depth_gate(gain, depth):
-        if max_depth > 0:
-            return jnp.where(depth >= max_depth, neg_inf, gain)
-        return gain
-
-    def cond(s: GrowState):
-        return (s.it < M - 1) & (jnp.max(s.best.gain) > 0.0)
-
-    def body(s: GrowState) -> GrowState:
-        best_leaf = jnp.argmax(s.best.gain).astype(jnp.int32)
-        rec = SplitResult(*[getattr(s.best, n)[best_leaf] for n in SplitResult._fields])
+    def apply_split(s: GrowState, best_leaf, rec: SplitResult) -> GrowState:
+        """Apply one split of ``best_leaf`` by ``rec`` (Split,
+        serial_tree_learner.cpp:757-851 + the next iteration's FindBestSplits)."""
         node = s.it
         new_leaf = s.tree.num_leaves
 
@@ -328,6 +410,27 @@ def grow_tree(
         min_con = s.min_con.at[best_leaf].set(l_min).at[new_leaf].set(r_min)
         max_con = s.max_con.at[best_leaf].set(l_max).at[new_leaf].set(r_max)
 
+        # ---- CEGB bookkeeping --------------------------------------------
+        feature_used = s.feature_used
+        used_in_data = s.used_in_data
+        unused_cnt = s.unused_cnt
+        if cegb.has_coupled:
+            feature_used = feature_used.at[f].set(True)
+        if cegb.has_lazy:
+            # rows of the split leaf have now paid for feature f
+            used_in_data = used_in_data.at[f].set(used_in_data[f] | in_leaf)
+            not_used = (~used_in_data).astype(f32)  # [F, N]
+            lmask = (bag_mask * (leaf_id == best_leaf)).astype(f32)
+            rmask = (bag_mask * (leaf_id == new_leaf)).astype(f32)
+            left_unused = not_used @ lmask
+            right_unused = not_used @ rmask
+            if axis_name is not None:
+                left_unused = jax.lax.psum(left_unused, axis_name)
+                right_unused = jax.lax.psum(right_unused, axis_name)
+            unused_cnt = unused_cnt.at[best_leaf].set(left_unused).at[new_leaf].set(
+                right_unused
+            )
+
         # ---- histograms: smaller child pass + subtraction ----------------
         left_smaller = rec.left_count <= rec.right_count
         small_idx = jnp.where(left_smaller, best_leaf, new_leaf)
@@ -340,29 +443,34 @@ def grow_tree(
         large_hist = parent_hist - small_hist
         hist = s.hist.at[small_idx].set(small_hist).at[large_idx].set(large_hist)
 
-        # ---- children best splits ----------------------------------------
-        child_idx = jnp.stack([best_leaf, new_leaf])
-        ch_hist = hist[child_idx]
-        ch_sg = lsg[child_idx]
-        ch_sh = lsh[child_idx]
-        ch_nd = lnd[child_idx]
-        ch_min = min_con[child_idx]
-        ch_max = max_con[child_idx]
-        ch_split = split2(ch_hist, ch_sg, ch_sh, ch_nd, ch_min, ch_max)
-        ch_gain = depth_gate(ch_split.gain, depth_child)
+        # ---- next-round candidate refresh --------------------------------
+        if cegb_on:
+            best = rescan_all(
+                tree, hist, lsg, lsh, lnd, min_con, max_con, feature_used, unused_cnt
+            )
+        else:
+            child_idx = jnp.stack([best_leaf, new_leaf])
+            ch_hist = hist[child_idx]
+            ch_sg = lsg[child_idx]
+            ch_sh = lsh[child_idx]
+            ch_nd = lnd[child_idx]
+            ch_min = min_con[child_idx]
+            ch_max = max_con[child_idx]
+            ch_split = split2(ch_hist, ch_sg, ch_sh, ch_nd, ch_min, ch_max)
+            ch_gain = depth_gate(ch_split.gain, depth_child)
 
-        def upd(field_arr, child_vals):
-            return field_arr.at[best_leaf].set(child_vals[0]).at[new_leaf].set(child_vals[1])
+            def upd(field_arr, child_vals):
+                return field_arr.at[best_leaf].set(child_vals[0]).at[new_leaf].set(child_vals[1])
 
-        best = SplitResult(
-            *[
-                upd(
-                    getattr(s.best, n),
-                    ch_gain if n == "gain" else getattr(ch_split, n),
-                )
-                for n in SplitResult._fields
-            ]
-        )
+            best = SplitResult(
+                *[
+                    upd(
+                        getattr(s.best, n),
+                        ch_gain if n == "gain" else getattr(ch_split, n),
+                    )
+                    for n in SplitResult._fields
+                ]
+            )
 
         return GrowState(
             it=s.it + 1,
@@ -375,10 +483,56 @@ def grow_tree(
             min_con=min_con,
             max_con=max_con,
             hist=hist,
+            feature_used=feature_used,
+            unused_cnt=unused_cnt,
+            used_in_data=used_in_data,
         )
 
+    # ---- forced splits preamble (ForceSplits) ---------------------------
+    state = state0
+    if forced_splits:
+        aborted = jnp.asarray(False)
+        for (leaf_i, feat_i, thr_i) in forced_splits[: M - 1]:
+            hist_slice = state.hist[leaf_i, feat_i]
+            if axis_name is not None and not psum_hist:
+                # voting-parallel keeps shard-local histograms; a forced split
+                # needs the global column (the elected-slice psum's little sibling)
+                hist_slice = jax.lax.psum(hist_slice, axis_name)
+            rec = gather_info_for_threshold(
+                hist_slice,
+                state.leaf_sum_grad[leaf_i],
+                state.leaf_sum_hess[leaf_i],
+                state.leaf_num_data[leaf_i],
+                jnp.int32(thr_i),
+                num_bin_arr[feat_i],
+                missing_arr[feat_i],
+                default_bin_arr[feat_i],
+                is_cat_arr[feat_i],
+                params,
+            )._replace(feature=jnp.int32(feat_i))
+            valid = rec.gain > neg_inf
+            if max_depth > 0:
+                valid &= state.tree.leaf_depth[leaf_i] < max_depth
+            can = (~aborted) & valid
+            applied = apply_split(state, jnp.int32(leaf_i), rec)
+            state = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(can, a, b), applied, state
+            )
+            aborted = aborted | ~valid
+
+    # ---- best-gain loop --------------------------------------------------
+    def cond(s: GrowState):
+        return (s.it < M - 1) & (jnp.max(s.best.gain) > 0.0)
+
+    def body(s: GrowState) -> GrowState:
+        best_leaf = jnp.argmax(s.best.gain).astype(jnp.int32)
+        rec = SplitResult(*[getattr(s.best, n)[best_leaf] for n in SplitResult._fields])
+        return apply_split(s, best_leaf, rec)
+
     if M > 1:
-        final = jax.lax.while_loop(cond, body, state0)
+        final = jax.lax.while_loop(cond, body, state)
     else:
-        final = state0
+        final = state
+    if cegb_on:
+        return final.tree, final.leaf_id, (final.feature_used, final.used_in_data)
     return final.tree, final.leaf_id

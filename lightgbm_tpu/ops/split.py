@@ -48,6 +48,24 @@ class SplitParams(NamedTuple):
     min_gain_to_split: float
 
 
+class CegbParams(NamedTuple):
+    """Static CEGB (cost-effective gradient boosting) switches (config.h:389-405).
+
+    The per-feature penalty vectors travel in ``feature_meta`` as
+    ``cegb_coupled``/``cegb_lazy`` [F]; these flags gate the (costly) per-leaf
+    rescan path in the grower.
+    """
+
+    tradeoff: float = 1.0
+    penalty_split: float = 0.0
+    has_coupled: bool = False
+    has_lazy: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.penalty_split != 0.0 or self.has_coupled or self.has_lazy
+
+
 def threshold_l1(s: jax.Array, l1: float) -> jax.Array:
     """ThresholdL1 (feature_histogram.hpp:446)."""
     if l1 == 0.0:
@@ -276,6 +294,83 @@ def _scan_candidates(
     )
 
 
+def gather_info_for_threshold(
+    hist_f: jax.Array,  # [B, 3] one feature's histogram
+    sum_grad: jax.Array,
+    sum_hess: jax.Array,
+    num_data: jax.Array,
+    threshold: jax.Array,  # bin threshold (int32 scalar)
+    num_bin: jax.Array,
+    missing_type: jax.Array,
+    default_bin: jax.Array,
+    is_cat: jax.Array,
+    params: SplitParams,
+) -> SplitResult:
+    """SplitInfo for a FORCED (feature, threshold) split
+    (FeatureHistogram::GatherInfoForThreshold, feature_histogram.hpp:281-420).
+
+    Numerical: right side = bins in [max(threshold,1), last real bin], skipping
+    the default bin when missing=Zero and the NaN bin when missing=NaN;
+    default_left=True. Categorical one-hot: left side = the single bin;
+    default_left=False. Gain <= min_gain_shift yields -inf (the caller skips the
+    forced split and aborts the rest of its BFS, serial_tree_learner.cpp:666).
+    """
+    p = params
+    B = hist_f.shape[0]
+    bins = jnp.arange(B, dtype=jnp.int32)
+    use_na = missing_type == MISSING_NAN
+    skip_def = missing_type == MISSING_ZERO
+
+    gain_shift = leaf_split_gain(sum_grad, sum_hess, p)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+
+    # ---- numerical ------------------------------------------------------
+    right_mask = (bins >= jnp.maximum(threshold, 1)) & (bins <= num_bin - 1 - use_na)
+    right_mask &= ~(skip_def & (bins == default_bin))
+    rm = right_mask.astype(hist_f.dtype)[:, None]
+    right = jnp.sum(hist_f * rm, axis=0)  # [3]
+    num_rg, num_rh, num_rc = right[0], right[1] + K_EPSILON, right[2]
+    num_lg = sum_grad - num_rg
+    num_lh = sum_hess - num_rh
+    num_lc = num_data - num_rc
+
+    # ---- categorical one-hot -------------------------------------------
+    left_mask = (bins == threshold).astype(hist_f.dtype)[:, None]
+    cleft = jnp.sum(hist_f * left_mask, axis=0)
+    cat_lg, cat_lh, cat_lc = cleft[0], cleft[1] + K_EPSILON, cleft[2]
+    used_bin = num_bin + jnp.where(missing_type == MISSING_NONE, 0, -1)
+    cat_ok = threshold < used_bin
+
+    lg = jnp.where(is_cat, cat_lg, num_lg)
+    lh = jnp.where(is_cat, cat_lh, num_lh)
+    lc = jnp.where(is_cat, cat_lc, num_lc)
+    rg = sum_grad - lg
+    rh = sum_hess - lh
+    rc = num_data - lc
+
+    current_gain = leaf_split_gain(lg, lh, p) + leaf_split_gain(rg, rh, p)
+    ok = (current_gain > min_gain_shift) & jnp.where(is_cat, cat_ok, True)
+    ok &= ~jnp.isnan(current_gain)
+
+    left_out = calculate_leaf_output(lg, lh, p)
+    right_out = calculate_leaf_output(rg, rh, p)
+    gain = jnp.where(ok, current_gain - min_gain_shift, K_MIN_SCORE)
+    return SplitResult(
+        gain=gain.astype(jnp.float32),
+        feature=jnp.int32(-1),  # caller fills the (static) feature index
+        threshold=threshold.astype(jnp.int32),
+        default_left=jnp.where(is_cat, False, True),
+        left_sum_grad=lg,
+        left_sum_hess=lh - K_EPSILON,
+        left_count=lc,
+        right_sum_grad=rg,
+        right_sum_hess=rh - K_EPSILON,
+        right_count=rc,
+        left_output=left_out,
+        right_output=right_out,
+    )
+
+
 def per_feature_best_gain(
     hist: jax.Array,
     sum_grad: jax.Array,
@@ -307,6 +402,7 @@ def find_best_split(
     feature_meta: Dict[str, jax.Array],  # num_bin/missing_type/default_bin/monotone [F]
     feature_mask: jax.Array,  # [F] bool: feature_fraction sample & usable
     params: SplitParams,
+    penalty: Any = None,  # optional [F] CEGB gain penalty per feature
 ) -> SplitResult:
     """Best split for one leaf across all features (FindBestThresholdNumerical)."""
     p = params
@@ -325,6 +421,11 @@ def find_best_split(
     min_gain_shift = sc.min_gain_shift
 
     g_best = jnp.where(feature_mask, g_best, K_MIN_SCORE)
+    if penalty is not None:
+        # CEGB: penalties land on the shifted gain (serial_tree_learner.cpp:537-543),
+        # i.e. after min_gain_shift subtraction; shift them into the raw scale here
+        # so the argmax and the final reported gain both see penalized values.
+        g_best = g_best - penalty
 
     best_f = jnp.argmax(g_best)  # first max wins ties (feature index order)
     best_gain_raw = g_best[best_f]

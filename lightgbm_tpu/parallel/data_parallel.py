@@ -39,7 +39,7 @@ except ImportError:  # pragma: no cover
         return _shard_map_exp(f, **kw)
 
 from ..ops.grow import grow_tree
-from ..ops.split import SplitParams
+from ..ops.split import CegbParams, SplitParams
 
 
 def grow_tree_data_parallel(
@@ -55,15 +55,29 @@ def grow_tree_data_parallel(
     num_bins: int,
     params: SplitParams,
     chunk: int = 4096,
+    forced_splits=(),
+    cegb: CegbParams = CegbParams(),
+    cegb_state=None,
 ):
     """Explicit shard_map data-parallel growth; returns (TreeArrays, leaf_id).
 
-    TreeArrays come out replicated; leaf_id stays row-sharded.
+    TreeArrays come out replicated; leaf_id stays row-sharded. With CEGB
+    enabled, also returns the carried (feature_used, used_in_data) state —
+    feature_used replicated, used_in_data row-sharded alongside bins.
     """
     meta_keys = sorted(feature_meta.keys())
     meta_vals = tuple(feature_meta[k] for k in meta_keys)
+    cegb_on = cegb.enabled
+    if cegb_on and cegb_state is None:
+        F, N = bins.shape
+        import jax.numpy as jnp
 
-    def local(bins_l, grad_l, hess_l, bag_l, fmask, *meta_flat):
+        cegb_state = (
+            jnp.zeros((F,), bool),
+            jnp.zeros((F, N) if cegb.has_lazy else (1, 1), bool),
+        )
+
+    def local(bins_l, grad_l, hess_l, bag_l, fmask, fu, uid, *meta_flat):
         meta = dict(zip(meta_keys, meta_flat))
         return grow_tree(
             bins_l,
@@ -78,15 +92,30 @@ def grow_tree_data_parallel(
             params=params,
             chunk=chunk,
             axis_name="data",
+            forced_splits=forced_splits,
+            cegb=cegb,
+            cegb_state=(fu, uid) if cegb_on else None,
         )
 
     row = P("data")
     rep = P()
+    uid_spec = P(None, "data") if cegb.has_lazy else rep
+    state_out = ((rep, uid_spec),) if cegb_on else ()
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(None, "data"), row, row, row, rep) + (rep,) * len(meta_vals),
-        out_specs=(rep, row),
+        in_specs=(P(None, "data"), row, row, row, rep, rep, uid_spec)
+        + (rep,) * len(meta_vals),
+        out_specs=(rep, row) + state_out,
         check_vma=False,
     )
-    return jax.jit(fn)(bins, grad, hess, bag_mask, feature_mask, *meta_vals)
+    if not cegb_on:
+        import jax.numpy as jnp
+
+        dummy = (jnp.zeros((1,), bool), jnp.zeros((1, 1), bool))
+        fu_in, uid_in = dummy
+    else:
+        fu_in, uid_in = cegb_state
+    return jax.jit(fn)(
+        bins, grad, hess, bag_mask, feature_mask, fu_in, uid_in, *meta_vals
+    )

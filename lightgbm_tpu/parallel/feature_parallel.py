@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.grow import grow_tree
-from ..ops.split import SplitParams
+from ..ops.split import CegbParams, SplitParams
 
 
 def feature_mesh(devices=None) -> Mesh:
@@ -47,6 +47,9 @@ def grow_tree_feature_parallel(
     num_bins: int,
     params: SplitParams,
     chunk: int = 4096,
+    forced_splits=(),
+    cegb: CegbParams = CegbParams(),
+    cegb_state=None,
 ):
     """Feature-sharded growth; returns (TreeArrays, leaf_id), both replicated."""
     fcol = NamedSharding(mesh, P("feature", None))
@@ -56,6 +59,11 @@ def grow_tree_feature_parallel(
     F = bins.shape[0]
     n_shards = mesh.shape["feature"]
     pad = (-F) % n_shards
+    if pad and cegb_state is not None:
+        fu, uid = cegb_state
+        if cegb.has_lazy:
+            uid = jnp.pad(uid, ((0, pad), (0, 0)))
+        cegb_state = (jnp.pad(fu, (0, pad)), uid)
     if pad:
         # pad features so the shard split is even; padded features are masked off
         bins = jnp.pad(bins, ((0, pad), (0, 0)))
@@ -75,7 +83,7 @@ def grow_tree_feature_parallel(
     hess = jax.device_put(hess, rep)
     bag_mask = jax.device_put(bag_mask, rep)
 
-    return grow_tree(
+    out = grow_tree(
         bins,
         grad,
         hess,
@@ -87,4 +95,13 @@ def grow_tree_feature_parallel(
         num_bins=num_bins,
         params=params,
         chunk=chunk,
+        forced_splits=forced_splits,
+        cegb=cegb,
+        cegb_state=cegb_state,
     )
+    if cegb.enabled and pad:
+        tree, leaf_id, (fu, uid) = out
+        if cegb.has_lazy:
+            uid = uid[:F]
+        return tree, leaf_id, (fu[:F], uid)
+    return out

@@ -79,6 +79,7 @@ def grow_tree_voting_parallel(
     params: SplitParams,
     top_k: int = 20,
     chunk: int = 4096,
+    forced_splits=(),
 ):
     """Voting-parallel growth; returns (TreeArrays replicated, leaf_id sharded)."""
     meta_keys = sorted(feature_meta.keys())
@@ -102,6 +103,7 @@ def grow_tree_voting_parallel(
             axis_name="data",
             split_fn=split_fn,
             psum_hist=False,  # histograms stay local; split_fn psums elected slice
+            forced_splits=forced_splits,
         )
 
     row = P("data")

@@ -1,0 +1,302 @@
+"""Plotting utilities (importance / metric / tree).
+
+Mirrors the reference python package's plotting surface
+(/root/reference/python-package/lightgbm/plotting.py:30 plot_importance,
+:248 plot_metric, :422 plot_tree + create_tree_digraph) against this package's
+Booster/eval-history objects. matplotlib and graphviz are optional; each entry
+point raises ImportError with the reference's message style when missing.
+"""
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .basic import Booster
+from .utils.log import LightGBMError
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
+    if not isinstance(obj, (list, tuple)) or len(obj) != 2:
+        raise TypeError("%s must be a tuple of 2 elements." % obj_name)
+
+
+def plot_importance(
+    booster,
+    ax=None,
+    height: float = 0.2,
+    xlim: Optional[Tuple] = None,
+    ylim: Optional[Tuple] = None,
+    title: str = "Feature importance",
+    xlabel: str = "Feature importance",
+    ylabel: str = "Features",
+    importance_type: str = "split",
+    max_num_features: Optional[int] = None,
+    ignore_zero: bool = True,
+    figsize: Optional[Tuple] = None,
+    grid: bool = True,
+    precision: int = 3,
+    **kwargs,
+):
+    """Plot model's feature importances (plotting.py:30-130)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot importance.")
+
+    if isinstance(booster, Booster):
+        importance = booster.feature_importance(importance_type=importance_type)
+        feature_name = booster.feature_name()
+    elif hasattr(booster, "booster_"):  # sklearn wrapper
+        importance = booster.booster_.feature_importance(importance_type=importance_type)
+        feature_name = booster.booster_.feature_name()
+    else:
+        raise TypeError("booster must be Booster or LGBMModel.")
+
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples)
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        ax.text(
+            x + 1,
+            y,
+            ("%." + str(precision) + "f") % x if importance_type == "gain" else str(int(x)),
+            va="center",
+        )
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, max(values) * 1.1)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (-1, len(values))
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(
+    booster,
+    metric: Optional[str] = None,
+    dataset_names: Optional[List[str]] = None,
+    ax=None,
+    xlim: Optional[Tuple] = None,
+    ylim: Optional[Tuple] = None,
+    title: str = "Metric during training",
+    xlabel: str = "Iterations",
+    ylabel: str = "auto",
+    figsize: Optional[Tuple] = None,
+    grid: bool = True,
+):
+    """Plot one metric during training (plotting.py:248-360).
+
+    ``booster`` is a dict returned by ``record_evaluation`` or a Booster (whose
+    eval history is used).
+    """
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot metric.")
+
+    if isinstance(booster, Booster):
+        eval_results = deepcopy(booster._gbdt.eval_history())
+    elif isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    else:
+        raise TypeError("booster must be dict or Booster.")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+
+    if dataset_names is None:
+        dataset_names = list(eval_results.keys())
+    elif not dataset_names:
+        raise ValueError("dataset_names cannot be empty.")
+
+    name = dataset_names[0]
+    metrics_for_one = eval_results[name]
+    num_metric = len(metrics_for_one)
+    if metric is None:
+        if num_metric > 1:
+            raise ValueError("more than one metric available, pick one with the metric arg.")
+        metric, results = metrics_for_one.popitem()
+    else:
+        if metric not in metrics_for_one:
+            raise ValueError("No given metric in eval results.")
+        results = metrics_for_one[metric]
+    num_iteration = len(results)
+    max_result = max(results)
+    min_result = min(results)
+    x_ = range(num_iteration)
+    ax.plot(x_, results, label=name)
+
+    for name in dataset_names[1:]:
+        metrics_for_one = eval_results[name]
+        results = metrics_for_one[metric]
+        max_result = max(max(results), max_result)
+        min_result = min(min(results), min_result)
+        ax.plot(range(len(results)), results, label=name)
+
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, num_iteration)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        range_result = max_result - min_result
+        ylim = (min_result - range_result * 0.2, max_result + range_result * 0.2)
+    ax.set_ylim(ylim)
+    if ylabel == "auto":
+        ylabel = metric
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _to_graphviz(
+    tree_info: Dict,
+    show_info: List[str],
+    feature_names: List[str],
+    precision: int = 3,
+    **kwargs,
+):
+    """Convert one dumped tree to a graphviz Digraph (plotting.py:360-420)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("You must install graphviz to plot tree.")
+
+    def float2str(value, precision):
+        return ("%." + str(precision) + "f") % value
+
+    def add(root, parent=None, decision=None):
+        if "split_index" in root:
+            name = "split%d" % root["split_index"]
+            if feature_names is not None:
+                label = "<B>%s</B> %s " % (
+                    feature_names[root["split_feature"]],
+                    root["decision_type"],
+                )
+            else:
+                label = "feature <B>%d</B> %s " % (
+                    root["split_feature"],
+                    root["decision_type"],
+                )
+            label += "<B>%s</B>" % float2str(root["threshold"], precision)
+            for info in ["split_gain", "internal_value", "internal_count"]:
+                if info in show_info:
+                    output = info.split("_")[-1]
+                    label += "<br/>%s: %s" % (
+                        output,
+                        float2str(root[info], precision)
+                        if "value" in info or "gain" in info
+                        else str(root[info]),
+                    )
+            graph.node(name, label="<" + label + ">")
+            add(root["left_child"], name, "yes")
+            add(root["right_child"], name, "no")
+        else:
+            name = "leaf%d" % root["leaf_index"]
+            label = "leaf %d: " % root["leaf_index"]
+            label += "<B>%s</B>" % float2str(root["leaf_value"], precision)
+            if "leaf_count" in show_info and "leaf_count" in root:
+                label += "<br/>count: %d" % root["leaf_count"]
+            graph.node(name, label="<" + label + ">")
+        if parent is not None:
+            graph.edge(parent, name, decision)
+
+    graph = Digraph(**kwargs)
+    add(tree_info["tree_structure"])
+    return graph
+
+
+def create_tree_digraph(
+    booster,
+    tree_index: int = 0,
+    show_info: Optional[List[str]] = None,
+    precision: int = 3,
+    **kwargs,
+):
+    """Create a graphviz digraph of one tree (plotting.py:422-480)."""
+    if hasattr(booster, "booster_"):
+        booster = booster.booster_
+    if not isinstance(booster, Booster):
+        raise TypeError("booster must be Booster or LGBMModel.")
+    model = booster.dump_model()
+    tree_infos = model["tree_info"]
+    feature_names = model.get("feature_names", None)
+    if tree_index >= len(tree_infos):
+        raise IndexError("tree_index is out of range.")
+    if show_info is None:
+        show_info = []
+    return _to_graphviz(tree_infos[tree_index], show_info, feature_names, precision, **kwargs)
+
+
+def plot_tree(
+    booster,
+    ax=None,
+    tree_index: int = 0,
+    figsize: Optional[Tuple] = None,
+    show_info: Optional[List[str]] = None,
+    precision: int = 3,
+    **kwargs,
+):
+    """Plot one trained tree via graphviz+matplotlib (plotting.py:480-560)."""
+    try:
+        import matplotlib.image as image
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError("You must install matplotlib to plot tree.")
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    graph = create_tree_digraph(
+        booster=booster, tree_index=tree_index, show_info=show_info,
+        precision=precision, **kwargs,
+    )
+    from io import BytesIO
+
+    s = BytesIO()
+    s.write(graph.pipe(format="png"))
+    s.seek(0)
+    img = image.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

@@ -1,0 +1,115 @@
+"""save_binary dataset round-trip and plotting smoke tests.
+
+Binary dataset: Dataset::SaveBinaryFile / LoadFromBinFile behavior
+(dataset.cpp:615, dataset_loader.cpp:268) — training from the reloaded binary
+must produce the identical model. Plotting mirrors test_plotting.py smoke.
+"""
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+BASE = {"verbosity": -1, "num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 5}
+
+
+def make_data(n=1200, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+class TestSaveBinary:
+    def test_roundtrip_identical_model(self, tmp_path):
+        X, y = make_data()
+        params = dict(BASE, objective="binary")
+        ds = lgb.Dataset(X, label=y, params=params)
+        bin_file = tmp_path / "train.bin"
+        ds.save_binary(str(bin_file))
+        bst_a = lgb.train(params, lgb.Dataset(X, label=y), 10)
+        bst_b = lgb.train(params, lgb.Dataset(str(bin_file)), 10)
+        assert bst_a.model_to_string() == bst_b.model_to_string()
+
+    def test_binary_preserves_metadata(self, tmp_path):
+        X, y = make_data(seed=1)
+        w = np.random.RandomState(2).rand(len(y)) + 0.5
+        ds = lgb.Dataset(X, label=y, weight=w, params=dict(BASE, objective="binary"))
+        bin_file = tmp_path / "w.bin"
+        ds.save_binary(str(bin_file))
+        re = lgb.Dataset(str(bin_file))
+        re.construct()
+        np.testing.assert_allclose(re._binned.metadata.weight, w.astype(np.float32))
+        np.testing.assert_allclose(re._binned.metadata.label, y.astype(np.float32))
+
+    def test_cli_save_binary_then_train_from_it(self, tmp_path):
+        X, y = make_data(seed=3)
+        train_file = tmp_path / "t.train"
+        np.savetxt(train_file, np.column_stack([y, X]), delimiter="\t")
+        from lightgbm_tpu.cli import main
+
+        m1 = tmp_path / "m1.txt"
+        main([
+            "task=train", "data=%s" % train_file, "objective=binary",
+            "num_leaves=15", "max_bin=63", "num_iterations=5",
+            "save_binary=true", "output_model=%s" % m1, "verbosity=-1",
+        ])
+        assert (tmp_path / "t.train.bin").exists()
+        m2 = tmp_path / "m2.txt"
+        main([
+            "task=train", "data=%s" % (tmp_path / "t.train.bin"),
+            "objective=binary", "num_leaves=15", "max_bin=63",
+            "num_iterations=5", "output_model=%s" % m2, "verbosity=-1",
+        ])
+        t1 = [l for l in m1.read_text().splitlines() if not l.startswith("[")]
+        t2 = [l for l in m2.read_text().splitlines() if not l.startswith("[")]
+        assert t1 == t2
+
+
+class TestPlotting:
+    def _booster(self):
+        X, y = make_data(seed=4)
+        evals = {}
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train(
+            dict(BASE, objective="binary", metric="auc"),
+            ds, 10,
+            valid_sets=[ds], valid_names=["train"],
+            callbacks=[lgb.record_evaluation(evals)],
+        )
+        return bst, evals
+
+    def test_plot_importance(self):
+        bst, _ = self._booster()
+        ax = lgb.plot_importance(bst)
+        assert len(ax.patches) > 0
+        ax2 = lgb.plot_importance(bst, importance_type="gain", max_num_features=3)
+        assert len(ax2.patches) <= 3
+
+    def test_plot_metric(self):
+        bst, evals = self._booster()
+        ax = lgb.plot_metric(evals)
+        assert len(ax.lines) >= 1
+        with pytest.raises(TypeError):
+            lgb.plot_metric([1, 2, 3])
+
+    def test_create_tree_digraph(self):
+        bst, _ = self._booster()
+        g = lgb.create_tree_digraph(bst, tree_index=0, show_info=["internal_count"])
+        src = g.source
+        assert "split0" in src and "leaf" in src
+        with pytest.raises(IndexError):
+            lgb.create_tree_digraph(bst, tree_index=10**6)
+
+    def test_plot_tree(self):
+        pytest.importorskip("graphviz")
+        import shutil
+
+        if shutil.which("dot") is None:
+            pytest.skip("graphviz binary not installed")
+        bst, _ = self._booster()
+        ax = lgb.plot_tree(bst, tree_index=0)
+        assert ax is not None

@@ -1,0 +1,157 @@
+"""Vectorized binning must match the reference's per-value greedy walk.
+
+The oracles below transcribe the scalar loops of bin.cpp:74-270 (GreedyFindBin's
+value walk and the within-ulp distinct merge) directly; the shipped
+implementations are vectorized rewrites, and this property test pins them to the
+oracle on randomized inputs.
+"""
+import math
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import BinMapper, greedy_find_bin
+
+_INF = float("inf")
+
+
+def _next_after_up(x):
+    return math.inf if x == math.inf else float(np.nextafter(x, np.inf))
+
+
+def _equal_ordered(a, b):
+    return b <= _next_after_up(a)
+
+
+def oracle_greedy_find_bin(distinct_values, counts, max_bin, total_cnt, min_data_in_bin):
+    """bin.cpp:74-150, scalar walk."""
+    num_distinct = len(distinct_values)
+    bin_upper_bound = []
+    if num_distinct <= max_bin:
+        cur = 0
+        for i in range(num_distinct - 1):
+            cur += int(counts[i])
+            if cur >= min_data_in_bin:
+                val = _next_after_up((float(distinct_values[i]) + float(distinct_values[i + 1])) / 2.0)
+                if not bin_upper_bound or not _equal_ordered(bin_upper_bound[-1], val):
+                    bin_upper_bound.append(val)
+                    cur = 0
+        bin_upper_bound.append(_INF)
+        return bin_upper_bound
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+    rest_bin_cnt = max_bin
+    rest_sample_cnt = total_cnt
+    is_big = np.asarray(counts) >= mean_bin_size
+    rest_bin_cnt -= int(is_big.sum())
+    rest_sample_cnt -= int(np.asarray(counts)[is_big].sum())
+    mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    upper_bounds = [_INF] * max_bin
+    lower_bounds = [_INF] * max_bin
+    bin_cnt = 0
+    lower_bounds[0] = float(distinct_values[0])
+    cur_cnt_inbin = 0
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= int(counts[i])
+        cur_cnt_inbin += int(counts[i])
+        if (
+            is_big[i]
+            or cur_cnt_inbin >= mean_bin_size
+            or (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * 0.5))
+        ):
+            upper_bounds[bin_cnt] = float(distinct_values[i])
+            bin_cnt += 1
+            lower_bounds[bin_cnt] = float(distinct_values[i + 1])
+            if bin_cnt >= max_bin - 1:
+                break
+            cur_cnt_inbin = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / max(rest_bin_cnt, 1)
+    bin_cnt += 1
+    out = []
+    for i in range(bin_cnt - 1):
+        val = _next_after_up((upper_bounds[i] + lower_bounds[i + 1]) / 2.0)
+        if not out or not _equal_ordered(out[-1], val):
+            out.append(val)
+    out.append(_INF)
+    return out
+
+
+def oracle_distinct_with_zero(values, zero_cnt):
+    """bin.cpp:238-270, scalar merge walk."""
+    values = np.sort(values, kind="stable")
+    distinct, counts = [], []
+    n = len(values)
+    if n == 0 or (values[0] > 0.0 and zero_cnt > 0):
+        distinct.append(0.0)
+        counts.append(zero_cnt)
+    if n > 0:
+        distinct.append(float(values[0]))
+        counts.append(1)
+    for i in range(1, n):
+        prev, cur = float(values[i - 1]), float(values[i])
+        if not _equal_ordered(prev, cur):
+            if prev < 0.0 and cur > 0.0:
+                distinct.append(0.0)
+                counts.append(zero_cnt)
+            distinct.append(cur)
+            counts.append(1)
+        else:
+            distinct[-1] = cur
+            counts[-1] += 1
+    if n > 0 and values[n - 1] < 0.0 and zero_cnt > 0:
+        distinct.append(0.0)
+        counts.append(zero_cnt)
+    return np.asarray(distinct), np.asarray(counts, dtype=np.int64)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_greedy_find_bin_matches_oracle(seed):
+    rng = np.random.RandomState(seed)
+    n = rng.randint(300, 3000)
+    # mixture: continuous + heavy repeated values (creates is_big entries)
+    vals = np.concatenate([
+        rng.randn(n),
+        np.repeat(rng.randn(rng.randint(1, 6)), rng.randint(50, 400)),
+    ])
+    distinct, cnts = np.unique(np.round(vals, 3), return_counts=True)
+    total = int(cnts.sum())
+    for max_bin in (16, 63, 255):
+        for mdb in (1, 3, 10):
+            got = greedy_find_bin(distinct, cnts, max_bin, total, mdb)
+            want = oracle_greedy_find_bin(distinct, cnts, max_bin, total, mdb)
+            assert got == want, (seed, max_bin, mdb)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_distinct_with_zero_matches_oracle(seed):
+    rng = np.random.RandomState(100 + seed)
+    n = rng.randint(0, 2000)
+    vals = rng.randn(n) * 10
+    # inject within-ulp duplicates and exact duplicates
+    if n > 10:
+        vals[: n // 3] = np.repeat(vals[n // 3 : n // 3 + 1], n // 3)
+        vals[n // 3 : n // 3 + 5] = np.nextafter(vals[0], np.inf)
+    # all-negative / all-positive / straddling cases via shift
+    for shift, zero_cnt in ((0.0, 17), (100.0, 5), (-100.0, 9), (0.0, 0)):
+        v = vals + shift
+        v = v[np.abs(v) > 1e-35]
+        gd, gc = BinMapper._distinct_with_zero(v, zero_cnt)
+        wd, wc = oracle_distinct_with_zero(v, zero_cnt)
+        np.testing.assert_array_equal(gd, wd)
+        np.testing.assert_array_equal(gc, wc)
+
+
+def test_find_bin_large_continuous_fast_and_sane():
+    rng = np.random.RandomState(3)
+    vals = rng.randn(200_000)
+    m = BinMapper()
+    m.find_bin(vals, 200_000, 255, 3, 20)
+    assert 200 <= m.num_bin <= 255
+    # bins roughly equal-count on continuous data
+    bins = m.values_to_bins(vals)
+    cnts = np.bincount(bins, minlength=m.num_bin)
+    assert cnts.max() < 200_000 / m.num_bin * 3

@@ -1,0 +1,222 @@
+"""Forced splits (forcedsplits_filename) and CEGB penalty tests.
+
+Mirrors the reference's CEGB behavior/scaling tests
+(tests/python_package_test/test_basic.py:220,250) and exercises ForceSplits
+(serial_tree_learner.cpp:597) through the JSON config path.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+BASE = {"verbosity": -1, "num_leaves": 15, "max_bin": 63, "min_data_in_leaf": 5}
+
+
+def make_data(n=1500, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = X[:, 0] + 0.8 * X[:, 1] + 0.6 * X[:, 2] + 0.2 * rng.randn(n)
+    return X, (logit > 0).astype(np.float64)
+
+
+class TestForcedSplits:
+    def test_root_split_forced(self, tmp_path):
+        X, y = make_data()
+        fs = tmp_path / "forced.json"
+        # feature 5 is noise: the grower would never choose it on its own
+        fs.write_text(json.dumps({"feature": 5, "threshold": 0.25}))
+        bst = lgb.train(
+            dict(BASE, objective="binary", forcedsplits_filename=str(fs)),
+            lgb.Dataset(X, label=y),
+            3,
+        )
+        for t in bst._gbdt.trees():
+            assert t.split_feature[0] == 5
+            # threshold bin must contain 0.25
+            assert t.threshold[0] >= 0.25
+
+    def test_nested_forced_splits(self, tmp_path):
+        X, y = make_data(seed=1)
+        fs = tmp_path / "forced.json"
+        fs.write_text(
+            json.dumps(
+                {
+                    "feature": 4,
+                    "threshold": 0.0,
+                    "left": {"feature": 5, "threshold": -0.5},
+                    "right": {"feature": 3, "threshold": 0.5},
+                }
+            )
+        )
+        bst = lgb.train(
+            dict(BASE, objective="binary", forcedsplits_filename=str(fs)),
+            lgb.Dataset(X, label=y),
+            2,
+        )
+        t = bst._gbdt.trees()[0]
+        # BFS application: node0 = root on f4; node1 = left subtree on f5
+        # (leaf 0), node2 = right subtree on f3 (leaf 1)
+        assert t.split_feature[0] == 4
+        assert t.split_feature[1] == 5
+        assert t.split_feature[2] == 3
+        # wiring: node1 must live in node0's left subtree, node2 in the right
+        assert t.left_child[0] == 1
+        assert t.right_child[0] == 2
+
+    def test_forced_split_keeps_accuracy(self, tmp_path):
+        X, y = make_data(seed=2)
+        fs = tmp_path / "forced.json"
+        fs.write_text(json.dumps({"feature": 5, "threshold": 0.0}))
+        base = lgb.train(dict(BASE, objective="binary"), lgb.Dataset(X, label=y), 20)
+        forced = lgb.train(
+            dict(BASE, objective="binary", forcedsplits_filename=str(fs)),
+            lgb.Dataset(X, label=y),
+            20,
+        )
+        acc_b = np.mean((base.predict(X) > 0.5) == y)
+        acc_f = np.mean((forced.predict(X) > 0.5) == y)
+        assert acc_f > 0.9  # forcing one noise split shouldn't wreck training
+        assert acc_b >= acc_f - 0.02
+
+
+class TestCEGB:
+    def test_penalty_split_prunes(self):
+        X, y = make_data(seed=3)
+        ds = lgb.Dataset(X, label=y)
+        base = lgb.train(dict(BASE, objective="binary"), ds, 5)
+        pen = lgb.train(
+            dict(BASE, objective="binary", cegb_penalty_split=5.0), ds, 5
+        )
+        n_base = sum(t.num_leaves for t in base._gbdt.trees())
+        n_pen = sum(t.num_leaves for t in pen._gbdt.trees())
+        assert n_pen < n_base  # per-split cost prunes low-gain splits
+
+    def test_cegb_variants_change_model(self):
+        """test_basic.py:220 — each penalty flavor alters the trained model."""
+        X, y = make_data(seed=4)
+        ds = lgb.Dataset(X, label=y)
+        base = lgb.train(dict(BASE, objective="binary"), ds, 5)
+        base_str = base.model_to_string()
+        F = X.shape[1]
+        for extra in (
+            {"cegb_penalty_split": 1.0},
+            {"cegb_penalty_feature_coupled": [5.0] * (F - 1) + [0.0]},
+            {"cegb_penalty_feature_lazy": [0.1] * F},
+        ):
+            alt = lgb.train(dict(BASE, objective="binary", **extra), ds, 5)
+            assert alt.model_to_string() != base_str, extra
+
+    def test_cegb_scaling_equality(self):
+        """test_basic.py:250 — tradeoff*k with penalties/k gives identical trees."""
+        X, y = make_data(seed=5)
+        ds = lgb.Dataset(X, label=y)
+        F = X.shape[1]
+        for pen_kw in (
+            {"cegb_penalty_split": 0.5},
+            {"cegb_penalty_feature_coupled": [2.0] * F},
+            {"cegb_penalty_feature_lazy": [0.05] * F},
+        ):
+            scaled = {
+                k: ([x * 10 for x in v] if isinstance(v, list) else v * 10)
+                for k, v in pen_kw.items()
+            }
+            a = lgb.train(dict(BASE, objective="binary", cegb_tradeoff=10.0, **pen_kw), ds, 4)
+            b = lgb.train(dict(BASE, objective="binary", cegb_tradeoff=1.0, **scaled), ds, 4)
+            sa = "\n".join(
+                l for l in a.model_to_string().splitlines() if not l.startswith("[cegb")
+            )
+            sb = "\n".join(
+                l for l in b.model_to_string().splitlines() if not l.startswith("[cegb")
+            )
+            assert sa == sb, pen_kw
+
+    def test_coupled_penalty_amortizes_across_trees(self):
+        """feature_used persists across trees (serial_tree_learner.cpp:107-115):
+        once a tree pays a feature's coupled penalty, later trees use it freely."""
+        import jax.numpy as jnp
+
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.dataset import construct_dataset
+        from lightgbm_tpu.ops.grow import grow_tree
+        from lightgbm_tpu.ops.split import CegbParams, SplitParams
+
+        X, y = make_data(seed=7)
+        cfg = Config.from_params(dict(BASE, objective="binary"))
+        binned = construct_dataset(X, cfg, label=y)
+        F, N = binned.bins.shape
+        meta = {k: jnp.asarray(v) for k, v in binned.feature_meta_arrays().items()}
+        meta["cegb_coupled"] = jnp.asarray(np.full(F, 3.0, np.float32))
+        bins = jnp.asarray(binned.bins)
+        grad = jnp.asarray((0.5 - y).astype(np.float32))
+        hess = jnp.full((N,), 0.25, jnp.float32)
+        ones = jnp.ones((N,), jnp.float32)
+        fmask = jnp.ones((F,), bool)
+        sp = SplitParams(0.0, 0.0, 0.0, 5, 1e-3, 0.0)
+        cegb = CegbParams(tradeoff=1.0, penalty_split=0.0, has_coupled=True)
+        kw = dict(num_leaves=7, max_depth=-1, num_bins=binned.max_num_bin, params=sp)
+        t1, _, state = grow_tree(
+            bins, grad, hess, ones, fmask, meta, cegb=cegb, **kw
+        )
+        used = np.asarray(state[0])
+        used_feats = set(
+            int(f) for f in np.asarray(t1.split_feature)[: int(t1.num_leaves) - 1]
+        )
+        assert all(used[f] for f in used_feats)
+        # a second tree carrying the state must match a penalty-free tree when
+        # it only needs already-bought features
+        t2, _, _ = grow_tree(
+            bins, grad, hess, ones, fmask, meta, cegb=cegb, cegb_state=state, **kw
+        )
+        t_free, _ = grow_tree(bins, grad, hess, ones, fmask, meta, **kw)
+        if used_feats >= set(
+            int(f) for f in np.asarray(t_free.split_feature)[: int(t_free.num_leaves) - 1]
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(t2.split_feature), np.asarray(t_free.split_feature)
+            )
+            np.testing.assert_array_equal(
+                np.asarray(t2.threshold_bin), np.asarray(t_free.threshold_bin)
+            )
+
+    def test_cegb_data_parallel_matches_serial(self):
+        """CEGB penalized training under the sharded data-parallel learner
+        must produce the same model as serial (same math, psum'd counts)."""
+        X, y = make_data(n=1024, seed=8)
+        ds_params = dict(
+            BASE, objective="binary", cegb_penalty_split=0.5,
+            cegb_penalty_feature_lazy=[0.05] * X.shape[1],
+        )
+        serial = lgb.train(dict(ds_params, tree_learner="serial"), lgb.Dataset(X, label=y), 3)
+        par = lgb.train(dict(ds_params, tree_learner="data"), lgb.Dataset(X, label=y), 3)
+        s = [l for l in serial.model_to_string().splitlines() if not l.startswith("[")]
+        p = [l for l in par.model_to_string().splitlines() if not l.startswith("[")]
+        assert s == p
+
+    def test_forced_split_data_parallel(self, tmp_path):
+        X, y = make_data(n=1024, seed=9)
+        fs = tmp_path / "forced.json"
+        fs.write_text(json.dumps({"feature": 5, "threshold": 0.0}))
+        bst = lgb.train(
+            dict(BASE, objective="binary", tree_learner="data",
+                 forcedsplits_filename=str(fs)),
+            lgb.Dataset(X, label=y),
+            2,
+        )
+        for t in bst._gbdt.trees():
+            assert t.split_feature[0] == 5
+
+    def test_coupled_penalty_focuses_features(self):
+        """Heavy coupled penalty on noise features concentrates splits."""
+        X, y = make_data(seed=6)
+        ds = lgb.Dataset(X, label=y)
+        F = X.shape[1]
+        pen = [0.0, 0.0, 100.0, 100.0, 100.0, 100.0]
+        bst = lgb.train(
+            dict(BASE, objective="binary", cegb_penalty_feature_coupled=pen), ds, 5
+        )
+        used = set()
+        for t in bst._gbdt.trees():
+            used.update(int(f) for f in t.split_feature[: t.num_leaves - 1])
+        assert used <= {0, 1, 2}  # f2 has real signal; may pay its toll once
