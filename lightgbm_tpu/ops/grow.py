@@ -4,9 +4,15 @@ TPU-native counterpart of SerialTreeLearner::Train
 (/root/reference/src/treelearner/serial_tree_learner.cpp:173-237) and its split loop.
 Differences from the reference are architectural, not semantic:
 
- * Leaf membership is a per-row ``leaf_id`` vector updated with ``where`` instead of
-   DataPartition's index reshuffle (data_partition.hpp:111) — fully vectorized, no
-   sorting, static shapes.
+ * Leaf membership lives in one of two static modes. The default ``bucketed``
+   mode keeps a DataPartition-style row permutation (data_partition.hpp:20):
+   each split stably partitions the leaf's contiguous segment inside a
+   power-of-2 gathered bucket (``lax.switch`` over sizes), so per-split
+   histogram cost tracks leaf size like the reference's ordered-index kernels.
+   The ``masked`` mode is the simple oracle — a per-row ``leaf_id`` vector
+   updated with ``where`` and full-N masked histogram passes — kept for
+   differential testing (tests/test_hist_modes.py) and for lazy-CEGB, which
+   needs full-row masks.
  * The whole num_leaves-1 split loop runs inside one ``lax.while_loop`` so a tree
    trains without host round-trips.
  * The smaller/larger-leaf histogram subtraction trick (serial_tree_learner.cpp:510,
@@ -70,7 +76,7 @@ class TreeArrays(NamedTuple):
 
 class GrowState(NamedTuple):
     it: jax.Array
-    leaf_id: jax.Array  # [N] int32
+    leaf_id: jax.Array  # [N] int32 (masked mode; [1] dummy when bucketed)
     tree: TreeArrays
     best: SplitResult  # per-leaf best splits, each field [M]
     leaf_sum_grad: jax.Array  # [M]
@@ -82,6 +88,10 @@ class GrowState(NamedTuple):
     feature_used: jax.Array  # [F] bool (CEGB coupled bookkeeping)
     unused_cnt: jax.Array  # [M, F] rows-not-yet-charged counts (CEGB lazy)
     used_in_data: jax.Array  # [F, N] bool when lazy CEGB else [1, 1] dummy
+    # bucketed mode: DataPartition-style segment layout (data_partition.hpp:20)
+    order: jax.Array  # [N] int32 row permutation grouped by leaf ([1] dummy)
+    leaf_begin: jax.Array  # [M] int32 segment starts ([1] dummy)
+    leaf_phys: jax.Array  # [M] int32 physical rows per leaf ([1] dummy)
 
 
 def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat):
@@ -96,11 +106,18 @@ def _decision_go_left(col, threshold, default_left, missing_type, default_bin, n
     return go_left
 
 
+def _ceil_log2(n: int) -> int:
+    return max(int(n - 1).bit_length(), 0)
+
+
+MIN_BUCKET_LOG2 = 10  # smallest gathered-segment bucket (1024 rows)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "num_leaves", "max_depth", "num_bins", "params", "chunk", "axis_name",
-        "split_fn", "psum_hist", "forced_splits", "cegb",
+        "split_fn", "psum_hist", "forced_splits", "cegb", "hist_mode",
     ),
 )
 def grow_tree(
@@ -121,6 +138,7 @@ def grow_tree(
     forced_splits: Tuple = (),
     cegb: CegbParams = CegbParams(),
     cegb_state: Optional[Tuple[jax.Array, jax.Array]] = None,
+    hist_mode: str = "bucketed",
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [N]).
 
@@ -134,6 +152,9 @@ def grow_tree(
 
     ``forced_splits``: BFS-ordered static tuple of (leaf_idx, used_feature_idx,
     threshold_bin) applied before best-gain growth (ForceSplits).
+    ``hist_mode``: "bucketed" (default — segment-permutation histograms whose
+    cost tracks leaf size) or "masked" (full-N masked passes; the differential
+    oracle, also used automatically for lazy CEGB).
     ``cegb``: static CegbParams; per-feature penalty vectors ride in
     ``feature_meta["cegb_coupled"/"cegb_lazy"]``. ``cegb_state`` is the
     (feature_used [F] bool, used_in_data [F, N] bool) pair carried across trees
@@ -154,6 +175,101 @@ def grow_tree(
         raise NotImplementedError(
             "CEGB penalties are only supported with the serial/data-parallel "
             "split search (the reference implements them in SerialTreeLearner)"
+        )
+    if hist_mode not in ("bucketed", "masked"):
+        raise ValueError(
+            "hist_mode must be 'bucketed' or 'masked', got %r" % (hist_mode,)
+        )
+    # lazy CEGB charges per (row, feature) and needs full-row leaf masks
+    bucketed = hist_mode == "bucketed" and not cegb.has_lazy and M > 1
+
+    num_bin_arr = feature_meta["num_bin"].astype(jnp.int32)
+    missing_arr = feature_meta["missing_type"].astype(jnp.int32)
+    default_bin_arr = feature_meta["default_bin"].astype(jnp.int32)
+    mono_arr = feature_meta["monotone"].astype(jnp.int32)
+    is_cat_arr = feature_meta.get("is_categorical")
+    if is_cat_arr is None:
+        is_cat_arr = jnp.zeros((F,), bool)
+    else:
+        is_cat_arr = is_cat_arr.astype(bool)
+
+    # power-of-2 gathered-segment sizes for the bucketed partition/histogram
+    if bucketed:
+        SIZES = sorted(
+            {min(1 << b, N) for b in range(MIN_BUCKET_LOG2, _ceil_log2(N) + 1)}
+            | {N}
+        )
+        sizes_arr = jnp.asarray(SIZES, jnp.int32)
+
+    def _segment_slice(order, begin, cnt, S):
+        """Gathered segment of `order` of static size S >= cnt, with validity.
+
+        dynamic_slice clamps the start when begin+S > N, so the segment may
+        carry rows of neighboring leaves on either side; `valid` marks exactly
+        the [begin, begin+cnt) positions."""
+        start = jnp.clip(begin, 0, max(N - S, 0))
+        off = begin - start
+        seg = jax.lax.dynamic_slice(order, (start,), (S,))
+        pos = jnp.arange(S, dtype=jnp.int32)
+        valid = (pos >= off) & (pos < off + cnt)
+        return start, off, seg, pos, valid
+
+    def partition_segment(order, begin, pcnt, f, threshold, default_left):
+        """Stably partition the leaf's segment in-place: left rows first.
+
+        Returns (new order, left physical count) — DataPartition::Split
+        (data_partition.hpp:111) on a power-of-2 gathered bucket."""
+        miss, dbin, nanb, iscat = (
+            missing_arr[f], default_bin_arr[f], num_bin_arr[f] - 1, is_cat_arr[f],
+        )
+
+        def make_branch(S):
+            def branch(order, begin, pcnt, f, threshold, default_left):
+                start, off, seg, pos, valid = _segment_slice(order, begin, pcnt, S)
+                colv = bins[f, seg].astype(jnp.int32)
+                gl = _decision_go_left(colv, threshold, default_left, miss, dbin, nanb, iscat)
+                # stable 4-class sort keeps out-of-segment rows in place:
+                # [pre-segment | left | right | post-segment]
+                klass = jnp.where(
+                    pos < off, 0, jnp.where(valid & gl, 1, jnp.where(valid, 2, 3))
+                )
+                perm = jnp.argsort(klass, stable=True)
+                order2 = jax.lax.dynamic_update_slice(order, seg[perm], (start,))
+                left_cnt = jnp.sum((klass == 1).astype(jnp.int32))
+                return order2, left_cnt
+
+            return branch
+
+        idx = jnp.clip(
+            jnp.searchsorted(sizes_arr, pcnt, side="left"), 0, len(SIZES) - 1
+        )
+        return jax.lax.switch(
+            idx, [make_branch(S) for S in SIZES],
+            order, begin, pcnt, f, threshold, default_left,
+        )
+
+    def segment_histogram(order, begin, cnt):
+        """[F, B, 3] histogram of rows order[begin:begin+cnt) via the smallest
+        power-of-2 bucket — replaces the full-N masked pass; cost tracks leaf
+        size like the reference's ordered-index histograms (dense_bin.hpp:71)."""
+
+        def make_branch(S):
+            def branch(order, begin, cnt):
+                _, _, seg, _, valid = _segment_slice(order, begin, cnt, S)
+                b_seg = jnp.take(bins, seg, axis=1)  # [F, S]
+                g_seg = jnp.take(grad, seg)
+                h_seg = jnp.take(hess, seg)
+                bag_seg = jnp.take(bag_mask, seg) * valid.astype(f32)
+                vals = leaf_values(g_seg, h_seg, bag_seg)
+                return leaf_histogram(b_seg, vals, B, chunk=chunk)
+
+            return branch
+
+        idx = jnp.clip(
+            jnp.searchsorted(sizes_arr, cnt, side="left"), 0, len(SIZES) - 1
+        )
+        return jax.lax.switch(
+            idx, [make_branch(S) for S in SIZES], order, begin, cnt
         )
 
     coupled_arr = feature_meta.get("cegb_coupled")
@@ -298,7 +414,7 @@ def grow_tree(
 
     state0 = GrowState(
         it=jnp.int32(0),
-        leaf_id=jnp.zeros((N,), jnp.int32),
+        leaf_id=jnp.zeros((1,) if bucketed else (N,), jnp.int32),
         tree=tree0,
         best=best0,
         leaf_sum_grad=jnp.zeros((M,), f32).at[0].set(root_g),
@@ -310,17 +426,14 @@ def grow_tree(
         feature_used=feature_used0,
         unused_cnt=unused0,
         used_in_data=used_in_data0,
+        order=jnp.arange(N, dtype=jnp.int32) if bucketed else jnp.zeros((1,), jnp.int32),
+        leaf_begin=jnp.zeros((M,) if bucketed else (1,), jnp.int32),
+        leaf_phys=(
+            jnp.zeros((M,), jnp.int32).at[0].set(N)
+            if bucketed
+            else jnp.zeros((1,), jnp.int32)
+        ),
     )
-
-    num_bin_arr = feature_meta["num_bin"].astype(jnp.int32)
-    missing_arr = feature_meta["missing_type"].astype(jnp.int32)
-    default_bin_arr = feature_meta["default_bin"].astype(jnp.int32)
-    mono_arr = feature_meta["monotone"].astype(jnp.int32)
-    is_cat_arr = feature_meta.get("is_categorical")
-    if is_cat_arr is None:
-        is_cat_arr = jnp.zeros((F,), bool)
-    else:
-        is_cat_arr = is_cat_arr.astype(bool)
 
     def apply_split(s: GrowState, best_leaf, rec: SplitResult) -> GrowState:
         """Apply one split of ``best_leaf`` by ``rec`` (Split,
@@ -329,18 +442,32 @@ def grow_tree(
         new_leaf = s.tree.num_leaves
 
         f = rec.feature
-        col = jax.lax.dynamic_slice(bins, (f, 0), (1, N))[0].astype(jnp.int32)
-        go_left = _decision_go_left(
-            col,
-            rec.threshold,
-            rec.default_left,
-            missing_arr[f],
-            default_bin_arr[f],
-            num_bin_arr[f] - 1,
-            is_cat_arr[f],
-        )
-        in_leaf = s.leaf_id == best_leaf
-        leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, s.leaf_id)
+        if bucketed:
+            leaf_id = s.leaf_id  # dummy; reconstructed from order at the end
+            pbegin = s.leaf_begin[best_leaf]
+            pphys = s.leaf_phys[best_leaf]
+            order, left_phys = partition_segment(
+                s.order, pbegin, pphys, f, rec.threshold, rec.default_left
+            )
+            right_phys = pphys - left_phys
+            leaf_begin = s.leaf_begin.at[new_leaf].set(pbegin + left_phys)
+            leaf_phys = (
+                s.leaf_phys.at[best_leaf].set(left_phys).at[new_leaf].set(right_phys)
+            )
+        else:
+            col = jax.lax.dynamic_slice(bins, (f, 0), (1, N))[0].astype(jnp.int32)
+            go_left = _decision_go_left(
+                col,
+                rec.threshold,
+                rec.default_left,
+                missing_arr[f],
+                default_bin_arr[f],
+                num_bin_arr[f] - 1,
+                is_cat_arr[f],
+            )
+            in_leaf = s.leaf_id == best_leaf
+            leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, s.leaf_id)
+            order, leaf_begin, leaf_phys = s.order, s.leaf_begin, s.leaf_phys
 
         # ---- wire the tree ------------------------------------------------
         t = s.tree
@@ -432,13 +559,25 @@ def grow_tree(
             )
 
         # ---- histograms: smaller child pass + subtraction ----------------
+        # smaller-child choice uses the global (bagged) counts from the split
+        # record: under shard_map the physical counts are shard-local and
+        # shards must all histogram the SAME child before the psum
         left_smaller = rec.left_count <= rec.right_count
         small_idx = jnp.where(left_smaller, best_leaf, new_leaf)
         large_idx = jnp.where(left_smaller, new_leaf, best_leaf)
-        small_mask = (leaf_id == small_idx).astype(f32)
-        small_hist = leaf_histogram(
-            bins, masked_values(small_mask), B, chunk=chunk, axis_name=hist_axis
-        )
+        if bucketed:
+            small_begin = jnp.where(left_smaller, pbegin, pbegin + left_phys)
+            small_cnt = jnp.where(left_smaller, left_phys, right_phys)
+            small_hist = segment_histogram(order, small_begin, small_cnt)
+            if hist_axis is not None:
+                # collective AFTER the bucket switch: shards may pick different
+                # bucket branches, so no psum may live inside them
+                small_hist = jax.lax.psum(small_hist, hist_axis)
+        else:
+            small_mask = (leaf_id == small_idx).astype(f32)
+            small_hist = leaf_histogram(
+                bins, masked_values(small_mask), B, chunk=chunk, axis_name=hist_axis
+            )
         parent_hist = s.hist[best_leaf]
         large_hist = parent_hist - small_hist
         hist = s.hist.at[small_idx].set(small_hist).at[large_idx].set(large_hist)
@@ -486,6 +625,9 @@ def grow_tree(
             feature_used=feature_used,
             unused_cnt=unused_cnt,
             used_in_data=used_in_data,
+            order=order,
+            leaf_begin=leaf_begin,
+            leaf_phys=leaf_phys,
         )
 
     # ---- forced splits preamble (ForceSplits) ---------------------------
@@ -533,6 +675,23 @@ def grow_tree(
         final = jax.lax.while_loop(cond, body, state)
     else:
         final = state
+
+    if bucketed:
+        # reconstruct per-row leaf ids from the segment layout: position ->
+        # owning segment (empty leaves keyed past N so they claim nothing),
+        # then scatter through the permutation.
+        key = jnp.where(
+            final.leaf_phys > 0,
+            final.leaf_begin,
+            N + jnp.arange(M, dtype=jnp.int32),
+        )
+        ordl = jnp.argsort(key)
+        slot = jnp.searchsorted(key[ordl], jnp.arange(N, dtype=jnp.int32), side="right") - 1
+        pos_leaf = ordl[jnp.clip(slot, 0, M - 1)].astype(jnp.int32)
+        out_leaf_id = jnp.zeros((N,), jnp.int32).at[final.order].set(pos_leaf)
+    else:
+        out_leaf_id = final.leaf_id
+
     if cegb_on:
-        return final.tree, final.leaf_id, (final.feature_used, final.used_in_data)
-    return final.tree, final.leaf_id
+        return final.tree, out_leaf_id, (final.feature_used, final.used_in_data)
+    return final.tree, out_leaf_id

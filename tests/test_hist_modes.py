@@ -1,0 +1,90 @@
+"""Bucketed (segment-gather) vs masked (full-N) histogram growth equivalence.
+
+The bucketed path is the perf-critical default: a DataPartition-style row
+permutation (data_partition.hpp:20) with power-of-2 gathered buckets makes
+per-split histogram cost track leaf size, like the reference's ordered-index
+kernels (dense_bin.hpp:71). The masked path is the simple oracle; both must
+produce identical trees and row->leaf assignments.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import construct_dataset
+from lightgbm_tpu.ops.grow import grow_tree
+from lightgbm_tpu.ops.split import SplitParams
+
+PARAMS = SplitParams(0.0, 0.0, 0.0, 5, 1e-3, 0.0)
+
+
+def _grow_both(X, y, bag=None, max_bin=63, leaves=31, mono=None):
+    cfg = Config.from_params({"max_bin": max_bin, "objective": "binary"})
+    ds = construct_dataset(
+        X, cfg, label=y,
+    )
+    if mono is not None:
+        ds.monotone_constraints = mono
+    meta = {k: jnp.asarray(v) for k, v in ds.feature_meta_arrays().items()}
+    n = ds.num_data
+    grad = jnp.asarray((0.5 - y).astype(np.float32))
+    hess = jnp.full((n,), 0.25, jnp.float32)
+    bagm = jnp.ones((n,), jnp.float32) if bag is None else jnp.asarray(bag)
+    fmask = jnp.ones((ds.num_features,), bool)
+    kw = dict(
+        num_leaves=leaves, max_depth=-1, num_bins=ds.max_num_bin, params=PARAMS,
+        chunk=256,
+    )
+    bins = jnp.asarray(ds.bins)
+    tm, lm = grow_tree(bins, grad, hess, bagm, fmask, meta, hist_mode="masked", **kw)
+    tb, lb = grow_tree(bins, grad, hess, bagm, fmask, meta, hist_mode="bucketed", **kw)
+    return tm, lm, tb, lb
+
+
+def _assert_trees_equal(tm, tb):
+    for name in tm._fields:
+        a, b = np.asarray(getattr(tm, name)), np.asarray(getattr(tb, name))
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6, err_msg=name)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_bucketed_matches_masked(seed):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(3000, 7)
+    X[::7, 2] = np.nan
+    X[::5, 3] = 0.0
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * X[:, 1] > 0).astype(np.float64)
+    tm, lm, tb, lb = _grow_both(X, y)
+    _assert_trees_equal(tm, tb)
+    np.testing.assert_array_equal(np.asarray(lm), np.asarray(lb))
+
+
+def test_bucketed_matches_masked_with_bagging():
+    rng = np.random.RandomState(2)
+    X = rng.randn(2500, 6)
+    y = (X[:, 0] > 0).astype(np.float64)
+    bag = (rng.rand(2500) > 0.4).astype(np.float32)
+    tm, lm, tb, lb = _grow_both(X, y, bag=bag)
+    _assert_trees_equal(tm, tb)
+    np.testing.assert_array_equal(np.asarray(lm), np.asarray(lb))
+
+
+def test_bucketed_matches_masked_monotone():
+    rng = np.random.RandomState(4)
+    X = rng.randn(2000, 5)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    tm, lm, tb, lb = _grow_both(X, y, mono=[1, -1, 0, 0, 0])
+    _assert_trees_equal(tm, tb)
+    np.testing.assert_array_equal(np.asarray(lm), np.asarray(lb))
+
+
+def test_bucketed_non_pow2_and_tiny():
+    rng = np.random.RandomState(3)
+    for n in (777, 1025, 4097):
+        X = rng.randn(n, 4)
+        y = (X[:, 0] > 0).astype(np.float64)
+        tm, lm, tb, lb = _grow_both(X, y, leaves=7)
+        _assert_trees_equal(tm, tb)
+        np.testing.assert_array_equal(np.asarray(lm), np.asarray(lb))
