@@ -432,13 +432,20 @@ class BinMapper:
         return self.categorical_2_bin.get(iv, self.num_bin - 1)
 
     def values_to_bins(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized ValueToBin over a column."""
+        """Vectorized ValueToBin over a column (native kernel when available)."""
         values = np.asarray(values, dtype=np.float64)
-        out = np.zeros(len(values), dtype=np.int32)
-        nan_mask = np.isnan(values)
         if self.bin_type == BIN_NUMERICAL:
             ub = np.asarray(self.bin_upper_bound, dtype=np.float64)
             n_search = self.num_bin - (1 if self.missing_type == MISSING_NAN else 0)
+            from . import native
+
+            res = native.values_to_bins_numerical(
+                values, ub, n_search, self.num_bin, self.missing_type, use8=False
+            )
+            if res is not None:
+                return res
+            nan_mask = np.isnan(values)
+            out = np.zeros(len(values), dtype=np.int32)
             safe = np.where(nan_mask, 0.0, values)
             idx = np.searchsorted(ub[:n_search], safe, side="left")
             idx = np.minimum(idx, n_search - 1)
@@ -446,6 +453,8 @@ class BinMapper:
             if self.missing_type == MISSING_NAN:
                 out[nan_mask] = self.num_bin - 1
         else:
+            out = np.zeros(len(values), dtype=np.int32)
+            nan_mask = np.isnan(values)
             safe = np.where(nan_mask, 0.0, values)
             iv = safe.astype(np.int64)
             if self.categorical_2_bin:

@@ -85,7 +85,24 @@ def load_text_file(
             )
 
     if fmt == "libsvm":
+        has_label = bool(raw_lines) and ":" not in raw_lines[0].split()[0]
+        from . import native
+
+        res = native.parse_libsvm(
+            path, use_header, has_label, model_num_features or 0
+        )
+        if res is not None:
+            return res + (None,)
         return _parse_libsvm(raw_lines, model_num_features) + (None,)
+    from . import native
+
+    res = native.parse_delimited(path, use_header, sep, label_idx)
+    if res is not None:
+        X, y = res
+        names = None
+        if header is not None:
+            names = [h for i, h in enumerate(header) if i != label_idx]
+        return X, y, names
     return _parse_delimited(raw_lines, sep, label_idx, header)
 
 

@@ -164,6 +164,11 @@ class Tree:
         n = X.shape[0]
         if self.num_leaves <= 1:
             return np.zeros(n, dtype=np.int32)
+        from ..native import predict_leaf as _native_predict_leaf
+
+        res = _native_predict_leaf(X, self)
+        if res is not None:
+            return res
         miss_arr = (self.decision_type.astype(np.int32) >> 2) & 3
         dl_arr = (self.decision_type & K_DEFAULT_LEFT_MASK) > 0
         cat_arr = (self.decision_type & K_CATEGORICAL_MASK) > 0
@@ -184,7 +189,9 @@ class Tree:
                 (miss == MISSING_NAN) & np.isnan(fv2)
             )
             num_left = np.where(use_default, dl_arr[nd], fv2 <= thr)
-            fv_int = np.floor(np.nan_to_num(fv, nan=-1.0)).astype(np.int64)
+            # truncation (not floor): matches the scalar path's int(fval), the
+            # native kernel's static_cast, and the reference's CategoricalDecision
+            fv_int = np.trunc(np.nan_to_num(fv, nan=-1.0)).astype(np.int64)
             cat_left = (~nanv) & (fv_int == thr.astype(np.int64))
             go_left = np.where(cat_arr[nd], cat_left, num_left)
             nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])

@@ -1,0 +1,352 @@
+// Native runtime kernels for lightgbm_tpu: text parsing, row binning, and
+// batch tree traversal.
+//
+// TPU-native counterpart of the reference's C++ data path — the CSV/TSV/LibSVM
+// parsers (/root/reference/src/io/parser.{cpp,hpp}), the ValueToBin mapping
+// (include/LightGBM/bin.h:461-496) and the prediction traversal
+// (include/LightGBM/tree.h:216-271, src/application/predictor.hpp). The JAX/XLA
+// core consumes dense arrays; these kernels produce/consume exactly those, so
+// the hot host-side paths (file ingest, binning push, batch predict) run as
+// multithreaded native code instead of Python. Loaded via ctypes (native.py);
+// every entry point has a pure-python fallback.
+//
+// Build: g++ -O3 -fopenmp -shared -fPIC lgbt_native.cpp -o _lgbt_native.so
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <limits>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr double kZeroThreshold = 1e-35;  // meta.h:44
+
+// missing-value markers (io.py _MISSING_TOKENS)
+inline bool IsMissingToken(const char* s, size_t len) {
+  if (len == 0) return true;
+  switch (len) {
+    case 2:
+      return (s[0] == 'N' && s[1] == 'A') || (s[0] == 'n' && s[1] == 'a');
+    case 3:
+      return (strncmp(s, "NaN", 3) == 0) || (strncmp(s, "nan", 3) == 0) ||
+             (strncmp(s, "N/A", 3) == 0);
+    case 4:
+      return (strncmp(s, "null", 4) == 0) || (strncmp(s, "NULL", 4) == 0) ||
+             (strncmp(s, "None", 4) == 0);
+  }
+  return false;
+}
+
+inline const char* TrimLeft(const char* p, const char* end) {
+  while (p < end && (*p == ' ' || *p == '\r')) ++p;
+  return p;
+}
+
+inline const char* TrimRight(const char* p, const char* end) {
+  while (end > p && (end[-1] == ' ' || end[-1] == '\r')) --end;
+  return end;
+}
+
+struct Parsed {
+  std::vector<double> X;  // row-major rows*cols
+  std::vector<double> y;
+  int64_t rows = 0;
+  int64_t cols = 0;
+  int has_label = 0;
+  int bad_token = 0;  // saw a non-numeric, non-missing token
+};
+
+// split file content into line [begin,end) spans, skipping blank lines
+void SplitLines(const std::string& content,
+                std::vector<std::pair<const char*, const char*>>* lines) {
+  const char* p = content.data();
+  const char* end = p + content.size();
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* le = nl ? nl : end;
+    const char* a = p;
+    const char* b = le;
+    while (a < b && (b[-1] == '\r')) --b;
+    bool blank = true;
+    for (const char* q = a; q < b; ++q) {
+      if (*q != ' ' && *q != '\t') { blank = false; break; }
+    }
+    if (!blank) lines->emplace_back(a, b);
+    p = nl ? nl + 1 : end;
+  }
+}
+
+bool ReadFile(const char* path, std::string* out) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return false;
+  fseek(f, 0, SEEK_END);
+  long sz = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  out->resize(sz);
+  size_t got = fread(&(*out)[0], 1, sz, f);
+  fclose(f);
+  out->resize(got);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Text parsing (Parser::CreateParser + CSVParser/TSVParser/LibSVMParser)
+// ---------------------------------------------------------------------------
+
+// sep: ',' or '\t'; label_idx: column of the label, -1 = no label column.
+// Returns a heap Parsed* (free with lgbt_parsed_free), or nullptr on IO error.
+void* lgbt_parse_delimited(const char* path, int skip_first_line, char sep,
+                           int64_t label_idx) {
+  std::string content;
+  if (!ReadFile(path, &content)) return nullptr;
+  std::vector<std::pair<const char*, const char*>> lines;
+  SplitLines(content, &lines);
+  size_t start = 0;
+  if (skip_first_line && !lines.empty()) start = 1;
+  int64_t n = static_cast<int64_t>(lines.size() - start);
+  if (n <= 0) return nullptr;
+
+  // column count from the first data line
+  {
+    const char* a = lines[start].first;
+    const char* b = lines[start].second;
+    int64_t c = 1;
+    for (const char* q = a; q < b; ++q)
+      if (*q == sep) ++c;
+    Parsed* out = new Parsed();
+    out->rows = n;
+    out->cols = (label_idx >= 0) ? c - 1 : c;
+    out->has_label = label_idx >= 0;
+    out->X.assign(static_cast<size_t>(n) * out->cols,
+                  std::numeric_limits<double>::quiet_NaN());
+    if (out->has_label) out->y.assign(n, 0.0);
+
+    int bad = 0;
+#pragma omp parallel for schedule(static) reduction(| : bad)
+    for (int64_t r = 0; r < n; ++r) {
+      const char* p = lines[start + r].first;
+      const char* end = lines[start + r].second;
+      int64_t col = 0;
+      int64_t fcol = 0;
+      while (p <= end && col < c) {
+        const char* tok_end =
+            static_cast<const char*>(memchr(p, sep, end - p));
+        if (!tok_end) tok_end = end;
+        const char* a2 = TrimLeft(p, tok_end);
+        const char* b2 = TrimRight(a2, tok_end);
+        double v;
+        if (IsMissingToken(a2, b2 - a2)) {
+          v = std::numeric_limits<double>::quiet_NaN();
+        } else {
+          char* conv_end = nullptr;
+          std::string tmp(a2, b2 - a2);
+          v = strtod(tmp.c_str(), &conv_end);
+          if (conv_end == tmp.c_str()) {
+            v = std::numeric_limits<double>::quiet_NaN();
+            bad |= 1;  // reported via lgbt_parsed_bad; caller falls back/raises
+          }
+        }
+        if (col == label_idx) {
+          out->y[r] = v;
+        } else if (fcol < out->cols) {
+          out->X[r * out->cols + fcol] = v;
+          ++fcol;
+        }
+        ++col;
+        p = tok_end + 1;
+      }
+    }
+    out->bad_token = bad;
+    return out;
+  }
+}
+
+// LibSVM: optional leading label token (no ':'), then idx:value pairs.
+// min_width pads the matrix to at least that many feature columns.
+void* lgbt_parse_libsvm(const char* path, int skip_first_line, int has_label,
+                        int64_t min_width) {
+  std::string content;
+  if (!ReadFile(path, &content)) return nullptr;
+  std::vector<std::pair<const char*, const char*>> lines;
+  SplitLines(content, &lines);
+  size_t start = skip_first_line && !lines.empty() ? 1 : 0;
+  int64_t n = static_cast<int64_t>(lines.size() - start);
+  if (n <= 0) return nullptr;
+
+  struct Entry {
+    int64_t idx;
+    double val;
+  };
+  std::vector<std::vector<Entry>> rows(n);
+  std::vector<double> labels(has_label ? n : 0);
+  int64_t max_idx = -1;
+
+#pragma omp parallel
+  {
+    int64_t local_max = -1;
+#pragma omp for schedule(static)
+    for (int64_t r = 0; r < n; ++r) {
+      const char* p = lines[start + r].first;
+      const char* end = lines[start + r].second;
+      bool first_tok = true;
+      while (p < end) {
+        while (p < end && (*p == ' ' || *p == '\t')) ++p;
+        if (p >= end) break;
+        const char* te = p;
+        while (te < end && *te != ' ' && *te != '\t') ++te;
+        const char* colon = static_cast<const char*>(memchr(p, ':', te - p));
+        if (first_tok && has_label && !colon) {
+          std::string tmp(p, te - p);
+          labels[r] = strtod(tmp.c_str(), nullptr);
+        } else if (colon) {
+          std::string si(p, colon - p);
+          std::string sv(colon + 1, te - colon - 1);
+          Entry e;
+          e.idx = strtoll(si.c_str(), nullptr, 10);
+          e.val = strtod(sv.c_str(), nullptr);
+          rows[r].push_back(e);
+          if (e.idx > local_max) local_max = e.idx;
+        }
+        first_tok = false;
+        p = te;
+      }
+    }
+#pragma omp critical
+    {
+      if (local_max > max_idx) max_idx = local_max;
+    }
+  }
+
+  Parsed* out = new Parsed();
+  out->rows = n;
+  out->cols = std::max(max_idx + 1, min_width);
+  out->has_label = has_label;
+  out->X.assign(static_cast<size_t>(n) * out->cols, 0.0);
+  out->y = std::move(labels);
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < n; ++r) {
+    for (const auto& e : rows[r]) {
+      if (e.idx >= 0 && e.idx < out->cols) out->X[r * out->cols + e.idx] = e.val;
+    }
+  }
+  return out;
+}
+
+int64_t lgbt_parsed_rows(void* h) { return static_cast<Parsed*>(h)->rows; }
+int64_t lgbt_parsed_cols(void* h) { return static_cast<Parsed*>(h)->cols; }
+int lgbt_parsed_has_label(void* h) { return static_cast<Parsed*>(h)->has_label; }
+int lgbt_parsed_bad(void* h) { return static_cast<Parsed*>(h)->bad_token; }
+
+void lgbt_parsed_copy(void* h, double* X, double* y) {
+  Parsed* p = static_cast<Parsed*>(h);
+  memcpy(X, p->X.data(), p->X.size() * sizeof(double));
+  if (p->has_label && y) memcpy(y, p->y.data(), p->y.size() * sizeof(double));
+}
+
+void lgbt_parsed_free(void* h) { delete static_cast<Parsed*>(h); }
+
+// ---------------------------------------------------------------------------
+// Row binning (BinMapper::ValueToBin, bin.h:461-496; numerical features)
+// ---------------------------------------------------------------------------
+
+// ub: bin upper bounds (length n_search = num_bin minus the NaN bin if any).
+// missing_type: 0 none, 1 zero, 2 nan. Output uint8 (use8) or int32.
+void lgbt_values_to_bins(const double* vals, int64_t n, const double* ub,
+                         int32_t n_search, int32_t num_bin,
+                         int32_t missing_type, uint8_t* out8, int32_t* out32,
+                         int32_t use8) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    double v = vals[i];
+    int32_t bin;
+    if (std::isnan(v)) {
+      if (missing_type == 2) {
+        bin = num_bin - 1;
+        if (use8)
+          out8[i] = static_cast<uint8_t>(bin);
+        else
+          out32[i] = bin;
+        continue;
+      }
+      v = 0.0;
+    }
+    // searchsorted-left over ub[:n_search], clipped
+    int32_t lo = 0, hi = n_search;
+    while (lo < hi) {
+      int32_t mid = (lo + hi) >> 1;
+      if (ub[mid] < v)
+        lo = mid + 1;
+      else
+        hi = mid;
+    }
+    bin = lo < n_search - 1 ? lo : n_search - 1;
+    if (use8)
+      out8[i] = static_cast<uint8_t>(bin);
+    else
+      out32[i] = bin;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Batch tree traversal (Tree::GetLeaf / NumericalDecision, tree.h:216-271)
+// ---------------------------------------------------------------------------
+
+void lgbt_predict_leaf(const double* X, int64_t n, int64_t F,
+                       int32_t num_leaves, const int32_t* split_feature,
+                       const double* threshold, const int8_t* decision_type,
+                       const int32_t* left_child, const int32_t* right_child,
+                       int32_t* out_leaf) {
+  if (num_leaves <= 1) {
+    memset(out_leaf, 0, n * sizeof(int32_t));
+    return;
+  }
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < n; ++r) {
+    const double* row = X + r * F;
+    int32_t node = 0;
+    while (node >= 0) {
+      double fval = row[split_feature[node]];
+      int8_t dt = decision_type[node];
+      int miss = (dt >> 2) & 3;
+      bool go_left;
+      if (dt & 1) {  // categorical one-hot
+        go_left = !std::isnan(fval) &&
+                  static_cast<int64_t>(fval) ==
+                      static_cast<int64_t>(threshold[node]);
+      } else {
+        if (std::isnan(fval) && miss != 2) fval = 0.0;
+        if ((miss == 1 && fval > -kZeroThreshold && fval <= kZeroThreshold) ||
+            (miss == 2 && std::isnan(fval))) {
+          go_left = (dt & 2) != 0;
+        } else {
+          go_left = fval <= threshold[node];
+        }
+      }
+      node = go_left ? left_child[node] : right_child[node];
+    }
+    out_leaf[r] = -(node + 1);
+  }
+}
+
+int lgbt_num_threads() {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"
