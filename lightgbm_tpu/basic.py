@@ -79,10 +79,38 @@ class Dataset:
 
             if is_binary_dataset_file(self.data):
                 self._binned = load_binary_dataset(self.data)
+                md = self._binned.metadata
+                # honor user-supplied metadata overrides exactly like the text
+                # path (Metadata::Init semantics, dataset.h:40-248)
                 if self.label is not None:
-                    self._binned.metadata.label = np.asarray(
-                        self.label, np.float32
-                    ).reshape(-1)
+                    md.label = np.asarray(self.label, np.float32).reshape(-1)
+                if self.weight is not None:
+                    md.weight = np.asarray(self.weight, np.float32).reshape(-1)
+                if self.init_score is not None:
+                    md.init_score = np.asarray(self.init_score, np.float64)
+                if self.group is not None:
+                    from .dataset import Metadata
+
+                    md.query_boundaries = Metadata(
+                        md.num_data, group=np.asarray(self.group)
+                    ).query_boundaries
+                md._validate()
+                if self.reference is not None:
+                    # a binary file carries its own BinMappers; if they differ
+                    # from the reference's, eval-from-bins would silently score
+                    # against the wrong bin boundaries (the text path instead
+                    # re-bins with the reference's mappers)
+                    self.reference.construct(config)
+                    ref = self.reference._binned
+                    ours = [m.to_dict() for m in self._binned.mappers]
+                    theirs = [m.to_dict() for m in ref.mappers]
+                    if ours != theirs:
+                        log.fatal(
+                            "Binary dataset file %r was binned with different "
+                            "BinMappers than its reference dataset; re-save it "
+                            "with reference= set, or pass the raw data instead"
+                            % (self.data,)
+                        )
                 self._config = config
                 return self
             from .io import load_sidecar, load_text_file

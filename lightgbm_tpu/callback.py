@@ -1,14 +1,20 @@
 """Training callbacks.
 
-Mirrors /root/reference/python-package/lightgbm/callback.py: print_evaluation,
-record_evaluation, reset_parameter, early_stopping, with the same CallbackEnv
-contract and EarlyStopException control flow.
+Same public contract as the reference python package's callback module
+(/root/reference/python-package/lightgbm/callback.py): ``print_evaluation``,
+``record_evaluation``, ``reset_parameter`` and ``early_stopping`` factories, a
+``CallbackEnv`` namedtuple handed to each callback, ``order`` /
+``before_iteration`` attributes that engine.train uses for scheduling, and the
+``EarlyStopException`` control-flow channel. The bodies below are this
+package's own implementations of those semantics.
 """
 from __future__ import annotations
 
 import collections
 from typing import Callable, Dict, List
 
+# The tuple layout engine.train builds for every iteration; each evaluation
+# entry is (dataset_name, metric_name, value, is_higher_better[, stdv]).
 CallbackEnv = collections.namedtuple(
     "CallbackEnv",
     ["model", "params", "iteration", "begin_iteration", "end_iteration", "evaluation_result_list"],
@@ -16,92 +22,117 @@ CallbackEnv = collections.namedtuple(
 
 
 class EarlyStopException(Exception):
+    """Raised by a callback to stop boosting at ``best_iteration``."""
+
     def __init__(self, best_iteration: int, best_score) -> None:
         super().__init__()
         self.best_iteration = best_iteration
         self.best_score = best_score
 
 
-def _format_eval_result(value, show_stdv: bool = True) -> str:
-    if len(value) == 4:
-        return "%s's %s: %g" % (value[0], value[1], value[2])
-    if len(value) == 5:
-        if show_stdv:
-            return "%s's %s: %g + %g" % (value[0], value[1], value[2], value[4])
-        return "%s's %s: %g" % (value[0], value[1], value[2])
-    raise ValueError("Wrong metric value")
+def _fmt_entry(entry, show_stdv: bool = True) -> str:
+    """Render one evaluation tuple; cv entries carry a trailing stdv."""
+    if len(entry) not in (4, 5):
+        raise ValueError("Wrong metric value")
+    dataset, metric, value = entry[0], entry[1], entry[2]
+    text = "%s's %s: %g" % (dataset, metric, value)
+    if len(entry) == 5 and show_stdv:
+        text += " + %g" % entry[4]
+    return text
+
+
+def _fmt_line(entries, show_stdv: bool = True) -> str:
+    return "\t".join(_fmt_entry(e, show_stdv) for e in entries)
 
 
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """Log the evaluation results every ``period`` iterations."""
+
     def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list and (env.iteration + 1) % period == 0:
-            result = "\t".join(
-                [_format_eval_result(x, show_stdv) for x in env.evaluation_result_list]
-            )
-            print("[%d]\t%s" % (env.iteration + 1, result))
+        if period <= 0 or not env.evaluation_result_list:
+            return
+        shown_iter = env.iteration + 1
+        if shown_iter % period == 0:
+            print("[%d]\t%s" % (shown_iter, _fmt_line(env.evaluation_result_list, show_stdv)))
 
     _callback.order = 10  # type: ignore[attr-defined]
     return _callback
 
 
 def record_evaluation(eval_result: Dict) -> Callable:
+    """Append each iteration's eval values into ``eval_result`` in place,
+    as {dataset_name: {metric_name: [v0, v1, ...]}}."""
     if not isinstance(eval_result, dict):
         raise TypeError("eval_result should be a dictionary")
     eval_result.clear()
 
-    def _init(env: CallbackEnv) -> None:
-        for data_name, eval_name, _, _ in env.evaluation_result_list:
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
-
     def _callback(env: CallbackEnv) -> None:
-        if not eval_result:
-            _init(env)
-        for data_name, eval_name, result, _ in env.evaluation_result_list:
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
-            eval_result[data_name][eval_name].append(result)
+        for entry in env.evaluation_result_list:
+            dataset, metric, value = entry[0], entry[1], entry[2]
+            series = eval_result.setdefault(dataset, collections.OrderedDict()).setdefault(metric, [])
+            series.append(value)
 
     _callback.order = 20  # type: ignore[attr-defined]
     return _callback
 
 
 def reset_parameter(**kwargs) -> Callable:
+    """Re-set model parameters per boosting round.
+
+    Each keyword maps a parameter name to either a list (one value per round)
+    or a callable ``round_index -> value``.
+    """
+
+    def _resolve(name: str, schedule, round_idx: int, num_rounds: int):
+        if isinstance(schedule, list):
+            if len(schedule) != num_rounds:
+                raise ValueError("Length of list %r has to equal to 'num_boost_round'." % name)
+            return schedule[round_idx]
+        if callable(schedule):
+            return schedule(round_idx)
+        raise ValueError(
+            "Only list and callable values are supported "
+            "as a mapping from boosting round index to new parameter value"
+        )
+
     def _callback(env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(
-                        "Length of list %r has to equal to 'num_boost_round'." % key
-                    )
-                new_param = value[env.iteration - env.begin_iteration]
-            elif callable(value):
-                new_param = value(env.iteration - env.begin_iteration)
-            else:
-                raise ValueError("Only list and callable values are supported as a mapping from boosting round index to new parameter value")
-            new_parameters[key] = new_param
-        if new_parameters:
-            env.model.reset_parameter(new_parameters)
+        round_idx = env.iteration - env.begin_iteration
+        num_rounds = env.end_iteration - env.begin_iteration
+        updates = {
+            name: _resolve(name, schedule, round_idx, num_rounds)
+            for name, schedule in kwargs.items()
+        }
+        if updates:
+            env.model.reset_parameter(updates)
 
     _callback.before_iteration = True  # type: ignore[attr-defined]
     _callback.order = 10  # type: ignore[attr-defined]
     return _callback
 
 
-def early_stopping(stopping_rounds: int, first_metric_only: bool = False, verbose: bool = True) -> Callable:
-    best_score: List = []
-    best_iter: List = []
-    best_score_list: List = []
-    cmp_op: List = []
-    enabled = [True]
+class _EarlyStopper:
+    """State for early_stopping(): per-metric best trackers.
 
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(
-            (boost_alias in env.params and env.params[boost_alias] == "dart")
-            for boost_alias in ("boosting", "boosting_type", "boost")
-        )
-        if not enabled[0]:
+    DART never triggers it (scores of past trees keep changing under drop
+    renormalization), matching the reference's guard.
+    """
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool, verbose: bool) -> None:
+        self.stopping_rounds = stopping_rounds
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.initialized = False
+        self.active = True
+        self.best_value: List[float] = []
+        self.best_iter: List[int] = []
+        self.best_entries: List = []
+        self.improves: List[Callable] = []
+
+    def _setup(self, env: CallbackEnv) -> None:
+        self.initialized = True
+        dart_aliases = ("boosting", "boosting_type", "boost")
+        if any(env.params.get(a) == "dart" for a in dart_aliases):
+            self.active = False
             import warnings
 
             warnings.warn("Early stopping is not available in dart mode")
@@ -110,51 +141,47 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False, verbos
             raise ValueError(
                 "For early stopping, at least one dataset and eval metric is required for evaluation"
             )
-        if verbose:
-            print("Training until validation scores don't improve for %d rounds." % stopping_rounds)
-        for eval_ret in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if eval_ret[3]:  # bigger is better
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda x, y: x > y)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda x, y: x < y)
+        if self.verbose:
+            print("Training until validation scores don't improve for %d rounds." % self.stopping_rounds)
+        for entry in env.evaluation_result_list:
+            higher_better = entry[3]
+            self.best_value.append(float("-inf") if higher_better else float("inf"))
+            self.best_iter.append(0)
+            self.best_entries.append(None)
+            self.improves.append(
+                (lambda new, old: new > old) if higher_better else (lambda new, old: new < old)
+            )
+
+    def _stop(self, i: int, message: str) -> None:
+        if self.verbose:
+            print("%s\n[%d]\t%s" % (message, self.best_iter[i] + 1, _fmt_line(self.best_entries[i])))
+        raise EarlyStopException(self.best_iter[i], self.best_entries[i])
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if not self.initialized:
+            self._setup(env)
+        if not self.active:
+            return
+        for i, entry in enumerate(env.evaluation_result_list):
+            value = entry[2]
+            if self.best_entries[i] is None or self.improves[i](value, self.best_value[i]):
+                self.best_value[i] = value
+                self.best_iter[i] = env.iteration
+                self.best_entries[i] = env.evaluation_result_list
+            elif env.iteration - self.best_iter[i] >= self.stopping_rounds:
+                self._stop(i, "Early stopping, best iteration is:")
+            if env.iteration == env.end_iteration - 1:
+                self._stop(i, "Did not meet early stopping. Best iteration is:")
+            if self.first_metric_only:
+                break
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False, verbose: bool = True) -> Callable:
+    """Stop training when no eval metric improves for ``stopping_rounds``."""
+    stopper = _EarlyStopper(stopping_rounds, first_metric_only, verbose)
 
     def _callback(env: CallbackEnv) -> None:
-        if not cmp_op:
-            _init(env)
-        if not enabled[0]:
-            return
-        for i in range(len(env.evaluation_result_list)):
-            score = env.evaluation_result_list[i][2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            elif env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    print(
-                        "Early stopping, best iteration is:\n[%d]\t%s"
-                        % (
-                            best_iter[i] + 1,
-                            "\t".join([_format_eval_result(x) for x in best_score_list[i]]),
-                        )
-                    )
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            if env.iteration == env.end_iteration - 1:
-                if verbose:
-                    print(
-                        "Did not meet early stopping. Best iteration is:\n[%d]\t%s"
-                        % (
-                            best_iter[i] + 1,
-                            "\t".join([_format_eval_result(x) for x in best_score_list[i]]),
-                        )
-                    )
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            if first_metric_only:
-                break
+        stopper(env)
 
     _callback.order = 30  # type: ignore[attr-defined]
     return _callback

@@ -184,11 +184,15 @@ class GBDT:
             f_orig = int(node["feature"])
             thr = float(node["threshold"])
             if f_orig not in feat_to_used:
+                # abort the ENTIRE remaining BFS, not just this subtree: the
+                # reference sets aborted_last_force_split when a node's split
+                # info is unavailable and stops forcing (ForceSplits,
+                # serial_tree_learner.cpp:597-757)
                 log.warning(
-                    "Forced split on trivial/unknown feature %d ignored "
-                    "(and the rest of its subtree)" % f_orig
+                    "Forced split on trivial/unknown feature %d aborts the "
+                    "remaining forced splits" % f_orig
                 )
-                continue
+                break
             f_used = feat_to_used[f_orig]
             mapper = train_set.mappers[f_used]
             thr_bin = int(mapper.value_to_bin(thr))

@@ -148,6 +148,10 @@ def parse_libsvm(path: str, skip_first_line: bool, has_label: bool, min_width: i
     if not h:
         return None
     try:
+        if lib.lgbt_parsed_bad(h):
+            # e.g. a labeled row starting with idx:value (missing label):
+            # defer to the python parser's error reporting
+            return None
         n = lib.lgbt_parsed_rows(h)
         c = lib.lgbt_parsed_cols(h)
         X = np.empty((n, c), np.float64)

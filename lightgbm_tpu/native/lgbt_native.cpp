@@ -168,6 +168,10 @@ void* lgbt_parse_delimited(const char* path, int skip_first_line, char sep,
         ++col;
         p = tok_end + 1;
       }
+      // column-count mismatch (short row, or extra trailing fields): defer to
+      // the python parser so its error reporting decides, instead of silently
+      // NaN-filling/truncating a malformed file
+      if (col != c || p <= end) bad |= 1;
     }
     out->bad_token = bad;
     return out;
@@ -194,7 +198,8 @@ void* lgbt_parse_libsvm(const char* path, int skip_first_line, int has_label,
   std::vector<double> labels(has_label ? n : 0);
   int64_t max_idx = -1;
 
-#pragma omp parallel
+  int bad = 0;
+#pragma omp parallel reduction(| : bad)
   {
     int64_t local_max = -1;
 #pragma omp for schedule(static)
@@ -211,6 +216,19 @@ void* lgbt_parse_libsvm(const char* path, int skip_first_line, int has_label,
         if (first_tok && has_label && !colon) {
           std::string tmp(p, te - p);
           labels[r] = strtod(tmp.c_str(), nullptr);
+        } else if (first_tok && has_label) {
+          // a labeled file whose row starts with idx:value is missing its
+          // label token — flag so the caller defers to the python parser
+          bad |= 1;
+          if (colon) {
+            std::string si(p, colon - p);
+            std::string sv(colon + 1, te - colon - 1);
+            Entry e;
+            e.idx = strtoll(si.c_str(), nullptr, 10);
+            e.val = strtod(sv.c_str(), nullptr);
+            rows[r].push_back(e);
+            if (e.idx > local_max) local_max = e.idx;
+          }
         } else if (colon) {
           std::string si(p, colon - p);
           std::string sv(colon + 1, te - colon - 1);
@@ -234,6 +252,7 @@ void* lgbt_parse_libsvm(const char* path, int skip_first_line, int has_label,
   out->rows = n;
   out->cols = std::max(max_idx + 1, min_width);
   out->has_label = has_label;
+  out->bad_token = bad;
   out->X.assign(static_cast<size_t>(n) * out->cols, 0.0);
   out->y = std::move(labels);
 #pragma omp parallel for schedule(static)

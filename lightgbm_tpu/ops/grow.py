@@ -544,8 +544,12 @@ def grow_tree(
         if cegb.has_coupled:
             feature_used = feature_used.at[f].set(True)
         if cegb.has_lazy:
-            # rows of the split leaf have now paid for feature f
-            used_in_data = used_in_data.at[f].set(used_in_data[f] | in_leaf)
+            # rows of the split leaf have now paid for feature f — only rows in
+            # the bag: the reference inserts rows from the data partition, i.e.
+            # the bagged subset (serial_tree_learner.cpp:772)
+            used_in_data = used_in_data.at[f].set(
+                used_in_data[f] | (in_leaf & (bag_mask > 0))
+            )
             not_used = (~used_in_data).astype(f32)  # [F, N]
             lmask = (bag_mask * (leaf_id == best_leaf)).astype(f32)
             rmask = (bag_mask * (leaf_id == new_leaf)).astype(f32)

@@ -13,9 +13,10 @@ The histogram layout is ``[num_features, num_bins, 3]`` float32 with channels
 accumulation follows the reference's GPU path, which demonstrates AUC parity with
 single-precision accumulators (docs/GPU-Performance.rst:131-145).
 
-A Pallas kernel with VMEM-resident accumulators replaces this op when available
-(ops/hist_pallas.py); this module is the portable XLA fallback and the reference
-implementation for its tests.
+On TPU the radix-packed Pallas kernel (ops/hist_pallas.py) replaces the
+one-hot contraction — ``leaf_histogram`` dispatches at trace time on the
+default backend; this module remains the portable XLA fallback and the
+reference implementation for the kernel's differential tests.
 """
 from __future__ import annotations
 
@@ -25,6 +26,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from . import hist_pallas
 
 
 def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:
@@ -36,13 +39,17 @@ def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:
     return max(256, (c // 256) * 256)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "chunk", "axis_name"))
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "chunk", "axis_name", "impl", "hist_dtype")
+)
 def leaf_histogram(
     bins: jax.Array,
     values: jax.Array,
     num_bins: int,
     chunk: int = 4096,
     axis_name: Optional[str] = None,
+    impl: str = "auto",
+    hist_dtype: str = "float32",
 ) -> jax.Array:
     """Histogram of per-row values over binned features.
 
@@ -56,10 +63,22 @@ def leaf_histogram(
       axis_name: if set, psum the result over that mesh axis (the data-parallel
         ReduceScatter path of data_parallel_tree_learner.cpp:161 collapsed into
         one XLA collective).
+      impl: "auto" (pallas on TPU, XLA contraction elsewhere), "pallas", "xla".
+      hist_dtype: MXU operand dtype for the pallas kernel — "float32" (exact,
+        matches the XLA fallback) or "bfloat16" (rounds grad/hess operands;
+        accumulation stays f32 — the reference GPU path's single-precision
+        trade, docs/GPU-Performance.rst:131-145).
 
     Returns:
       ``[F, B, K]`` float32 histogram.
     """
+    if impl == "pallas" or (impl == "auto" and hist_pallas.supported(num_bins)):
+        hist = hist_pallas.histogram_pallas(
+            bins, values, num_bins, chunk=max(chunk, 512), dtype_name=hist_dtype
+        )
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        return hist
     F, N = bins.shape
     K = values.shape[1]
     B = num_bins

@@ -1,0 +1,52 @@
+"""JAX platform selection helpers.
+
+This build machine's sitecustomize registers the axon TPU tunnel backend at
+interpreter start and pins ``jax_platforms`` via ``jax.config.update``, which
+overrides the ``JAX_PLATFORMS`` env var. Forcing CPU (for tests and the
+virtual multi-device mesh) therefore needs the in-process config update, and it
+only works before the first backend use. Centralized here so the next jax
+upgrade breaks one place, not several (conftest, __graft_entry__, bench).
+"""
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_devices(n_devices: int = 1):
+    """Pin jax to ``n_devices`` virtual CPU devices; returns the jax module.
+
+    Must run before the jax backend initializes (before the first array op /
+    ``jax.devices()`` call) — afterwards the switch raises and is ignored.
+    jax 0.9 replaced ``--xla_force_host_platform_device_count`` with the
+    ``jax_num_cpu_devices`` config; both knobs are handled here.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"  # belt: fresh interpreters / subprocesses
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=%d" % n_devices
+    )
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if n_devices > 1:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+    except (RuntimeError, ValueError):
+        pass  # backend already up — caller's assert on len(devices) decides
+    return jax
+
+
+def ensure_virtual_devices(n_devices: int):
+    """Make sure >= n devices exist, falling back to virtual CPU devices.
+
+    Single-chip tunnel (axon) or plain CPU platforms cannot provide a
+    multi-device mesh; switch to ``n_devices`` virtual CPU devices instead.
+    A real multi-chip platform configured via JAX_PLATFORMS is left alone.
+    """
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    if n_devices > 1 and plats in ("", "axon", "cpu"):
+        return force_cpu_devices(n_devices)
+
+    import jax
+
+    return jax

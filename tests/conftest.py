@@ -2,23 +2,12 @@
 
 Multi-chip TPU hardware is not available in CI; sharding/collective tests use
 virtual CPU devices, per the project testing strategy (SURVEY.md §4: in-process
-multi-worker simulation the reference lacks).
-
-Note: this environment pins JAX_PLATFORMS=axon (the TPU tunnel) in the profile,
-and jax 0.9 replaced --xla_force_host_platform_device_count with the
-jax_num_cpu_devices config; both are handled here before jax initializes.
+multi-worker simulation the reference lacks). Platform monkey-wiring lives in
+lightgbm_tpu.utils.platform (shared with __graft_entry__ and bench.py).
 """
-import os
+from lightgbm_tpu.utils.platform import force_cpu_devices
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # belt: fresh interpreters
-
-import jax  # noqa: E402
-
-# suspenders: this machine's sitecustomize pre-imports jax with the axon (TPU)
-# platform pinned, so the env var alone is ignored; the config update works as
-# long as the backend hasn't initialized yet.
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+jax = force_cpu_devices(8)
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for the test mesh"
 
 import numpy as np  # noqa: E402
