@@ -142,15 +142,21 @@ class BinnedDataset:
             for j, orig in enumerate(self.used_feature_idx):
                 if orig < len(mono_full):
                     mono[j] = mono_full[orig]
-        return {
+        meta = {
             "num_bin": self.num_bins_per_feature(),
             "missing_type": np.array([m.missing_type for m in self.mappers], dtype=np.int32),
             "default_bin": np.array([m.default_bin for m in self.mappers], dtype=np.int32),
-            "is_categorical": np.array(
-                [m.bin_type == BIN_CATEGORICAL for m in self.mappers], dtype=bool
-            ),
             "monotone": mono,
         }
+        is_cat = np.array(
+            [m.bin_type == BIN_CATEGORICAL for m in self.mappers], dtype=bool
+        )
+        if is_cat.any():
+            # key presence is the static "has categorical features" switch: the
+            # split scan only builds its CTR/one-hot machinery when present, so
+            # all-numerical workloads trace none of it
+            meta["is_categorical"] = is_cat
+        return meta
 
 
 BINARY_MAGIC = "lightgbm_tpu.binned.v1"

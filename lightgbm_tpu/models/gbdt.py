@@ -97,6 +97,11 @@ class GBDT:
             min_data_in_leaf=cfg.min_data_in_leaf,
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
             min_gain_to_split=cfg.min_gain_to_split,
+            max_cat_to_onehot=cfg.max_cat_to_onehot,
+            cat_smooth=cfg.cat_smooth,
+            cat_l2=cfg.cat_l2,
+            max_cat_threshold=cfg.max_cat_threshold,
+            min_data_per_group=cfg.min_data_per_group,
         )
         K = self.num_tree_per_iteration
         init = train_set.metadata.init_score
@@ -855,4 +860,9 @@ class GBDT:
             min_data_in_leaf=cfg.min_data_in_leaf,
             min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
             min_gain_to_split=cfg.min_gain_to_split,
+            max_cat_to_onehot=cfg.max_cat_to_onehot,
+            cat_smooth=cfg.cat_smooth,
+            cat_l2=cfg.cat_l2,
+            max_cat_threshold=cfg.max_cat_threshold,
+            min_data_per_group=cfg.min_data_per_group,
         )

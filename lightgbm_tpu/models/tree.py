@@ -55,6 +55,12 @@ class Tree:
         self.leaf_value: np.ndarray = np.zeros(n, dtype=np.float64)
         self.leaf_count: np.ndarray = np.zeros(n, dtype=np.int64)
         self.shrinkage: float = 1.0
+        # categorical bitset storage (tree.h:372-376): for a categorical node,
+        # threshold_ holds cat_idx; cat_threshold[cat_boundaries[cat_idx] :
+        # cat_boundaries[cat_idx+1]] is a uint32 bitset over raw category VALUES
+        self.num_cat: int = 0
+        self.cat_boundaries: np.ndarray = np.zeros(1, dtype=np.int32)
+        self.cat_threshold: np.ndarray = np.zeros(0, dtype=np.uint32)
 
     # -- construction from device output ---------------------------------
 
@@ -85,18 +91,48 @@ class Tree:
         )
         t.threshold = np.zeros(m, dtype=np.float64)
         t.decision_type = np.zeros(m, dtype=np.int8)
+        cat_member = (
+            np.asarray(tree_arrays.cat_member)[:m]
+            if hasattr(tree_arrays, "cat_member")
+            else None
+        )
+        boundaries = [0]
+        cat_words: List[np.ndarray] = []
         for i in range(m):
             mapper = dataset.mappers[sf_used[i]]
             dt = 0
-            if mapper.bin_type == 1:  # categorical one-hot: store the category VALUE
+            if mapper.bin_type == 1:
+                # categorical bitset node (Tree::SplitCategorical, tree.cpp:69-93):
+                # threshold = cat_idx; member bins -> raw category values -> bitset
                 dt |= K_CATEGORICAL_MASK
-                t.threshold[i] = float(mapper.bin_2_categorical[int(t.threshold_bin[i])])
+                member_bins = (
+                    np.nonzero(cat_member[i])[0]
+                    if cat_member is not None
+                    else [int(t.threshold_bin[i])]
+                )
+                vals = sorted(
+                    int(mapper.bin_2_categorical[b])
+                    for b in member_bins
+                    if b < len(mapper.bin_2_categorical)
+                    and mapper.bin_2_categorical[b] >= 0
+                )
+                words = np.zeros((vals[-1] // 32 + 1) if vals else 1, np.uint32)
+                for v in vals:
+                    words[v // 32] |= np.uint32(1) << np.uint32(v % 32)
+                t.threshold[i] = float(t.num_cat)
+                t.threshold_bin[i] = t.num_cat  # tree.cpp:83 threshold_in_bin_=num_cat_
+                boundaries.append(boundaries[-1] + len(words))
+                cat_words.append(words)
+                t.num_cat += 1
             else:
                 t.threshold[i] = _avoid_inf(mapper.bin_to_value(int(t.threshold_bin[i])))
             if dl[i]:
                 dt |= K_DEFAULT_LEFT_MASK
             dt |= (mapper.missing_type & 3) << 2
             t.decision_type[i] = dt
+        if t.num_cat > 0:
+            t.cat_boundaries = np.asarray(boundaries, np.int32)
+            t.cat_threshold = np.concatenate(cat_words).astype(np.uint32)
         return t
 
     # -- decision helpers -------------------------------------------------
@@ -137,10 +173,30 @@ class Tree:
             active[idx] = ~is_leaf
         return out
 
+    def _in_cat_bitset(self, cat_idx: int, iv: int) -> bool:
+        """FindInBitset over this node's value-space bitset (common.h:943)."""
+        lo = int(self.cat_boundaries[cat_idx])
+        hi = int(self.cat_boundaries[cat_idx + 1])
+        w = iv >> 5
+        if w >= hi - lo:
+            return False
+        return bool((int(self.cat_threshold[lo + w]) >> (iv & 31)) & 1)
+
     def _decide(self, node: int, fval: float) -> bool:
         """NumericalDecision / CategoricalDecision (tree.h:216-271)."""
         miss = self._missing_type(node)
         if self._is_categorical(node):
+            if self.num_cat > 0:
+                if math.isnan(fval):
+                    if miss == MISSING_NAN:
+                        return False  # NaN is always right (tree.h:261)
+                    iv = 0
+                else:
+                    iv = int(fval)
+                    if iv < 0:
+                        return False
+                return self._in_cat_bitset(int(self.threshold[node]), iv)
+            # legacy single-category equality (pre-bitset round-1 model files)
             if math.isnan(fval):
                 return False
             return int(fval) == int(self.threshold[node])
@@ -164,11 +220,12 @@ class Tree:
         n = X.shape[0]
         if self.num_leaves <= 1:
             return np.zeros(n, dtype=np.int32)
-        from ..native import predict_leaf as _native_predict_leaf
+        if self.num_cat == 0:
+            from ..native import predict_leaf as _native_predict_leaf
 
-        res = _native_predict_leaf(X, self)
-        if res is not None:
-            return res
+            res = _native_predict_leaf(X, self)
+            if res is not None:
+                return res
         miss_arr = (self.decision_type.astype(np.int32) >> 2) & 3
         dl_arr = (self.decision_type & K_DEFAULT_LEFT_MASK) > 0
         cat_arr = (self.decision_type & K_CATEGORICAL_MASK) > 0
@@ -191,8 +248,25 @@ class Tree:
             num_left = np.where(use_default, dl_arr[nd], fv2 <= thr)
             # truncation (not floor): matches the scalar path's int(fval), the
             # native kernel's static_cast, and the reference's CategoricalDecision
-            fv_int = np.trunc(np.nan_to_num(fv, nan=-1.0)).astype(np.int64)
-            cat_left = (~nanv) & (fv_int == thr.astype(np.int64))
+            if self.num_cat > 0:
+                # bitset membership; NaN -> right when missing==NaN, else cat 0
+                iv = np.trunc(np.where(nanv, 0.0, fv)).astype(np.int64)
+                cat_idx = np.where(cat_arr[nd], thr, 0.0).astype(np.int64)
+                lo = self.cat_boundaries[cat_idx].astype(np.int64)
+                nwords = self.cat_boundaries[cat_idx + 1].astype(np.int64) - lo
+                w = iv >> 5
+                in_range = (iv >= 0) & (w < nwords)
+                word_idx = np.clip(lo + w, 0, max(len(self.cat_threshold) - 1, 0))
+                words = (
+                    self.cat_threshold[word_idx].astype(np.int64)
+                    if len(self.cat_threshold)
+                    else np.zeros(len(idx), np.int64)
+                )
+                bit = (words >> (iv & 31)) & 1
+                cat_left = in_range & (bit > 0) & ~(nanv & (miss == MISSING_NAN))
+            else:
+                fv_int = np.trunc(np.nan_to_num(fv, nan=-1.0)).astype(np.int64)
+                cat_left = (~nanv) & (fv_int == thr.astype(np.int64))
             go_left = np.where(cat_arr[nd], cat_left, num_left)
             nxt = np.where(go_left, self.left_child[nd], self.right_child[nd])
             node[idx] = nxt
@@ -227,7 +301,7 @@ class Tree:
     def to_string(self) -> str:
         lines = []
         lines.append("num_leaves=%d" % self.num_leaves)
-        lines.append("num_cat=0")
+        lines.append("num_cat=%d" % self.num_cat)
         n1 = self.num_leaves - 1
         lines.append("split_feature=" + " ".join(str(int(v)) for v in self.split_feature[:n1]))
         lines.append("split_gain=" + " ".join(_short_float(v, 8) for v in self.split_gain[:n1]))
@@ -239,6 +313,15 @@ class Tree:
         lines.append("leaf_count=" + " ".join(str(int(v)) for v in self.leaf_count[: self.num_leaves]))
         lines.append("internal_value=" + " ".join(_short_float(v, 8) for v in self.internal_value[:n1]))
         lines.append("internal_count=" + " ".join(str(int(v)) for v in self.internal_count[:n1]))
+        if self.num_cat > 0:
+            # tree.cpp:230-234: bitset words over raw category values
+            lines.append(
+                "cat_boundaries="
+                + " ".join(str(int(v)) for v in self.cat_boundaries[: self.num_cat + 1])
+            )
+            lines.append(
+                "cat_threshold=" + " ".join(str(int(v)) for v in self.cat_threshold)
+            )
         lines.append("shrinkage=" + _short_float(self.shrinkage, 8))
         lines.append("")
         return "\n".join(lines) + "\n"
@@ -270,6 +353,14 @@ class Tree:
         t.leaf_count = arr("leaf_count", np.int64, n)
         t.internal_value = arr("internal_value", np.float64, n1)
         t.internal_count = arr("internal_count", np.int64, n1)
+        t.num_cat = int(kv.get("num_cat", 0))
+        if t.num_cat > 0:
+            t.cat_boundaries = np.asarray(
+                [int(x) for x in kv["cat_boundaries"].split()], np.int32
+            )
+            t.cat_threshold = np.asarray(
+                [int(x) for x in kv["cat_threshold"].split()], np.uint32
+            )
         t.shrinkage = float(kv.get("shrinkage", 1.0))
         return t
 
@@ -281,7 +372,7 @@ class Tree:
             structure = self._node_json(0)
         return {
             "num_leaves": int(self.num_leaves),
-            "num_cat": 0,
+            "num_cat": int(self.num_cat),
             "shrinkage": self.shrinkage,
             "tree_structure": structure,
         }
@@ -295,11 +386,18 @@ class Tree:
                 "leaf_count": int(self.leaf_count[leaf]),
             }
         miss = ["None", "Zero", "NaN"][self._missing_type(index)]
+        if self._is_categorical(index) and self.num_cat > 0:
+            # tree.cpp:265-272: the JSON threshold is the "a||b||c" category list
+            threshold = "||".join(
+                str(v) for v in self.cat_values(int(self.threshold[index]))
+            )
+        else:
+            threshold = float(self.threshold[index])
         return {
             "split_index": int(index),
             "split_feature": int(self.split_feature[index]),
             "split_gain": float(self.split_gain[index]),
-            "threshold": float(self.threshold[index]),
+            "threshold": threshold,
             "decision_type": "==" if self._is_categorical(index) else "<=",
             "default_left": self._default_left(index),
             "missing_type": miss,
@@ -308,6 +406,18 @@ class Tree:
             "left_child": self._node_json(int(self.left_child[index])),
             "right_child": self._node_json(int(self.right_child[index])),
         }
+
+    def cat_values(self, cat_idx: int) -> List[int]:
+        """Decode one categorical node's bitset into its category value list."""
+        lo = int(self.cat_boundaries[cat_idx])
+        hi = int(self.cat_boundaries[cat_idx + 1])
+        out: List[int] = []
+        for w in range(lo, hi):
+            word = int(self.cat_threshold[w])
+            for j in range(32):
+                if (word >> j) & 1:
+                    out.append((w - lo) * 32 + j)
+        return out
 
     def max_depth(self) -> int:
         if self.num_leaves <= 1:

@@ -72,6 +72,7 @@ class TreeArrays(NamedTuple):
     leaf_weight: jax.Array  # [M] f32 (sum of hessians)
     leaf_parent: jax.Array  # [M] int32
     leaf_depth: jax.Array  # [M] int32
+    cat_member: jax.Array  # [M-1, B] bool: left-side bin membership bitsets
 
 
 class GrowState(NamedTuple):
@@ -94,15 +95,19 @@ class GrowState(NamedTuple):
     leaf_phys: jax.Array  # [M] int32 physical rows per leaf ([1] dummy)
 
 
-def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat):
-    """Bin-space split decision (dense_bin.hpp Split / CategoricalDecision)."""
+def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat, member):
+    """Bin-space split decision (dense_bin.hpp Split / CategoricalDecisionInner).
+
+    ``member`` is the split's [B]-bool left-side bin membership (covers both
+    one-hot and CTR-sorted bitset splits); categorical decisions are a pure
+    bitset lookup — no default-direction logic (tree.h:275).
+    """
     go_left = col <= threshold
     is_zero_missing = missing_type == MISSING_ZERO
     is_nan_missing = missing_type == MISSING_NAN
     go_left = jnp.where(is_zero_missing & (col == default_bin), default_left, go_left)
     go_left = jnp.where(is_nan_missing & (col == nan_bin), default_left, go_left)
-    # categorical one-hot: only the chosen category's bin goes left
-    go_left = jnp.where(is_cat, col == threshold, go_left)
+    go_left = jnp.where(is_cat, member[col], go_left)
     return go_left
 
 
@@ -214,7 +219,7 @@ def grow_tree(
         valid = (pos >= off) & (pos < off + cnt)
         return start, off, seg, pos, valid
 
-    def partition_segment(order, begin, pcnt, f, threshold, default_left):
+    def partition_segment(order, begin, pcnt, f, threshold, default_left, member):
         """Stably partition the leaf's segment in-place: left rows first.
 
         Returns (new order, left physical count) — DataPartition::Split
@@ -227,7 +232,7 @@ def grow_tree(
             def branch(order, begin, pcnt, f, threshold, default_left):
                 start, off, seg, pos, valid = _segment_slice(order, begin, pcnt, S)
                 colv = bins[f, seg].astype(jnp.int32)
-                gl = _decision_go_left(colv, threshold, default_left, miss, dbin, nanb, iscat)
+                gl = _decision_go_left(colv, threshold, default_left, miss, dbin, nanb, iscat, member)
                 # stable 4-class sort keeps out-of-segment rows in place:
                 # [pre-segment | left | right | post-segment]
                 klass = jnp.where(
@@ -361,15 +366,17 @@ def grow_tree(
         unused0 = jnp.zeros((M, F), f32)
 
     def expand(res: SplitResult, idx: int) -> SplitResult:
-        """Scatter a single-leaf SplitResult into [M]-sized per-leaf arrays."""
-        return SplitResult(
-            *[
-                jnp.full((M,), _field_init(name), dtype=getattr(res, name).dtype)
+        """Scatter a single-leaf SplitResult into [M]-leading per-leaf arrays."""
+
+        def one(name):
+            v = jnp.asarray(getattr(res, name))
+            return (
+                jnp.full((M,) + v.shape, _field_init(name), dtype=v.dtype)
                 .at[idx]
-                .set(getattr(res, name))
-                for name in SplitResult._fields
-            ]
-        )
+                .set(v)
+            )
+
+        return SplitResult(*[one(name) for name in SplitResult._fields])
 
     def _field_init(name):
         return -jnp.inf if name == "gain" else 0
@@ -391,6 +398,7 @@ def grow_tree(
         leaf_weight=jnp.zeros((M,), f32).at[0].set(root_h),
         leaf_parent=jnp.full((M,), -1, jnp.int32),
         leaf_depth=jnp.zeros((M,), jnp.int32),  # root depth 0 (tree.cpp ctor)
+        cat_member=jnp.zeros((M - 1, B), bool),
     )
 
     hist0 = jnp.zeros((M, F, B, 3), f32).at[0].set(root_hist)
@@ -447,7 +455,8 @@ def grow_tree(
             pbegin = s.leaf_begin[best_leaf]
             pphys = s.leaf_phys[best_leaf]
             order, left_phys = partition_segment(
-                s.order, pbegin, pphys, f, rec.threshold, rec.default_left
+                s.order, pbegin, pphys, f, rec.threshold, rec.default_left,
+                rec.cat_bitset,
             )
             right_phys = pphys - left_phys
             leaf_begin = s.leaf_begin.at[new_leaf].set(pbegin + left_phys)
@@ -464,6 +473,7 @@ def grow_tree(
                 default_bin_arr[f],
                 num_bin_arr[f] - 1,
                 is_cat_arr[f],
+                rec.cat_bitset,
             )
             in_leaf = s.leaf_id == best_leaf
             leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, s.leaf_id)
@@ -516,6 +526,7 @@ def grow_tree(
             .set(depth_child)
             .at[new_leaf]
             .set(depth_child),
+            cat_member=t.cat_member.at[node].set(rec.cat_bitset),
         )
 
         # ---- leaf aggregates ---------------------------------------------

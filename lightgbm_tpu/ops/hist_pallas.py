@@ -62,7 +62,7 @@ def _kernel(bins_ref, vt_ref, out_ref, *, hi_n: int, dtype):
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    b = bins_ref[0, :].astype(jnp.int32)  # [C]
+    b = bins_ref[0, 0, :].astype(jnp.int32)  # [C]
     vt = vt_ref[:]  # [K, C] f32
     k_n, C = vt.shape
 
@@ -82,6 +82,13 @@ def _kernel(bins_ref, vt_ref, out_ref, *, hi_n: int, dtype):
         oh_lo,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
+        # f32 operands need the 3-pass bf16 decomposition on the MXU; the
+        # default single pass silently rounds to bf16 precision
+        precision=(
+            jax.lax.Precision.HIGHEST
+            if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT
+        ),
     )
 
 
@@ -103,7 +110,13 @@ def histogram_pallas(
     HI = _hi_for(B)
     dtype = jnp.dtype(dtype_name)
 
-    C = min(chunk, max(512, N))
+    # Mosaic block rule: the last two block dims must each be divisible by
+    # (8, 128) or equal the full array dim. C is therefore forced to a
+    # multiple of 512, and bins gets a singleton middle axis so its block's
+    # last-two dims are (1, C) against array dims (1, N) — the feature axis
+    # becomes a leading grid axis, which has no tiling constraint.
+    C = min(max(chunk, 512), max(512, N))
+    C = max(512, (C // 512) * 512)
     if N % C != 0:
         pad = (-N) % C
         # zero values contribute nothing; padded rows land in bin 0 with v=0
@@ -113,13 +126,14 @@ def histogram_pallas(
     n_chunks = N // C
 
     vt = values.T  # [K, N] — lane axis on rows for clean (8,128) tiling
+    bins3 = bins.reshape(F, 1, N)
 
     kernel = functools.partial(_kernel, hi_n=HI, dtype=dtype)
     out = pl.pallas_call(
         kernel,
         grid=(F, n_chunks),
         in_specs=[
-            pl.BlockSpec((1, C), lambda f, c: (f, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, C), lambda f, c: (f, 0, c), memory_space=pltpu.VMEM),
             pl.BlockSpec((K, C), lambda f, c: (0, c), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
@@ -127,7 +141,7 @@ def histogram_pallas(
         ),
         out_shape=jax.ShapeDtypeStruct((F, HI * K, LO), jnp.float32),
         interpret=interpret,
-    )(bins, vt)
+    )(bins3, vt)
 
     # [F, HI*K, LO] -> [F, HI, K, LO] -> [F, HI, LO, K] -> [F, HI*LO, K] -> [F, B, K]
     hist = out.reshape(F, HI, K, LO).transpose(0, 1, 3, 2).reshape(F, HI * LO, K)
@@ -136,7 +150,8 @@ def histogram_pallas(
 
 def supported(num_bins: int, backend: Optional[str] = None) -> bool:
     """True when the pallas kernel can serve this shape on this backend."""
-    if num_bins > 128 * LO // 3:
+    # must match _hi_for's constraint: ceil(B/LO) * 3 rows <= 128
+    if -(-num_bins // LO) * 3 > 128:
         return False
     if backend is None:
         try:

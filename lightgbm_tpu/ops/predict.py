@@ -34,6 +34,7 @@ class PredictTree(NamedTuple):
     default_bin: jax.Array  # [M-1] int32
     nan_bin: jax.Array  # [M-1] int32
     is_cat: jax.Array  # [M-1] bool
+    cat_member: jax.Array  # [M-1, B] bool left-side bin membership bitsets
     num_leaves: jax.Array  # scalar int32
 
 
@@ -57,6 +58,7 @@ def make_predict_tree(tree, feature_meta) -> PredictTree:
         default_bin=feature_meta["default_bin"].astype(jnp.int32)[f],
         nan_bin=num_bin[f] - 1,
         is_cat=is_cat_nodes,
+        cat_member=tree.cat_member,
         num_leaves=tree.num_leaves.astype(jnp.int32),
     )
 
@@ -84,7 +86,8 @@ def tree_predict_leaf(bins_t: jax.Array, tree: PredictTree) -> jax.Array:
         go_left = col <= thr
         go_left = jnp.where((miss == MISSING_ZERO) & (col == dbin), dl, go_left)
         go_left = jnp.where((miss == MISSING_NAN) & (col == nbin), dl, go_left)
-        go_left = jnp.where(tree.is_cat[nsafe], col == thr, go_left)
+        # categorical: bitset membership (CategoricalDecisionInner, tree.h:275)
+        go_left = jnp.where(tree.is_cat[nsafe], tree.cat_member[nsafe, col], go_left)
         nxt = jnp.where(go_left, tree.left_child[nsafe], tree.right_child[nsafe])
         node = jnp.where(active, nxt, node)
         return node, active

@@ -46,6 +46,13 @@ class SplitParams(NamedTuple):
     min_data_in_leaf: int
     min_sum_hessian_in_leaf: float
     min_gain_to_split: float
+    # categorical split knobs (config.h:510-540); trailing defaults keep older
+    # positional constructions working
+    max_cat_to_onehot: int = 4
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    min_data_per_group: int = 100
 
 
 class CegbParams(NamedTuple):
@@ -110,6 +117,11 @@ class SplitResult(NamedTuple):
     right_count: jax.Array
     left_output: jax.Array
     right_output: jax.Array
+    # categorical bitset split (SplitInfo::cat_threshold, split_info.hpp):
+    # num_cat = 0 for numerical; >= 1 means "row goes left iff its bin is a
+    # member of cat_bitset" (CategoricalDecisionInner, tree.h:275)
+    num_cat: Any = 0  # scalar int32
+    cat_bitset: Any = False  # [B] bool bin membership
 
 
 class _ScanOut(NamedTuple):
@@ -126,9 +138,13 @@ class _ScanOut(NamedTuple):
     lg_neg: jax.Array
     lh_neg: jax.Array
     lc_neg: jax.Array
-    cat_lg: jax.Array
-    cat_lh: jax.Array
-    cat_lc: jax.Array
+    # categorical best per feature (already reduced over candidates)
+    cat_lg: jax.Array  # [F] left sums of the best categorical candidate
+    cat_lh: jax.Array  # [F] (includes +kEpsilon)
+    cat_lc: jax.Array  # [F]
+    cat_member: jax.Array  # [F, B] bool: left-side bin membership
+    cat_ncat: jax.Array  # [F] int32 number of categories on the left
+    cat_use_ctr: jax.Array  # [F] bool: True when the CTR path (cat_l2) won
     min_gain_shift: jax.Array
 
 
@@ -221,36 +237,149 @@ def _scan_candidates(
     valid_neg &= ~(skip_def[:, None] & (thresholds == default_bin[:, None] - 1))
     gains_neg = gains_for(lg_neg, lh_neg, rg_neg_raw, rh_neg, lc_neg, rc_neg, valid_neg)
 
-    # ---- categorical one-hot candidates ---------------------------------
-    # FindBestThresholdCategorical one-hot branch (feature_histogram.hpp:139-172):
-    # left = the single bin t, right = rest; no monotone; default_left=False.
+    # ---- categorical candidates -----------------------------------------
+    # FindBestThresholdCategorical (feature_histogram.hpp:118-279). Features
+    # with num_bin <= max_cat_to_onehot use the one-hot branch (left = one
+    # bin); the rest use the CTR-sorted many-vs-many branch: bins with count
+    # >= cat_smooth, sorted by sum_grad/(sum_hess+cat_smooth), scanned from
+    # both ends with cat_l2 regularization and min_data_per_group grouping.
     is_cat = feature_meta.get("is_categorical")
-    if is_cat is None:
+    has_cat = is_cat is not None  # key presence = static trace-time switch
+    if not has_cat:
         is_cat = jnp.zeros((F,), bool)
+        zf = jnp.zeros((F,), hist.dtype)
+        cat_lg = cat_lh = cat_lc = zf
+        cat_member = jnp.zeros((F, B), bool)
+        cat_ncat = jnp.zeros((F,), jnp.int32)
+        cat_use_ctr = jnp.zeros((F,), bool)
+        g_cat = jnp.full((F,), K_MIN_SCORE, hist.dtype)
+        t_cat = jnp.zeros((F,), jnp.int32)
     else:
         is_cat = is_cat.astype(bool)
-    cat_lg = hist[:, :, 0]
-    cat_lh_raw = hist[:, :, 1]
-    cat_lc = hist[:, :, 2]
-    cat_lh = cat_lh_raw + K_EPSILON
-    cat_rg = sum_grad - cat_lg
-    cat_rh = sum_hess_eff - cat_lh
-    cat_rc = num_data - cat_lc
-    used_bin = num_bin + jnp.where(missing == MISSING_NONE, 0, -1)  # [F]
-    cat_valid = thresholds < used_bin[:, None]
-    cat_valid &= (cat_lc >= p.min_data_in_leaf) & (cat_rc >= p.min_data_in_leaf)
-    cat_valid &= (cat_lh_raw >= p.min_sum_hessian_in_leaf) & (
-        cat_rh >= p.min_sum_hessian_in_leaf
-    )
-    cat_lo = _leaf_output_constrained(cat_lg, cat_lh, p, min_constraint, max_constraint)
-    cat_ro = _leaf_output_constrained(cat_rg, cat_rh, p, min_constraint, max_constraint)
-    cat_g = _gain_given_output(cat_lg, cat_lh, cat_lo, p) + _gain_given_output(
-        cat_rg, cat_rh, cat_ro, p
-    )
-    cat_valid &= cat_g > min_gain_shift
-    gains_cat = jnp.where(cat_valid, cat_g, K_MIN_SCORE)
-    t_cat = jnp.argmax(gains_cat, axis=1)  # smallest t wins ties
-    g_cat = jnp.take_along_axis(gains_cat, t_cat[:, None], axis=1)[:, 0]
+        used_bin = num_bin + jnp.where(missing == MISSING_NONE, 0, -1)  # [F]
+
+        # one-hot branch: left = the single bin t, right = rest; default_left=False
+        oh_lg = hist[:, :, 0]
+        oh_lh_raw = hist[:, :, 1]
+        oh_lc = hist[:, :, 2]
+        oh_lh = oh_lh_raw + K_EPSILON
+        oh_rg = sum_grad - oh_lg
+        oh_rh = sum_hess_eff - oh_lh
+        oh_rc = num_data - oh_lc
+        oh_valid = thresholds < used_bin[:, None]
+        oh_valid &= (oh_lc >= p.min_data_in_leaf) & (oh_rc >= p.min_data_in_leaf)
+        oh_valid &= (oh_lh_raw >= p.min_sum_hessian_in_leaf) & (
+            oh_rh >= p.min_sum_hessian_in_leaf
+        )
+        oh_lo = _leaf_output_constrained(oh_lg, oh_lh, p, min_constraint, max_constraint)
+        oh_ro = _leaf_output_constrained(oh_rg, oh_rh, p, min_constraint, max_constraint)
+        oh_g = _gain_given_output(oh_lg, oh_lh, oh_lo, p) + _gain_given_output(
+            oh_rg, oh_rh, oh_ro, p
+        )
+        oh_valid &= oh_g > min_gain_shift
+        gains_oh = jnp.where(oh_valid, oh_g, K_MIN_SCORE)
+        t_oh = jnp.argmax(gains_oh, axis=1).astype(jnp.int32)  # smallest t wins ties
+        g_oh = jnp.take_along_axis(gains_oh, t_oh[:, None], axis=1)[:, 0]
+        oh_sel = t_oh[:, None]
+        oh_best_lg = jnp.take_along_axis(oh_lg, oh_sel, axis=1)[:, 0]
+        oh_best_lh = jnp.take_along_axis(oh_lh, oh_sel, axis=1)[:, 0]
+        oh_best_lc = jnp.take_along_axis(oh_lc, oh_sel, axis=1)[:, 0]
+
+        # CTR-sorted branch (cat_l2 folded into l2 for gains AND leaf outputs)
+        p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+        cnt_b = hist[:, :, 2]
+        bin_valid = (bins < used_bin[:, None]) & (cnt_b >= p.cat_smooth)  # [F, B]
+        ctr = hist[:, :, 0] / (hist[:, :, 1] + p.cat_smooth)
+        sort_idx = jnp.argsort(jnp.where(bin_valid, ctr, jnp.inf), axis=1)  # [F, B]
+        rank = jnp.argsort(sort_idx, axis=1)  # inverse permutation: bin -> position
+        used_ctr = jnp.sum(bin_valid, axis=1).astype(jnp.int32)  # [F]
+        max_num_cat = jnp.minimum(p.max_cat_threshold, (used_ctr + 1) // 2)  # [F]
+        i_pos = jnp.arange(B, dtype=jnp.int32)[None, :]
+
+        hist_sorted = jnp.take_along_axis(hist, sort_idx[:, :, None], axis=1)
+
+        def _ctr_dir(h_dir):
+            """Candidate gains for one traversal direction over the sorted bins.
+
+            ``h_dir`` is [F, B, 3] in traversal order; candidate i takes the first
+            i+1 bins as the left side. min_data_per_group grouping is sequential
+            (the group counter resets only on an emitted candidate) -> lax.scan.
+            """
+            lg = jnp.cumsum(h_dir[:, :, 0], axis=1)
+            lh = jnp.cumsum(h_dir[:, :, 1], axis=1) + K_EPSILON
+            lc = jnp.cumsum(h_dir[:, :, 2], axis=1)
+            rg = sum_grad - lg
+            rh = sum_hess - lh
+            rc = num_data - lc
+            left_ok = (lc >= p.min_data_in_leaf) & (lh >= p.min_sum_hessian_in_leaf)
+            right_ok = (
+                (rc >= p.min_data_in_leaf)
+                & (rc >= p.min_data_per_group)
+                & (rh >= p.min_sum_hessian_in_leaf)
+            )
+
+            def step(gcnt, x):
+                c_i, ok_i = x
+                gcnt = gcnt + c_i
+                emit = ok_i & (gcnt >= p.min_data_per_group)
+                return jnp.where(emit, 0.0, gcnt), emit
+
+            _, emit = jax.lax.scan(
+                step,
+                jnp.zeros((F,), hist.dtype),
+                (h_dir[:, :, 2].T, (left_ok & right_ok).T),
+            )
+            emit = emit.T  # [F, B]
+            lo = _leaf_output_constrained(lg, lh, p_cat, min_constraint, max_constraint)
+            ro = _leaf_output_constrained(rg, rh, p_cat, min_constraint, max_constraint)
+            g = _gain_given_output(lg, lh, lo, p_cat) + _gain_given_output(
+                rg, rh, ro, p_cat
+            )
+            ok = emit & (i_pos < used_ctr[:, None]) & (i_pos < max_num_cat[:, None])
+            ok &= g > min_gain_shift
+            return jnp.where(ok, g, K_MIN_SCORE), lg, lh, lc
+
+        g_fwd, lg_fwd, lh_fwd, lc_fwd = _ctr_dir(hist_sorted)
+        # reverse traversal starts at sorted position used_ctr-1 and walks down
+        rev_pos = jnp.clip(used_ctr[:, None] - 1 - i_pos, 0, B - 1)
+        g_rev, lg_rev, lh_rev, lc_rev = _ctr_dir(
+            jnp.take_along_axis(hist_sorted, rev_pos[:, :, None], axis=1)
+        )
+        # candidate order = (dir=+1, i asc) then (dir=-1, i asc), strict-> updates:
+        # first max of the concatenation reproduces the reference's tie-breaking
+        g_all = jnp.concatenate([g_fwd, g_rev], axis=1)  # [F, 2B]
+        j_best = jnp.argmax(g_all, axis=1).astype(jnp.int32)
+        g_ctr = jnp.take_along_axis(g_all, j_best[:, None], axis=1)[:, 0]
+        fwd_won = j_best < B
+        i_best = jnp.where(fwd_won, j_best, j_best - B)
+        i_sel = i_best[:, None]
+
+        def _pick_dir(a_fwd, a_rev):
+            return jnp.where(
+                fwd_won,
+                jnp.take_along_axis(a_fwd, i_sel, axis=1)[:, 0],
+                jnp.take_along_axis(a_rev, i_sel, axis=1)[:, 0],
+            )
+
+        ctr_lg = _pick_dir(lg_fwd, lg_rev)
+        ctr_lh = _pick_dir(lh_fwd, lh_rev)
+        ctr_lc = _pick_dir(lc_fwd, lc_rev)
+        member_ctr = jnp.where(
+            fwd_won[:, None],
+            rank <= i_sel,
+            rank >= (used_ctr[:, None] - 1 - i_sel),
+        ) & bin_valid
+
+        # per-feature winner: one-hot vs CTR is decided by num_bin, not by gain
+        use_onehot = num_bin <= p.max_cat_to_onehot  # [F]
+        g_cat = jnp.where(use_onehot, g_oh, g_ctr)
+        t_cat = jnp.where(use_onehot, t_oh, i_best)
+        cat_member = jnp.where(use_onehot[:, None], bins == t_oh[:, None], member_ctr)
+        cat_ncat = jnp.where(use_onehot, 1, i_best + 1).astype(jnp.int32)
+        cat_lg = jnp.where(use_onehot, oh_best_lg, ctr_lg)
+        cat_lh = jnp.where(use_onehot, oh_best_lh, ctr_lh)
+        cat_lc = jnp.where(use_onehot, oh_best_lc, ctr_lc)
+        cat_use_ctr = ~use_onehot
 
     # ---- per-feature best with scan-order tie-breaking -------------------
     # dir=-1 prefers the LARGEST threshold among equal gains.
@@ -269,7 +398,7 @@ def _scan_candidates(
     two_bin_nan = (missing == MISSING_NAN) & ~multi_bin
     dl_best = jnp.where(two_bin_nan, False, dl_best)
 
-    # categorical features use the one-hot candidates exclusively
+    # categorical features use the categorical candidates exclusively
     g_best = jnp.where(is_cat, g_cat, g_best)
     t_best = jnp.where(is_cat, t_cat, t_best)
     dl_best = jnp.where(is_cat, False, dl_best)
@@ -290,6 +419,9 @@ def _scan_candidates(
         cat_lg=cat_lg,
         cat_lh=cat_lh,
         cat_lc=cat_lc,
+        cat_member=cat_member,
+        cat_ncat=cat_ncat,
+        cat_use_ctr=cat_use_ctr,
         min_gain_shift=min_gain_shift,
     )
 
@@ -368,6 +500,8 @@ def gather_info_for_threshold(
         right_count=rc,
         left_output=left_out,
         right_output=right_out,
+        num_cat=jnp.where(is_cat, 1, 0).astype(jnp.int32),
+        cat_bitset=bins == threshold,
     )
 
 
@@ -414,9 +548,8 @@ def find_best_split(
     (g_best, t_best, dl_best, use_pos, is_cat) = (
         sc.g_best, sc.t_best, sc.dl_best, sc.use_pos, sc.is_cat,
     )
-    (lg_pos, lh_pos, lc_pos, lg_neg, lh_neg, lc_neg, cat_lg, cat_lh, cat_lc) = (
+    (lg_pos, lh_pos, lc_pos, lg_neg, lh_neg, lc_neg) = (
         sc.lg_pos, sc.lh_pos, sc.lc_pos, sc.lg_neg, sc.lh_neg, sc.lc_neg,
-        sc.cat_lg, sc.cat_lh, sc.cat_lc,
     )
     min_gain_shift = sc.min_gain_shift
 
@@ -434,25 +567,49 @@ def find_best_split(
     has_split = best_gain_raw > K_MIN_SCORE
 
     # Recover the chosen candidate's side sums.
-    best_is_cat = is_cat[best_f]
+    has_cat = "is_categorical" in feature_meta  # static: no cat -> no cat code
+    best_is_cat = is_cat[best_f] if has_cat else jnp.asarray(False)
 
-    def pick(arr_pos, arr_neg, arr_cat):
+    def pick(arr_pos, arr_neg, cat_v):
         pos_v = arr_pos[best_f, best_t]
         neg_v = arr_neg[best_f, best_t]
-        cat_v = arr_cat[best_f, best_t]
-        return jnp.where(best_is_cat, cat_v, jnp.where(use_pos[best_f], pos_v, neg_v))
+        num_v = jnp.where(use_pos[best_f], pos_v, neg_v)
+        return jnp.where(best_is_cat, cat_v, num_v) if has_cat else num_v
 
-    left_g = pick(lg_pos, lg_neg, cat_lg)
-    left_h = pick(lh_pos, lh_neg, cat_lh)  # includes +eps
-    left_c = pick(lc_pos, lc_neg, cat_lc)
+    left_g = pick(lg_pos, lg_neg, sc.cat_lg[best_f])
+    left_h = pick(lh_pos, lh_neg, sc.cat_lh[best_f])  # includes +eps
+    left_c = pick(lc_pos, lc_neg, sc.cat_lc[best_f])
     right_g = sum_grad - left_g
     right_h = sum_hess_eff - left_h
     right_c = num_data - left_c
 
     left_out = _leaf_output_constrained(left_g, left_h, p, min_constraint, max_constraint)
     right_out = _leaf_output_constrained(right_g, right_h, p, min_constraint, max_constraint)
+    if has_cat and p.cat_l2 != 0.0:
+        # the CTR branch regularizes leaf outputs with lambda_l2 + cat_l2
+        # (feature_histogram.hpp:246-255 passes the augmented l2)
+        p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+        use_ctr = best_is_cat & sc.cat_use_ctr[best_f]
+        left_out = jnp.where(
+            use_ctr,
+            _leaf_output_constrained(left_g, left_h, p_cat, min_constraint, max_constraint),
+            left_out,
+        )
+        right_out = jnp.where(
+            use_ctr,
+            _leaf_output_constrained(right_g, right_h, p_cat, min_constraint, max_constraint),
+            right_out,
+        )
 
     gain = jnp.where(has_split, best_gain_raw - min_gain_shift, K_MIN_SCORE)
+    B = hist.shape[1]
+    bins_r = jnp.arange(B, dtype=jnp.int32)
+    if has_cat:
+        num_cat = jnp.where(best_is_cat, sc.cat_ncat[best_f], 0)
+        cat_bitset = jnp.where(best_is_cat, sc.cat_member[best_f], bins_r == best_t)
+    else:
+        num_cat = jnp.int32(0)
+        cat_bitset = bins_r == best_t
     return SplitResult(
         gain=gain.astype(jnp.float32),
         feature=jnp.where(has_split, best_f.astype(jnp.int32), -1),
@@ -466,4 +623,6 @@ def find_best_split(
         right_count=right_c,
         left_output=left_out,
         right_output=right_out,
+        num_cat=num_cat.astype(jnp.int32),
+        cat_bitset=cat_bitset,
     )

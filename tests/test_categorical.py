@@ -1,0 +1,205 @@
+"""Sorted-CTR categorical splits + bitset thresholds.
+
+Covers FindBestThresholdCategorical's many-vs-many branch
+(/root/reference/src/treelearner/feature_histogram.hpp:118-279), bitset
+storage/serialization (tree.cpp:69-93, 230-234), and CategoricalDecision
+prediction semantics (include/LightGBM/tree.h:255-271).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _auc(y, pred):
+    n = len(y)
+    order = np.argsort(pred)
+    ranks = np.empty(n)
+    ranks[order] = np.arange(n)
+    pos = y == 1
+    np_, nn = pos.sum(), n - pos.sum()
+    return (ranks[pos].sum() - np_ * (np_ - 1) / 2) / (np_ * nn)
+
+
+@pytest.fixture(scope="module")
+def cat_data():
+    rng = np.random.RandomState(7)
+    n = 4000
+    cat = rng.randint(0, 30, n)
+    rate = (cat * 37 % 30) / 30.0
+    y = (rng.rand(n) < rate).astype(np.float64)
+    X = np.column_stack([cat.astype(np.float64), rng.randn(n)])
+    return X, y
+
+
+PARAMS = {
+    "objective": "binary",
+    "num_leaves": 15,
+    "min_data_in_leaf": 20,
+    "learning_rate": 0.2,
+    "verbose": -1,
+}
+
+
+def test_ctr_split_beats_onehot(cat_data):
+    """A 30-category feature needs many-vs-many splits; forcing one-hot
+    (max_cat_to_onehot > cardinality) must do strictly worse."""
+    X, y = cat_data
+    bst = lgb.train(
+        PARAMS, lgb.Dataset(X, label=y, categorical_feature=[0]), num_boost_round=10
+    )
+    bst_oh = lgb.train(
+        dict(PARAMS, max_cat_to_onehot=1000),
+        lgb.Dataset(X, label=y, categorical_feature=[0]),
+        num_boost_round=10,
+    )
+    auc_ctr = _auc(y, bst.predict(X))
+    auc_oh = _auc(y, bst_oh.predict(X))
+    assert auc_ctr > auc_oh
+    assert auc_ctr > 0.8
+    # the CTR trees actually contain multi-category bitset nodes
+    trees = bst._gbdt.trees()
+    assert any(t.num_cat > 0 for t in trees)
+    multi = [
+        len(t.cat_values(int(t.threshold[i])))
+        for t in trees
+        for i in range(t.num_leaves - 1)
+        if (t.decision_type[i] & 1) and t.num_cat > 0
+    ]
+    assert max(multi) > 1, "expected a many-vs-many categorical split"
+
+
+def test_bitset_roundtrip(cat_data):
+    """Text serialization of cat_boundaries/cat_threshold round-trips bitwise."""
+    X, y = cat_data
+    bst = lgb.train(
+        PARAMS, lgb.Dataset(X, label=y, categorical_feature=[0]), num_boost_round=5
+    )
+    s = bst.model_to_string()
+    assert "num_cat=" in s and "cat_boundaries=" in s and "cat_threshold=" in s
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=0, atol=0)
+    assert bst2.model_to_string() == s
+
+
+def test_categorical_decision_semantics(cat_data):
+    """NaN -> right when missing_type==NaN; negative -> right; value not in any
+    bin's bitset -> right (tree.h:255-271)."""
+    X, y = cat_data
+    X = X.copy()
+    X[::7, 0] = np.nan  # force missing_type NaN on the categorical feature
+    bst = lgb.train(
+        PARAMS, lgb.Dataset(X, label=y, categorical_feature=[0]), num_boost_round=5
+    )
+    probe = np.array(
+        [[np.nan, 0.0], [-3.0, 0.0], [10_000.0, 0.0], [5.0, 0.0]], np.float64
+    )
+    pred = bst.predict(probe)
+    assert np.all(np.isfinite(pred))
+    # scalar vs vectorized traversal agree on the edge values
+    trees = bst._gbdt.trees()
+    for t in trees[:2]:
+        slow = t.predict_leaf(probe)
+        fast = t.predict_leaf_fast(probe)
+        np.testing.assert_array_equal(slow, fast)
+
+
+def test_cat_smooth_filters_rare_categories():
+    """Bins with count < cat_smooth are excluded from the CTR sort
+    (feature_histogram.hpp:172-175)."""
+    rng = np.random.RandomState(3)
+    n = 2000
+    # category 50 appears ~4 times with a perfectly predictive label
+    cat = rng.randint(0, 8, n)
+    rare = rng.choice(n, 4, replace=False)
+    cat[rare] = 50
+    y = (cat % 2).astype(np.float64)
+    y[rare] = 1.0
+    X = np.column_stack([cat.astype(np.float64), rng.randn(n)])
+    bst = lgb.train(
+        dict(PARAMS, max_cat_to_onehot=2, cat_smooth=10.0),
+        lgb.Dataset(X, label=y, categorical_feature=[0]),
+        num_boost_round=3,
+    )
+    # no bitset may contain the rare category: its count is under cat_smooth
+    for t in bst._gbdt.trees():
+        for ci in range(t.num_cat):
+            assert 50 not in t.cat_values(ci)
+
+
+def test_max_cat_threshold_caps_left_size():
+    rng = np.random.RandomState(11)
+    n = 6000
+    cat = rng.randint(0, 64, n)
+    y = ((cat * 13 % 64) < 32).astype(np.float64)
+    X = cat.astype(np.float64)[:, None]
+    bst = lgb.train(
+        dict(PARAMS, max_cat_threshold=4, max_cat_to_onehot=2),
+        lgb.Dataset(X, label=y, categorical_feature=[0]),
+        num_boost_round=3,
+    )
+    for t in bst._gbdt.trees():
+        for ci in range(t.num_cat):
+            assert len(t.cat_values(ci)) <= 4
+
+
+def test_json_dump_categorical(cat_data):
+    X, y = cat_data
+    bst = lgb.train(
+        PARAMS, lgb.Dataset(X, label=y, categorical_feature=[0]), num_boost_round=3
+    )
+    d = bst.dump_model()
+    tree0 = d["tree_info"][0]["tree_structure"]
+
+    found = []
+
+    def walk(node):
+        if "split_feature" not in node:
+            return
+        if node["decision_type"] == "==":
+            found.append(node["threshold"])
+        for c in ("left_child", "right_child"):
+            if c in node:
+                walk(node[c])
+
+    walk(tree0)
+    assert found, "expected a categorical node in the dump"
+    assert all(isinstance(t, str) and "||" in t or isinstance(t, str) for t in found)
+
+
+def test_codegen_compiles_with_categorical(cat_data, tmp_path):
+    """convert_model output with bitset decisions compiles and matches."""
+    import ctypes
+    import subprocess
+
+    X, y = cat_data
+    bst = lgb.train(
+        PARAMS, lgb.Dataset(X, label=y, categorical_feature=[0]), num_boost_round=3
+    )
+    from lightgbm_tpu.models.model_codegen import save_model_to_ifelse
+
+    src = save_model_to_ifelse(bst._gbdt)
+    cpp = tmp_path / "model.cpp"
+    cpp.write_text(
+        src
+        + '\nextern "C" void predict_one(const double* f, double* o) '
+        "{ lightgbm_tpu_model::Predict(f, o); }\n"
+    )
+    so = tmp_path / "model.so"
+    subprocess.check_call(
+        ["g++", "-O1", "-shared", "-fPIC", "-o", str(so), str(cpp)]
+    )
+    lib = ctypes.CDLL(str(so))
+    lib.predict_one.argtypes = [
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_double),
+    ]
+    expect = bst.predict(X[:64])
+    got = np.zeros(1)
+    for r in range(64):
+        row = np.ascontiguousarray(X[r], np.float64)
+        lib.predict_one(
+            row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            got.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+        assert abs(got[0] - expect[r]) < 1e-9, r

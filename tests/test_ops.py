@@ -205,7 +205,9 @@ class TestSplitFinder:
         assert int(res.feature) == 1
 
     def test_categorical_onehot(self):
-        # categorical: best single category split
+        # categorical one-hot branch: best single category split. num_bin must
+        # be <= max_cat_to_onehot or the CTR-sorted branch takes over (and with
+        # min_data_per_group=100 > 40 rows it would find no split at all).
         B = 5
         h = np.zeros((B, 3))
         h[:, 2] = [10, 10, 10, 10, 0]
@@ -227,8 +229,9 @@ class TestSplitFinder:
             jnp.float32(np.inf),
             meta,
             jnp.ones((1,), bool),
-            PARAMS,
+            PARAMS._replace(max_cat_to_onehot=8),
         )
         assert int(res.threshold) == 0
         assert not bool(res.default_left)
+        assert int(res.num_cat) == 1
         np.testing.assert_allclose(float(res.left_sum_grad), 20.0, rtol=1e-5)
