@@ -155,16 +155,72 @@ def _choose_platform(probe_timeout_s: float):
             file=sys.stderr,
             flush=True,
         )
+        if rc is None and platforms is None:
+            # the env default TIMED OUT (a wedged TPU-tunnel client blocks
+            # init forever, it does not error) — auto-select would hang on the
+            # same tunnel, so go straight to cpu instead of burning a second
+            # probe window
+            break
     # last resort: force cpu without probing
     return "cpu", "cpu"
 
 
-def _run() -> None:
+def _orchestrate() -> None:
+    """Probe a working backend, then run the measured workload in a CHILD
+    process pinned to it. A wedged TPU-tunnel client poisons machine-level
+    state such that even a cpu-pinned jax init in THIS process can hang inside
+    the tunnel plugin's get_backend wrapper — so after the round-1 lineless
+    crash (rc=1) and the round-2 smoke hang, no jax work happens in the
+    orchestrator at all. The child prints the JSON line; on child
+    failure/timeout the orchestrator emits the failure line itself."""
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 420))
     platforms, platform = _choose_platform(probe_timeout)
+    env = dict(os.environ, BENCH_WORKER="1", BENCH_WORKER_PLATFORM=platform)
+    if platforms is not None:
+        env["BENCH_FORCE_PLATFORMS"] = platforms
+    limit = float(os.environ.get("BENCH_TIMEOUT_S", 2400)) - 60.0
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env,
+        stdout=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=limit)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        proc.wait()
+        _emit(0.0, 0.0, error="bench worker timed out after %.0fs" % limit)
+        sys.exit(2)
+    line = next(
+        (l for l in out.splitlines() if l.startswith("{")), None
+    )
+    if proc.returncode != 0 or line is None:
+        _emit(0.0, 0.0, error="bench worker rc=%s without JSON" % proc.returncode)
+        sys.exit(1)
+    print(line, flush=True)
+
+
+def _run() -> None:
+    platform = os.environ.get("BENCH_WORKER_PLATFORM", "unknown")
+    platforms = os.environ.get("BENCH_FORCE_PLATFORMS")
     if platforms is not None:
         # apply in-process: the env var alone is overridden by sitecustomize's
-        # jax.config.update pin (see _PROBE_SRC note)
+        # jax.config.update pin (see _PROBE_SRC note). Also sync the env var —
+        # lightgbm_tpu's import re-asserts JAX_PLATFORMS over the pin
+        # (platform.honor_jax_platforms_env), and the machine default of
+        # 'axon' would point the worker back at the very tunnel the probe
+        # just found wedged.
+        if platforms:
+            os.environ["JAX_PLATFORMS"] = platforms
+        else:
+            os.environ.pop("JAX_PLATFORMS", None)
         import jax
 
         jax.config.update("jax_platforms", platforms or None)
@@ -244,7 +300,10 @@ def _run() -> None:
 def main() -> None:
     _watchdog(float(os.environ.get("BENCH_TIMEOUT_S", 2400)))
     try:
-        _run()
+        if os.environ.get("BENCH_WORKER"):
+            _run()
+        else:
+            _orchestrate()
     except BaseException as e:  # always emit the line, even on KeyboardInterrupt
         import traceback
 

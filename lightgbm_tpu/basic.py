@@ -25,10 +25,14 @@ from .utils import log
 from .utils.log import LightGBMError
 
 
-def _to_2d_float(data) -> np.ndarray:
+def _to_2d_float(data, allow_sparse: bool = False) -> np.ndarray:
     if hasattr(data, "values"):  # pandas
         data = data.values
     if hasattr(data, "toarray"):  # scipy sparse
+        if allow_sparse:
+            # construct_dataset bins sparse inputs column-wise without
+            # densifying (and may EFB-bundle them, efb.py)
+            return data
         data = data.toarray()
     arr = np.asarray(data)
     if arr.ndim == 1:
@@ -130,7 +134,7 @@ class Dataset:
             if names and self.feature_name == "auto":
                 self.feature_name = names
             self.data = X
-        data = _to_2d_float(self.data)
+        data = _to_2d_float(self.data, allow_sparse=True)
         feature_names = None
         if isinstance(self.feature_name, (list, tuple)):
             feature_names = list(self.feature_name)
@@ -164,6 +168,8 @@ class Dataset:
         return self
 
     def _predictor_raw_scores(self, data: np.ndarray) -> np.ndarray:
+        if hasattr(data, "toarray"):  # continued training on sparse input
+            data = data.toarray()
         raw = self._predictor.predict_raw(data)
         if raw.ndim == 2:
             return raw.T.reshape(-1)  # class-major flatten
@@ -235,7 +241,7 @@ class Dataset:
         if isinstance(self.data, str):
             self.construct()
             return self._binned.num_data
-        return _to_2d_float(self.data).shape[0]
+        return _to_2d_float(self.data, allow_sparse=True).shape[0]
 
     def num_feature(self) -> int:
         if self._binned is not None:
@@ -243,7 +249,7 @@ class Dataset:
         if isinstance(self.data, str):
             self.construct()
             return self._binned.num_total_features
-        return _to_2d_float(self.data).shape[1]
+        return _to_2d_float(self.data, allow_sparse=True).shape[1]
 
     def subset(self, used_indices, params=None) -> "Dataset":
         used_indices = np.asarray(used_indices)
@@ -305,6 +311,9 @@ class Dataset:
             md,
             feature_names=parent.feature_names,
             monotone_constraints=parent.monotone_constraints,
+            group_id=parent.group_id,
+            bin_offset=parent.bin_offset,
+            max_group_bins=parent._max_group_bins,
         )
         return binned
 

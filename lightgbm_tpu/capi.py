@@ -1,0 +1,149 @@
+"""Loader for the LGBM_* C ABI shared library.
+
+Builds native/lgbt_capi.cpp on first use (g++ + the running interpreter's
+headers/libs) and returns a ctypes.CDLL with the reference's signatures bound
+(/root/reference/include/LightGBM/c_api.h). ctypes callers written against the
+reference's lib_lightgbm.so work unchanged against this library; plain C/C++
+programs can link it directly (it embeds an interpreter when none is running).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from typing import Optional
+
+_HERE = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SRC = os.path.join(_HERE, "lgbt_capi.cpp")
+_SO = os.path.join(_HERE, "_lgbt_capi.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+# c_api.h:24-33
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+
+def _build() -> bool:
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    ldlib = sysconfig.get_config_var("LDLIBRARY") or ""
+    pyver = "python%d.%d" % sys.version_info[:2]
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        "-I", inc, _SRC, "-o", _SO + ".tmp",
+    ]
+    if libdir:
+        cmd += ["-L", libdir, "-Wl,-rpath," + libdir]
+    # link against libpython so standalone C callers resolve the symbols; when
+    # loaded inside python the already-mapped interpreter wins
+    if ldlib.endswith(".so") or ldlib.endswith(".a"):
+        cmd += ["-l" + pyver]
+    try:
+        subprocess.check_call(cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    c = ctypes
+    vp, vpp = c.c_void_p, c.POINTER(c.c_void_p)
+    i32p = c.POINTER(c.c_int32)
+    lib.LGBM_GetLastError.restype = c.c_char_p
+    lib.LGBM_GetLastError.argtypes = []
+    lib.LGBM_DatasetCreateFromFile.restype = c.c_int
+    lib.LGBM_DatasetCreateFromFile.argtypes = [c.c_char_p, c.c_char_p, vp, vpp]
+    lib.LGBM_DatasetCreateFromMat.restype = c.c_int
+    lib.LGBM_DatasetCreateFromMat.argtypes = [
+        vp, c.c_int, c.c_int32, c.c_int32, c.c_int, c.c_char_p, vp, vpp,
+    ]
+    lib.LGBM_DatasetCreateFromCSR.restype = c.c_int
+    lib.LGBM_DatasetCreateFromCSR.argtypes = [
+        vp, c.c_int, i32p, vp, c.c_int, c.c_int64, c.c_int64, c.c_int64,
+        c.c_char_p, vp, vpp,
+    ]
+    lib.LGBM_DatasetCreateFromCSC.restype = c.c_int
+    lib.LGBM_DatasetCreateFromCSC.argtypes = [
+        vp, c.c_int, i32p, vp, c.c_int, c.c_int64, c.c_int64, c.c_int64,
+        c.c_char_p, vp, vpp,
+    ]
+    lib.LGBM_DatasetGetNumData.restype = c.c_int
+    lib.LGBM_DatasetGetNumData.argtypes = [vp, c.POINTER(c.c_int)]
+    lib.LGBM_DatasetGetNumFeature.restype = c.c_int
+    lib.LGBM_DatasetGetNumFeature.argtypes = [vp, c.POINTER(c.c_int)]
+    lib.LGBM_DatasetSetField.restype = c.c_int
+    lib.LGBM_DatasetSetField.argtypes = [vp, c.c_char_p, vp, c.c_int, c.c_int]
+    lib.LGBM_DatasetSaveBinary.restype = c.c_int
+    lib.LGBM_DatasetSaveBinary.argtypes = [vp, c.c_char_p]
+    lib.LGBM_DatasetFree.restype = c.c_int
+    lib.LGBM_DatasetFree.argtypes = [vp]
+    lib.LGBM_BoosterCreate.restype = c.c_int
+    lib.LGBM_BoosterCreate.argtypes = [vp, c.c_char_p, vpp]
+    lib.LGBM_BoosterCreateFromModelfile.restype = c.c_int
+    lib.LGBM_BoosterCreateFromModelfile.argtypes = [
+        c.c_char_p, c.POINTER(c.c_int), vpp,
+    ]
+    lib.LGBM_BoosterFree.restype = c.c_int
+    lib.LGBM_BoosterFree.argtypes = [vp]
+    lib.LGBM_BoosterAddValidData.restype = c.c_int
+    lib.LGBM_BoosterAddValidData.argtypes = [vp, vp]
+    lib.LGBM_BoosterUpdateOneIter.restype = c.c_int
+    lib.LGBM_BoosterUpdateOneIter.argtypes = [vp, c.POINTER(c.c_int)]
+    lib.LGBM_BoosterGetEval.restype = c.c_int
+    lib.LGBM_BoosterGetEval.argtypes = [
+        vp, c.c_int, c.POINTER(c.c_int), c.POINTER(c.c_double),
+    ]
+    lib.LGBM_BoosterGetNumClasses.restype = c.c_int
+    lib.LGBM_BoosterGetNumClasses.argtypes = [vp, c.POINTER(c.c_int)]
+    lib.LGBM_BoosterSaveModel.restype = c.c_int
+    lib.LGBM_BoosterSaveModel.argtypes = [vp, c.c_int, c.c_int, c.c_char_p]
+    lib.LGBM_BoosterPredictForMat.restype = c.c_int
+    lib.LGBM_BoosterPredictForMat.argtypes = [
+        vp, vp, c.c_int, c.c_int32, c.c_int32, c.c_int, c.c_int, c.c_int,
+        c.c_char_p, c.POINTER(c.c_int64), c.POINTER(c.c_double),
+    ]
+    lib.LGBM_BoosterPredictForFile.restype = c.c_int
+    lib.LGBM_BoosterPredictForFile.argtypes = [
+        vp, c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_char_p, c.c_char_p,
+    ]
+
+
+def load_lib() -> Optional[ctypes.CDLL]:
+    """The LGBM_* C ABI library, building on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None:
+        return _lib
+    if _tried:
+        return None
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            need_build = (not os.path.exists(_SO)) or (
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if need_build and not _build():
+                return None
+            # the shim resolves lightgbm_tpu.capi_impl through the interpreter
+            import lightgbm_tpu.capi_impl  # noqa: F401  (preload for clarity)
+
+            lib = ctypes.CDLL(_SO, mode=ctypes.RTLD_GLOBAL)
+            _bind(lib)
+            _lib = lib
+        except OSError:
+            return None
+    return _lib

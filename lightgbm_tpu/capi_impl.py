@@ -1,0 +1,311 @@
+"""Python side of the LGBM_* C ABI (handle tables + buffer marshalling).
+
+The native shim (native/lgbt_capi.cpp) embeds/attaches to CPython and proxies
+every ``LGBM_*`` call here with raw pointer addresses and scalars; this module
+owns the handle tables and adapts the reference's C API semantics
+(/root/reference/include/LightGBM/c_api.h:41-986, src/c_api.cpp) onto the
+package's Dataset/Booster objects. Pointers are read/written with ctypes, so
+no copies beyond what the API semantics require.
+
+Handles are small positive integers (0 is the NULL handle); the C side passes
+them around as opaque void*.
+"""
+from __future__ import annotations
+
+import ctypes
+import itertools
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+
+# c_api.h:24-33
+DTYPE_FLOAT32 = 0
+DTYPE_FLOAT64 = 1
+DTYPE_INT32 = 2
+DTYPE_INT64 = 3
+DTYPE_INT8 = 4
+
+PREDICT_NORMAL = 0
+PREDICT_RAW_SCORE = 1
+PREDICT_LEAF_INDEX = 2
+PREDICT_CONTRIB = 3
+
+_NP_DTYPE = {
+    DTYPE_FLOAT32: np.float32,
+    DTYPE_FLOAT64: np.float64,
+    DTYPE_INT32: np.int32,
+    DTYPE_INT64: np.int64,
+    DTYPE_INT8: np.int8,
+}
+
+_CTYPE = {
+    DTYPE_FLOAT32: ctypes.c_float,
+    DTYPE_FLOAT64: ctypes.c_double,
+    DTYPE_INT32: ctypes.c_int32,
+    DTYPE_INT64: ctypes.c_int64,
+    DTYPE_INT8: ctypes.c_int8,
+}
+
+_ids = itertools.count(1)
+_datasets: Dict[int, Dataset] = {}
+_boosters: Dict[int, "_CBooster"] = {}
+
+
+def _read_array(ptr: int, n: int, dtype_code: int) -> np.ndarray:
+    ct = _CTYPE[dtype_code]
+    buf = (ct * n).from_address(ptr)
+    return np.frombuffer(buf, dtype=_NP_DTYPE[dtype_code]).copy()
+
+
+def _write_doubles(ptr: int, values: np.ndarray) -> None:
+    values = np.ascontiguousarray(values, np.float64)
+    ctypes.memmove(ptr, values.ctypes.data, values.nbytes)
+
+
+def _params_str_to_dict(parameters: str) -> dict:
+    return Config.kv2map(parameters.replace("\t", " ").split())
+
+
+def _dataset(did: int) -> Dataset:
+    try:
+        return _datasets[did]
+    except KeyError:
+        raise ValueError("invalid DatasetHandle %d" % did)
+
+
+# ---------------------------------------------------------------------------
+# Dataset surface
+# ---------------------------------------------------------------------------
+
+
+def dataset_create_from_file(filename: str, parameters: str, ref_id: int) -> int:
+    params = _params_str_to_dict(parameters)
+    ref = _datasets.get(ref_id) if ref_id else None
+    ds = Dataset(filename, params=params, reference=ref)
+    ds.construct()
+    did = next(_ids)
+    _datasets[did] = ds
+    return did
+
+
+def dataset_create_from_mat(
+    data_ptr: int, data_type: int, nrow: int, ncol: int, is_row_major: int,
+    parameters: str, ref_id: int,
+) -> int:
+    arr = _read_array(data_ptr, nrow * ncol, data_type).astype(np.float64)
+    X = arr.reshape(nrow, ncol) if is_row_major else arr.reshape(ncol, nrow).T
+    params = _params_str_to_dict(parameters)
+    ref = _datasets.get(ref_id) if ref_id else None
+    ds = Dataset(X, params=params, reference=ref)
+    ds.construct()
+    did = next(_ids)
+    _datasets[did] = ds
+    return did
+
+
+def dataset_create_from_csr(
+    indptr_ptr: int, indptr_type: int, indices_ptr: int, data_ptr: int,
+    data_type: int, nindptr: int, nelem: int, num_col: int, parameters: str,
+    ref_id: int,
+) -> int:
+    indptr = _read_array(indptr_ptr, nindptr, indptr_type).astype(np.int64)
+    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int64)
+    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
+    nrow = nindptr - 1
+    X = np.zeros((nrow, num_col), np.float64)
+    for r in range(nrow):
+        lo, hi = indptr[r], indptr[r + 1]
+        X[r, indices[lo:hi]] = data[lo:hi]
+    params = _params_str_to_dict(parameters)
+    ref = _datasets.get(ref_id) if ref_id else None
+    ds = Dataset(X, params=params, reference=ref)
+    ds.construct()
+    did = next(_ids)
+    _datasets[did] = ds
+    return did
+
+
+def dataset_create_from_csc(
+    col_ptr_ptr: int, col_ptr_type: int, indices_ptr: int, data_ptr: int,
+    data_type: int, ncol_ptr: int, nelem: int, num_row: int, parameters: str,
+    ref_id: int,
+) -> int:
+    col_ptr = _read_array(col_ptr_ptr, ncol_ptr, col_ptr_type).astype(np.int64)
+    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int64)
+    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
+    ncol = ncol_ptr - 1
+    X = np.zeros((num_row, ncol), np.float64)
+    for c in range(ncol):
+        lo, hi = col_ptr[c], col_ptr[c + 1]
+        X[indices[lo:hi], c] = data[lo:hi]
+    params = _params_str_to_dict(parameters)
+    ref = _datasets.get(ref_id) if ref_id else None
+    ds = Dataset(X, params=params, reference=ref)
+    ds.construct()
+    did = next(_ids)
+    _datasets[did] = ds
+    return did
+
+
+def dataset_get_num_data(did: int) -> int:
+    return int(_dataset(did)._binned.num_data)
+
+
+def dataset_get_num_feature(did: int) -> int:
+    return int(_dataset(did)._binned.num_total_features)
+
+
+def dataset_set_field(
+    did: int, field_name: str, data_ptr: int, num_element: int, dtype_code: int
+) -> None:
+    # Metadata::SetField name dispatch (c_api.cpp LGBM_DatasetSetField)
+    ds = _dataset(did)
+    arr = _read_array(data_ptr, num_element, dtype_code)
+    if field_name == "label":
+        ds.set_label(arr)
+    elif field_name == "weight":
+        ds.set_weight(arr)
+    elif field_name == "init_score":
+        ds.set_init_score(arr)
+    elif field_name in ("group", "query"):
+        ds.set_group(arr)
+    else:
+        raise ValueError("unknown field name %r" % field_name)
+
+
+def dataset_get_field(did: int, field_name: str) -> Optional[np.ndarray]:
+    ds = _dataset(did)
+    if field_name == "label":
+        return ds.get_label()
+    if field_name == "weight":
+        return ds.get_weight()
+    if field_name == "init_score":
+        return ds.get_init_score()
+    if field_name in ("group", "query"):
+        return ds.get_group()
+    raise ValueError("unknown field name %r" % field_name)
+
+
+def dataset_save_binary(did: int, filename: str) -> None:
+    _dataset(did).save_binary(filename)
+
+
+def dataset_free(did: int) -> None:
+    _datasets.pop(did, None)
+
+
+# ---------------------------------------------------------------------------
+# Booster surface
+# ---------------------------------------------------------------------------
+
+
+class _CBooster:
+    """Booster + its attached eval data (BoosterHandle contents, c_api.cpp)."""
+
+    def __init__(self, booster: Booster):
+        self.booster = booster
+
+
+def booster_create(train_id: int, parameters: str) -> int:
+    params = _params_str_to_dict(parameters)
+    bst = Booster(params=params, train_set=_dataset(train_id))
+    bid = next(_ids)
+    _boosters[bid] = _CBooster(bst)
+    return bid
+
+
+def booster_create_from_modelfile(filename: str) -> Tuple[int, int]:
+    bst = Booster(model_file=filename)
+    bid = next(_ids)
+    _boosters[bid] = _CBooster(bst)
+    return bid, int(bst.current_iteration)
+
+
+def booster_free(bid: int) -> None:
+    _boosters.pop(bid, None)
+
+
+def booster_add_valid_data(bid: int, did: int) -> None:
+    _boosters[bid].booster.add_valid(_dataset(did), "valid_%d" % did)
+
+
+def booster_update_one_iter(bid: int) -> int:
+    return 1 if _boosters[bid].booster.update() else 0
+
+
+def booster_get_eval(bid: int, data_idx: int, out_ptr: int) -> int:
+    # data_idx 0 = training data, i = i-th valid set (c_api.h:585-597)
+    bst = _boosters[bid].booster
+    if data_idx == 0:
+        results = bst.eval_train()
+    else:
+        name = bst._gbdt.valid_names[data_idx - 1]
+        results = [t for t in bst.eval_valid() if t[0] == name]
+    vals = np.asarray([t[2] for t in results], np.float64)
+    if len(vals):
+        _write_doubles(out_ptr, vals)
+    return len(vals)
+
+
+def booster_get_num_classes(bid: int) -> int:
+    return _boosters[bid].booster.num_model_per_iteration()
+
+
+def booster_save_model(
+    bid: int, start_iteration: int, num_iteration: int, filename: str
+) -> None:
+    _boosters[bid].booster.save_model(
+        filename, num_iteration=num_iteration, start_iteration=start_iteration
+    )
+
+
+def booster_predict_for_mat(
+    bid: int, data_ptr: int, data_type: int, nrow: int, ncol: int,
+    is_row_major: int, predict_type: int, num_iteration: int, parameter: str,
+    out_ptr: int,
+) -> int:
+    arr = _read_array(data_ptr, nrow * ncol, data_type).astype(np.float64)
+    X = arr.reshape(nrow, ncol) if is_row_major else arr.reshape(ncol, nrow).T
+    bst = _boosters[bid].booster
+    kw = dict(num_iteration=num_iteration)
+    if predict_type == PREDICT_RAW_SCORE:
+        out = bst.predict(X, raw_score=True, **kw)
+    elif predict_type == PREDICT_LEAF_INDEX:
+        out = bst.predict(X, pred_leaf=True, **kw)
+    elif predict_type == PREDICT_CONTRIB:
+        out = bst.predict(X, pred_contrib=True, **kw)
+    else:
+        out = bst.predict(X, **kw)
+    out = np.ascontiguousarray(out, np.float64)
+    _write_doubles(out_ptr, out)
+    return int(out.size)
+
+
+def booster_predict_for_file(
+    bid: int, data_filename: str, data_has_header: int, predict_type: int,
+    num_iteration: int, parameter: str, result_filename: str,
+) -> None:
+    from .io import load_text_file
+
+    bst = _boosters[bid].booster
+    X, _, _ = load_text_file(
+        data_filename, has_header=bool(data_has_header), label_column=0
+    )
+    kw = dict(num_iteration=num_iteration)
+    if predict_type == PREDICT_RAW_SCORE:
+        out = bst.predict(X, raw_score=True, **kw)
+    elif predict_type == PREDICT_LEAF_INDEX:
+        out = bst.predict(X, pred_leaf=True, **kw)
+    elif predict_type == PREDICT_CONTRIB:
+        out = bst.predict(X, pred_contrib=True, **kw)
+    else:
+        out = bst.predict(X, **kw)
+    out = np.atleast_2d(np.asarray(out, np.float64))
+    if out.shape[0] == 1 and out.size > 1:
+        out = out.T
+    with open(result_filename, "w") as fh:
+        for row in out:
+            fh.write("\t".join(repr(float(v)) for v in np.atleast_1d(row)) + "\n")

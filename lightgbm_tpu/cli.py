@@ -22,6 +22,7 @@ from .config import Config, load_config_file
 from .engine import train as train_api
 from .io import load_sidecar, load_text_file
 from .utils import log
+from .utils.log import LightGBMError
 
 
 def parse_args(argv: List[str]) -> Dict[str, str]:
@@ -87,6 +88,19 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
     params.pop("data", None)
     params.pop("valid", None)
     params.pop("output_model", None)
+    callbacks = []
+    if config.snapshot_freq > 0:
+        # periodic model snapshots next to the output model (gbdt.cpp:254-258)
+        freq, path = config.snapshot_freq, config.output_model
+
+        def _snapshot(env):
+            if (env.iteration + 1) % freq == 0:
+                snap = "%s.snapshot_iter_%d" % (path, env.iteration + 1)
+                env.model.save_model(snap)
+                log.info("Saved snapshot to %s" % snap)
+
+        _snapshot.order = 100
+        callbacks.append(_snapshot)
     booster = train_api(
         params,
         train_set,
@@ -96,6 +110,7 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         init_model=config.input_model or None,
         early_stopping_rounds=config.early_stopping_round or None,
         verbose_eval=config.metric_freq if config.verbosity >= 1 else False,
+        callbacks=callbacks or None,
     )
     booster.save_model(config.output_model)
     log.info("Finished training; model saved to %s" % config.output_model)
@@ -171,18 +186,24 @@ def run_refit(config: Config, params: Dict[str, str]) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
-    params = parse_args(argv)
-    config = Config.from_params(params)
-    if config.task == "train":
-        run_train(config, params)
-    elif config.task in ("predict", "prediction", "test"):
-        run_predict(config, params)
-    elif config.task == "convert_model":
-        run_convert_model(config, params)
-    elif config.task == "refit":
-        run_refit(config, params)
-    else:
-        log.fatal("Unknown task: %s" % config.task)
+    try:
+        params = parse_args(argv)
+        config = Config.from_params(params)
+        if config.task == "train":
+            run_train(config, params)
+        elif config.task in ("predict", "prediction", "test"):
+            run_predict(config, params)
+        elif config.task == "convert_model":
+            run_convert_model(config, params)
+        elif config.task == "refit":
+            run_refit(config, params)
+        else:
+            log.fatal("Unknown task: %s" % config.task)
+    except LightGBMError as e:
+        # application_main's catch block ("Met Exceptions", main.cpp): a clean
+        # message + nonzero exit, not a traceback
+        print("Met Exceptions:\n%s" % e, file=sys.stderr)
+        return 1
     return 0
 
 

@@ -7,8 +7,14 @@ single dense feature-major bin matrix ``[num_features, num_rows]`` (uint8 when a
 features have <=256 bins) — the shape the Pallas/XLA histogram kernels consume
 directly, sharded over rows on a device mesh.
 
-EFB feature bundling (dataset.cpp:68-139) is unnecessary in this layout (it exists to
-compress sparse CPU columns); sparse inputs are densified at bin time.
+Sparse inputs (scipy CSR/CSC) bin without densifying, and EFB feature bundling
+(dataset.cpp:68-178, efb.py here) packs mutually-exclusive sparse features into
+shared dense columns — the [F, N] matrix becomes [G, N] with G << F, so
+Bosch/Allstate-shaped data (thousands of mostly-zero columns) fits in memory
+while every downstream kernel stays dense and static-shaped. The reference's
+ragged per-feature sparse stores (sparse_bin.hpp) are deliberately not
+replicated: ragged storage defeats the vectorized TPU histogram/partition
+kernels, and EFB recovers the memory win in a dense layout.
 
 Binning follows DatasetLoader::CostructFromSampleData (dataset_loader.cpp:535):
 sample rows (bin_construct_sample_cnt, data_random_seed), per-feature FindBin on the
@@ -107,6 +113,9 @@ class BinnedDataset:
         metadata: Metadata,
         feature_names: Optional[List[str]] = None,
         monotone_constraints: Optional[List[int]] = None,
+        group_id: Optional[np.ndarray] = None,
+        bin_offset: Optional[np.ndarray] = None,
+        max_group_bins: Optional[int] = None,
     ) -> None:
         self.bins = bins
         self.mappers = mappers
@@ -117,6 +126,15 @@ class BinnedDataset:
             feature_names = ["Column_%d" % i for i in range(num_total_features)]
         self.feature_names = feature_names
         self.monotone_constraints = monotone_constraints or []
+        # EFB bundling (efb.py): when set, ``bins`` is [num_groups, N] with the
+        # offset encoding; group_id/bin_offset [F] decode each feature's column
+        self.group_id = group_id
+        self.bin_offset = bin_offset
+        self._max_group_bins = max_group_bins
+
+    @property
+    def is_bundled(self) -> bool:
+        return self.group_id is not None
 
     @property
     def num_data(self) -> int:
@@ -124,11 +142,35 @@ class BinnedDataset:
 
     @property
     def num_features(self) -> int:
+        return len(self.mappers)
+
+    @property
+    def num_groups(self) -> int:
         return self.bins.shape[0]
 
     @property
     def max_num_bin(self) -> int:
         return max((m.num_bin for m in self.mappers), default=1)
+
+    @property
+    def max_group_bins(self) -> int:
+        """Histogram width: bundled group width, else max feature bins.
+
+        The THEORETICAL width from BundleInfo, never derived from the data —
+        a row subset may lack the rows carrying the top encodings, and an
+        undersized histogram would silently clamp the remap gathers."""
+        if self.is_bundled:
+            if self._max_group_bins is not None:
+                return int(self._max_group_bins)
+            # legacy files without the stored width: a group's width is its
+            # last member's offset + contributed bins
+            return int(
+                max(
+                    int(self.bin_offset[f]) + m.num_bin - 1
+                    for f, m in enumerate(self.mappers)
+                )
+            )
+        return self.max_num_bin
 
     def num_bins_per_feature(self) -> np.ndarray:
         return np.array([m.num_bin for m in self.mappers], dtype=np.int32)
@@ -148,6 +190,10 @@ class BinnedDataset:
             "default_bin": np.array([m.default_bin for m in self.mappers], dtype=np.int32),
             "monotone": mono,
         }
+        if self.is_bundled:
+            # key presence is the static "EFB bundled" switch for the grower
+            meta["group_id"] = self.group_id.astype(np.int32)
+            meta["bin_offset"] = self.bin_offset.astype(np.int32)
         is_cat = np.array(
             [m.bin_type == BIN_CATEGORICAL for m in self.mappers], dtype=bool
         )
@@ -172,6 +218,10 @@ def save_binary_dataset(binned: BinnedDataset, path: str) -> None:
         "bins": binned.bins,
         "used_feature_idx": np.asarray(binned.used_feature_idx, np.int64),
     }
+    if binned.is_bundled:
+        arrays["group_id"] = binned.group_id
+        arrays["bin_offset"] = binned.bin_offset
+        arrays["max_group_bins"] = np.asarray([binned.max_group_bins], np.int64)
     if md.label is not None:
         arrays["label"] = md.label
     if md.weight is not None:
@@ -226,6 +276,9 @@ def load_binary_dataset(path: str) -> BinnedDataset:
         )
         if "query_boundaries" in z.files:
             md.query_boundaries = z["query_boundaries"].astype(np.int64)
+        group_id = z["group_id"] if "group_id" in z.files else None
+        bin_offset = z["bin_offset"] if "bin_offset" in z.files else None
+        mgb = int(z["max_group_bins"][0]) if "max_group_bins" in z.files else None
     mappers = [BinMapper.from_dict(d) for d in meta["mappers"]]
     return BinnedDataset(
         bins,
@@ -235,6 +288,9 @@ def load_binary_dataset(path: str) -> BinnedDataset:
         md,
         feature_names=meta["feature_names"],
         monotone_constraints=meta["monotone_constraints"],
+        group_id=group_id,
+        bin_offset=bin_offset,
+        max_group_bins=mgb,
     )
 
 
@@ -281,7 +337,14 @@ def construct_dataset(
 
     With ``reference`` set, reuses its BinMappers (validation data path — the
     reference's Dataset::CreateValid / CheckAlign contract, dataset.h:300).
+    scipy sparse matrices bin without densifying and may EFB-bundle (efb.py).
     """
+    if _is_scipy_sparse(data):
+        return _construct_sparse(
+            data, config, label=label, weight=weight, group=group,
+            init_score=init_score, feature_names=feature_names,
+            categorical_feature=categorical_feature, reference=reference,
+        )
     data = np.asarray(data)
     if data.ndim != 2:
         log.fatal("Input data must be 2-dimensional, got shape %s" % (data.shape,))
@@ -297,6 +360,25 @@ def construct_dataset(
                 % (num_cols, reference.num_total_features)
             )
         bins = _bin_matrix(data, reference.mappers, reference.used_feature_idx)
+        if reference.is_bundled:
+            # the training set is EFB-bundled [G, N]: re-encode this data into
+            # the same bundled layout, or GBDT's group-space feature_meta would
+            # decode a per-feature matrix as groups (silently wrong eval)
+            from . import efb
+
+            feat_bins = bins
+
+            def get(f):
+                sub = feat_bins[f].astype(np.int32)
+                keep = sub != reference.mappers[f].default_bin
+                return np.nonzero(keep)[0], sub[keep]
+
+            bins = efb.build_bundled_matrix(
+                get,
+                efb.BundleInfo.from_binned(reference),
+                [m.default_bin for m in reference.mappers],
+                num_data,
+            )
         return BinnedDataset(
             bins,
             reference.mappers,
@@ -305,6 +387,9 @@ def construct_dataset(
             metadata,
             feature_names=reference.feature_names,
             monotone_constraints=reference.monotone_constraints,
+            group_id=reference.group_id,
+            bin_offset=reference.bin_offset,
+            max_group_bins=reference._max_group_bins,
         )
 
     cat_idx = _parse_categorical(
@@ -351,6 +436,157 @@ def construct_dataset(
         feature_names=feature_names,
         monotone_constraints=mono,
     )
+
+
+def _is_scipy_sparse(x) -> bool:
+    return hasattr(x, "tocsc") and hasattr(x, "nnz")
+
+
+def _construct_sparse(
+    data,
+    config: Config,
+    label=None,
+    weight=None,
+    group=None,
+    init_score=None,
+    feature_names=None,
+    categorical_feature=None,
+    reference: Optional[BinnedDataset] = None,
+) -> BinnedDataset:
+    """Bin a scipy sparse matrix column-by-column (no densification), then
+    EFB-bundle when enable_bundle finds exclusive groups (dataset.cpp:68-178).
+    """
+    from . import efb
+
+    csc = data.tocsc()
+    num_data, num_cols = csc.shape
+    metadata = Metadata(
+        num_data, label=label, weight=weight, group=group, init_score=init_score
+    )
+
+    def col_nonzeros(j):
+        lo, hi = csc.indptr[j], csc.indptr[j + 1]
+        return csc.indices[lo:hi], np.asarray(csc.data[lo:hi], np.float64)
+
+    def subbins_fn(mappers, used):
+        """f -> (row_idx, sub_bin) for rows whose sub-bin != default.
+
+        Memoized: find_groups consumes every column's nonzero rows before
+        build_bundled_matrix re-reads them — without the cache each column's
+        O(nnz) values_to_bins would run twice."""
+        memo = {}
+
+        def get(f):
+            if f not in memo:
+                idx, vals = col_nonzeros(used[f])
+                sub = mappers[f].values_to_bins(vals).astype(np.int32)
+                keep = sub != mappers[f].default_bin
+                memo[f] = (idx[keep], sub[keep])
+            return memo[f]
+
+        return get
+
+    if reference is not None:
+        if num_cols != reference.num_total_features:
+            log.fatal(
+                "Validation data has %d features, training data had %d"
+                % (num_cols, reference.num_total_features)
+            )
+        mappers, used = reference.mappers, reference.used_feature_idx
+        get = subbins_fn(mappers, used)
+        if reference.is_bundled:
+            bins = efb.build_bundled_matrix(
+                get,
+                efb.BundleInfo.from_binned(reference),
+                [m.default_bin for m in mappers],
+                num_data,
+            )
+        else:
+            max_bin = max((m.num_bin for m in mappers), default=2)
+            dtype = np.uint8 if max_bin <= 256 else np.int32
+            bins = np.zeros((len(used), num_data), dtype)
+            for f, m in enumerate(mappers):
+                bins[f, :] = m.default_bin
+                idx, sub = get(f)
+                bins[f, idx] = sub.astype(dtype)
+        return BinnedDataset(
+            bins, mappers, used, num_cols, metadata,
+            feature_names=reference.feature_names,
+            monotone_constraints=reference.monotone_constraints,
+            group_id=reference.group_id, bin_offset=reference.bin_offset,
+            max_group_bins=reference._max_group_bins,
+        )
+
+    cat_idx = _parse_categorical(
+        categorical_feature if categorical_feature is not None else config.categorical_feature,
+        num_cols,
+        feature_names,
+    )
+    sample_idx = _sample_rows(
+        num_data, config.bin_construct_sample_cnt, config.data_random_seed
+    )
+    total_sample_cnt = len(sample_idx)
+    sampled = csc if total_sample_cnt == num_data else data.tocsr()[sample_idx].tocsc()
+
+    mappers: List[BinMapper] = []
+    used: List[int] = []
+    for j in range(num_cols):
+        lo, hi = sampled.indptr[j], sampled.indptr[j + 1]
+        vals = np.asarray(sampled.data[lo:hi], np.float64)
+        vals = vals[np.isnan(vals) | (np.abs(vals) > K_ZERO_THRESHOLD)]
+        m = BinMapper()
+        m.find_bin(
+            vals,
+            total_sample_cnt,
+            config.max_bin,
+            config.min_data_in_bin,
+            config.min_data_in_leaf,
+            bin_type=BIN_CATEGORICAL if j in cat_idx else BIN_NUMERICAL,
+            use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+        )
+        if not m.is_trivial:
+            mappers.append(m)
+            used.append(j)
+    if not used:
+        log.warning("There are no meaningful features, as all feature values are constant.")
+
+    mono = list(config.monotone_constraints) if config.monotone_constraints else []
+    get = subbins_fn(mappers, used)
+    kwargs = dict(feature_names=feature_names, monotone_constraints=mono)
+
+    if config.enable_bundle and len(used) > 1:
+        nz_rows = [get(f)[0] for f in range(len(used))]
+        groups = efb.find_groups(
+            nz_rows,
+            [m.num_bin for m in mappers],
+            num_data,
+            config.max_conflict_rate,
+        )
+        info = efb.BundleInfo(groups, [m.num_bin for m in mappers])
+        if info.num_groups < len(used):
+            log.info(
+                "EFB bundled %d features into %d groups (max %d bins/group)"
+                % (len(used), info.num_groups, info.max_group_bins)
+            )
+            bins = efb.build_bundled_matrix(
+                get, info, [m.default_bin for m in mappers], num_data
+            )
+            return BinnedDataset(
+                bins, mappers, used, num_cols, metadata,
+                group_id=info.group_id, bin_offset=info.bin_offset,
+                max_group_bins=info.max_group_bins, **kwargs,
+            )
+
+    # no winning bundle: dense per-feature bin matrix, built from the columns
+    max_bin = max((m.num_bin for m in mappers), default=2)
+    dtype = np.uint8 if max_bin <= 256 else np.int32
+    bins = np.zeros((len(used), num_data), dtype)
+    for f, m in enumerate(mappers):
+        bins[f, :] = m.default_bin
+        idx, sub = get(f)
+        bins[f, idx] = sub.astype(dtype)
+    return BinnedDataset(bins, mappers, used, num_cols, metadata, **kwargs)
 
 
 def _bin_matrix(data: np.ndarray, mappers: List[BinMapper], used: List[int]) -> np.ndarray:

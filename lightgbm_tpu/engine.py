@@ -14,6 +14,7 @@ import numpy as np
 
 from . import callback as callback_mod
 from .basic import Booster, Dataset
+from .utils import timer as timer_mod
 from .config import Config
 from .utils import log
 from .utils.log import LightGBMError
@@ -102,6 +103,28 @@ def train(
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
     evaluation_result_list: List = []
+    with timer_mod.maybe_profile():
+        evaluation_result_list = _boost_loop(
+            booster, params, fobj, feval, valid_sets, is_valid_contain_train,
+            train_data_name, init_iteration, num_boost_round,
+            cbs_before, cbs_after,
+        )
+    booster._gbdt.timers.report()
+
+    booster.best_score = collections.defaultdict(collections.OrderedDict)
+    for (dname, ename, v, _) in evaluation_result_list or []:
+        booster.best_score[dname][ename] = v
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration
+    return booster
+
+
+def _boost_loop(
+    booster, params, fobj, feval, valid_sets, is_valid_contain_train,
+    train_data_name, init_iteration, num_boost_round, cbs_before, cbs_after,
+):
+    """The boosting iteration loop; returns the last evaluation result list."""
+    evaluation_result_list: List = []
     for i in range(init_iteration, init_iteration + num_boost_round):
         for cb in cbs_before:
             cb(
@@ -123,6 +146,9 @@ def train(
                     [(train_data_name, n, v, b) for (_, n, v, b) in booster.eval_train(feval)]
                 )
             evaluation_result_list.extend(booster.eval_valid(feval))
+            hist = booster._gbdt._eval_history
+            for (dname, mname, val, _) in evaluation_result_list:
+                hist.setdefault(dname, {}).setdefault(mname, []).append(val)
         try:
             for cb in cbs_after:
                 cb(
@@ -141,13 +167,7 @@ def train(
             break
         if finished:
             break
-
-    booster.best_score = collections.defaultdict(collections.OrderedDict)
-    for (dname, ename, v, _) in evaluation_result_list or []:
-        booster.best_score[dname][ename] = v
-    if booster.best_iteration <= 0:
-        booster.best_iteration = booster.current_iteration
-    return booster
+    return evaluation_result_list
 
 
 class CVBooster:

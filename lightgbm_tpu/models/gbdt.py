@@ -16,7 +16,7 @@ leaves (gbdt.cpp:308-413) — while the mechanics are TPU-shaped:
 """
 from __future__ import annotations
 
-import time
+import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -34,6 +34,13 @@ from ..utils import log
 from .tree import Tree
 
 K_EPSILON = 1e-15
+
+
+@functools.partial(jax.jit, static_argnames=("n", "bag_cnt"))
+def _device_bag_mask(key, n: int, bag_cnt: int) -> jax.Array:
+    """Exactly bag_cnt rows in-bag, drawn on device (gbdt.cpp:179-240)."""
+    perm = jax.random.permutation(key, n)
+    return jnp.zeros((n,), jnp.float32).at[perm[:bag_cnt]].set(1.0)
 
 
 def _leaf_output_np(sum_grad, sum_hess, l1: float, l2: float, max_delta_step: float):
@@ -90,6 +97,15 @@ class GBDT:
         meta_np = train_set.feature_meta_arrays()
         self.feature_meta = {k: jnp.asarray(v) for k, v in meta_np.items()}
         self.num_bins = int(train_set.max_num_bin)
+        # EFB: histograms run at the bundled group width (dataset.max_group_bins)
+        self.num_group_bins = (
+            int(train_set.max_group_bins) if train_set.is_bundled else None
+        )
+        if train_set.is_bundled and cfg.tree_learner in ("voting", "voting_parallel"):
+            log.fatal(
+                "tree_learner=voting is not supported with EFB-bundled data "
+                "(shard-local histograms cannot recover default-bin rows)"
+            )
         self.split_params = SplitParams(
             lambda_l1=cfg.lambda_l1,
             lambda_l2=cfg.lambda_l2,
@@ -118,10 +134,13 @@ class GBDT:
             self.objective.init(train_set.metadata, self.num_data)
         for m in self.training_metrics:
             m.init(train_set.metadata, self.num_data)
-        self._bag_rng = np.random.RandomState(cfg.bagging_seed & 0x7FFFFFFF)
+        from ..utils.timer import PhaseTimers
+
+        self.timers = PhaseTimers()  # TIMETAG analogue (utils/timer.py)
+        self._bag_key = jax.random.PRNGKey(cfg.bagging_seed & 0x7FFFFFFF)
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed & 0x7FFFFFFF)
         self._bag_mask = jnp.ones((self.num_data,), jnp.float32)
-        self._bag_mask_np: Optional[np.ndarray] = None
+        self._bagging_active = False
         self.class_need_train = [
             self.objective.class_need_train(k) if self.objective is not None else True
             for k in range(K)
@@ -273,17 +292,17 @@ class GBDT:
     def _bagging(self, iter_: int, grad, hess) -> Tuple[jax.Array, jax.Array]:
         """Row-mask bagging (gbdt.cpp:179-240 expressed as a mask).
 
-        Returns possibly-modified gradients (GOSS rescales sampled rows)."""
+        The mask is drawn on device (jax.random.permutation) — no per-iteration
+        host RNG + transfer of an N-sized array. Returns possibly-modified
+        gradients (GOSS rescales sampled rows)."""
         cfg = self.config
         if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
             return grad, hess
+        self._bagging_active = True
         if iter_ % cfg.bagging_freq == 0:
             bag_cnt = int(cfg.bagging_fraction * self.num_data)
-            mask = np.zeros(self.num_data, np.float32)
-            idx = self._bag_rng.choice(self.num_data, size=bag_cnt, replace=False)
-            mask[idx] = 1.0
-            self._bag_mask_np = mask
-            self._bag_mask = jnp.asarray(mask)
+            key = jax.random.fold_in(self._bag_key, iter_)
+            self._bag_mask = _device_bag_mask(key, self.num_data, bag_cnt)
         return grad, hess
 
     def _sample_features(self) -> jax.Array:
@@ -305,31 +324,41 @@ class GBDT:
         (TrainOneIter, gbdt.cpp:332-413)."""
         cfg = self.config
         K = self.num_tree_per_iteration
+        timers = self.timers
         init_scores = [0.0] * K
         if gradients is None or hessians is None:
-            for k in range(K):
-                init_scores[k] = self._boost_from_average(k)
-            self._before_train_iter(init_scores)
-            grad, hess = self._compute_gradients(init_scores)
+            with timers.phase("boosting(grad)"):
+                for k in range(K):
+                    init_scores[k] = self._boost_from_average(k)
+                self._before_train_iter(init_scores)
+                grad, hess = self._compute_gradients(init_scores)
         else:
             grad = jnp.asarray(np.asarray(gradients, np.float32).reshape(K, self.num_data))
             hess = jnp.asarray(np.asarray(hessians, np.float32).reshape(K, self.num_data))
 
-        grad, hess = self._bagging(self.iter_, grad, hess)
+        with timers.phase("bagging"):
+            grad, hess = self._bagging(self.iter_, grad, hess)
 
         should_continue = False
         for k in range(K):
             tree_arrays = None
             leaf_id = None
             if self.class_need_train[k] and self.train_set.num_features > 0:
-                tree_arrays, leaf_id = self._train_tree(grad[k], hess[k])
+                with timers.phase("tree growth"):
+                    tree_arrays, leaf_id = self._train_tree(grad[k], hess[k])
+                    if timers.enabled:
+                        jax.block_until_ready(tree_arrays)
             num_leaves = int(tree_arrays.num_leaves) if tree_arrays is not None else 1
             if num_leaves > 1:
                 should_continue = True
-                tree_arrays = self._renew_and_shrink(tree_arrays, leaf_id, k)
-                # score update by leaf gather (all rows incl. out-of-bag)
-                self.scores = self.scores.at[k].add(tree_arrays.leaf_value[leaf_id])
-                self._update_valid_scores(tree_arrays, k)
+                with timers.phase("renew+score update"):
+                    tree_arrays = self._renew_and_shrink(tree_arrays, leaf_id, k)
+                    # score update by leaf gather (all rows incl. out-of-bag)
+                    self.scores = self.scores.at[k].add(tree_arrays.leaf_value[leaf_id])
+                    if timers.enabled:
+                        jax.block_until_ready(self.scores)
+                with timers.phase("valid scores"):
+                    self._update_valid_scores(tree_arrays, k)
                 if abs(init_scores[k]) > K_EPSILON:
                     tree_arrays = tree_arrays._replace(
                         leaf_value=tree_arrays.leaf_value + np.float32(init_scores[k])
@@ -382,6 +411,7 @@ class GBDT:
             num_leaves=cfg.num_leaves,
             max_depth=cfg.max_depth,
             num_bins=self.num_bins,
+            num_group_bins=self.num_group_bins,
             params=self.split_params,
             chunk=cfg.tpu_hist_chunk,
         )
@@ -503,15 +533,19 @@ class GBDT:
         )
 
     def _renew_and_shrink(self, tree_arrays, leaf_id, class_id: int):
-        """RenewTreeOutput (serial_tree_learner.cpp:854) + Shrinkage."""
+        """RenewTreeOutput (serial_tree_learner.cpp:854) + Shrinkage.
+
+        Runs fully on device via segment percentiles (segment_percentile) —
+        the per-leaf host percentile loop remains as the differential oracle
+        (tests/test_renew_device.py)."""
         obj = self.objective
         if obj is not None and obj.is_renew_tree_output:
-            n_leaves = int(tree_arrays.num_leaves)
-            leaf_id_np = np.asarray(leaf_id)
-            score_np = np.asarray(self.scores[class_id], np.float64)
-            outputs = np.asarray(tree_arrays.leaf_value, np.float64).copy()
-            new_out = obj.renew_leaf_outputs(
-                score_np, leaf_id_np, self._bag_mask_np, n_leaves, outputs
+            new_out = obj.renew_leaf_outputs_device(
+                self.scores[class_id],
+                leaf_id,
+                self._bag_mask if self._bagging_active else None,
+                self.config.num_leaves,
+                tree_arrays.leaf_value,
             )
             tree_arrays = tree_arrays._replace(
                 leaf_value=jnp.asarray(new_out, jnp.float32)
@@ -529,83 +563,6 @@ class GBDT:
         for i, bins_t in enumerate(self._valid_bins_t):
             val = tree_predict_value(bins_t, ptree)
             self.valid_scores[i] = self.valid_scores[i].at[class_id].add(val)
-
-    # ------------------------------------------------------------------
-    # training driver with eval + early stopping (gbdt.cpp:242-260, 433-535)
-    # ------------------------------------------------------------------
-
-    def train(self) -> None:
-        cfg = self.config
-        start = time.time()
-        for it in range(cfg.num_iterations):
-            finished = self.train_one_iter()
-            if not finished:
-                finished = self.eval_and_check_early_stopping()
-            log.info(
-                "%f seconds elapsed, finished iteration %d" % (time.time() - start, it + 1)
-            )
-            if finished:
-                break
-
-    def eval_and_check_early_stopping(self) -> bool:
-        cfg = self.config
-        if cfg.metric_freq <= 0 or (self.iter_ % cfg.metric_freq != 0 and cfg.early_stopping_round <= 0):
-            return False
-        msgs = self.output_metric(self.iter_)
-        if msgs:
-            log.info(
-                "Early stopping at iteration %d, the best iteration round is %d"
-                % (self.iter_, self.iter_ - cfg.early_stopping_round)
-            )
-            self.best_iteration = self.iter_ - cfg.early_stopping_round
-            drop = cfg.early_stopping_round * self.num_tree_per_iteration
-            for _ in range(drop):
-                self.models.pop()
-                self._device_trees.pop()
-            self.iter_ -= cfg.early_stopping_round
-            return True
-        return False
-
-    def output_metric(self, iter_: int) -> str:
-        """OutputMetric (gbdt.cpp:477-535): print + early-stopping bookkeeping.
-
-        Returns non-empty best-message when early stop triggers.
-        """
-        cfg = self.config
-        es_round = cfg.early_stopping_round
-        print_now = cfg.metric_freq > 0 and iter_ % cfg.metric_freq == 0 and cfg.verbosity >= 1
-        # training metrics
-        if cfg.is_provide_training_metric and print_now:
-            score = self._train_score_np()
-            for m in self.training_metrics:
-                for name, val, _ in m.eval(score, self.objective):
-                    log.info("Iteration:%d, training %s : %g" % (iter_, name, val))
-        # valid metrics
-        met_early = False
-        best_msg = ""
-        for i in range(len(self.valid_sets)):
-            score = self._valid_score_np(i)
-            for j, m in enumerate(self.valid_metrics[i]):
-                results = m.eval(score, self.objective)
-                for name, val, bigger in results:
-                    full = "valid_%d %s" % (i + 1, name)
-                    if print_now:
-                        log.info("Iteration:%d, %s : %g" % (iter_, full, val))
-                    self._eval_history.setdefault(self.valid_names[i], {}).setdefault(
-                        name, []
-                    ).append(val)
-                    if es_round > 0 and (not cfg.first_metric_only or j == 0):
-                        key = (i, name)
-                        cmp = val if bigger else -val
-                        cur = self._early_stop_best.get(key)
-                        if cur is None or cmp > cur[0]:
-                            self._early_stop_best[key] = (cmp, iter_, "%s : %g" % (full, val))
-        if es_round > 0 and self.valid_sets:
-            newest_best = max(v[1] for v in self._early_stop_best.values())
-            if iter_ - newest_best >= es_round:
-                met_early = True
-                best_msg = "; ".join(v[2] for v in self._early_stop_best.values())
-        return best_msg if met_early else ""
 
     def _train_score_np(self) -> np.ndarray:
         s = np.asarray(self.scores, np.float64)

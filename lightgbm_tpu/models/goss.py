@@ -9,11 +9,38 @@ for the first 1/learning_rate iterations (goss.hpp:143-146).
 """
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..utils import log
 from .gbdt import GBDT
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "other_k"))
+def _goss_mask_amp(key, grad, hess, top_k: int, other_k: int):
+    """On-device GOSS subset: top_k rows by sum_k |g*h| kept, other_k sampled
+    from the rest with gradients amplified by (n-top_k)/other_k (goss.hpp:91-141).
+
+    lax-native counterpart of the reference's host argsort + RNG loop — no
+    N-sized device->host transfer per iteration."""
+    n = grad.shape[1]
+    score = jnp.sum(jnp.abs(grad * hess), axis=0)
+    order = jnp.argsort(-score, stable=True)
+    rest = order[top_k:]
+    shuffled = rest[jax.random.permutation(key, n - top_k)]
+    other_idx = shuffled[:other_k]
+    mask = (
+        jnp.zeros((n,), jnp.float32)
+        .at[order[:top_k]]
+        .set(1.0)
+        .at[other_idx]
+        .set(1.0)
+    )
+    multiply = jnp.float32((n - top_k) / other_k)
+    amp = jnp.ones((n,), jnp.float32).at[other_idx].set(multiply)
+    return mask, amp
 
 
 class GOSS(GBDT):
@@ -27,32 +54,24 @@ class GOSS(GBDT):
         if cfg.bagging_freq > 0 and cfg.bagging_fraction != 1.0:
             log.fatal("Cannot use bagging in GOSS")
         log.info("Using GOSS")
-        self._goss_rng = np.random.RandomState(cfg.bagging_seed & 0x7FFFFFFF)
 
     def _bagging(self, iter_, grad, hess):
         cfg = self.config
         n = self.num_data
         if iter_ < int(1.0 / cfg.learning_rate):
+            # no subsampling for the first 1/lr iterations (goss.hpp:143-146)
             self._bag_mask = jnp.ones((n,), jnp.float32)
-            self._bag_mask_np = None
+            self._bagging_active = False
             return grad, hess
-        g_np = np.asarray(grad)
-        h_np = np.asarray(hess)
-        score = np.sum(np.abs(g_np * h_np), axis=0)
+        self._bagging_active = True
         top_k = max(1, int(n * cfg.top_rate))
-        other_k = max(1, int(n * cfg.other_rate))
-        order = np.argsort(-score, kind="stable")
-        top_idx = order[:top_k]
-        rest_idx = order[top_k:]
-        sampled = self._goss_rng.choice(len(rest_idx), size=min(other_k, len(rest_idx)), replace=False)
-        other_idx = rest_idx[sampled]
-        multiply = np.float32((n - top_k) / other_k)
-        mask = np.zeros(n, np.float32)
-        mask[top_idx] = 1.0
-        mask[other_idx] = 1.0
-        amp = np.ones(n, np.float32)
-        amp[other_idx] = multiply
-        self._bag_mask_np = mask
-        self._bag_mask = jnp.asarray(mask)
-        amp_dev = jnp.asarray(amp)[None, :]
+        other_k = min(max(1, int(n * cfg.other_rate)), n - top_k)
+        if other_k <= 0:
+            # top_rate covers every row: keep everything, no amplification
+            self._bag_mask = jnp.ones((n,), jnp.float32)
+            return grad, hess
+        key = jax.random.fold_in(self._bag_key, iter_)
+        mask, amp = _goss_mask_amp(key, grad, hess, top_k, other_k)
+        self._bag_mask = mask
+        amp_dev = amp[None, :]
         return grad * amp_dev, hess * amp_dev

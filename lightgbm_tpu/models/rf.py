@@ -60,12 +60,13 @@ class RandomForest(GBDT):
         obj = self.objective
         init = float(self._rf_init()[class_id])
         if obj is not None and obj.is_renew_tree_output:
-            n_leaves = int(tree_arrays.num_leaves)
-            leaf_id_np = np.asarray(leaf_id)
-            score_np = np.full(self.num_data, init, np.float64)
-            outputs = np.asarray(tree_arrays.leaf_value, np.float64).copy()
-            new_out = obj.renew_leaf_outputs(
-                score_np, leaf_id_np, self._bag_mask_np, n_leaves, outputs
+            score_dev = jnp.full((self.num_data,), init, jnp.float32)
+            new_out = obj.renew_leaf_outputs_device(
+                score_dev,
+                leaf_id,
+                self._bag_mask if self._bagging_active else None,
+                self.config.num_leaves,
+                tree_arrays.leaf_value,
             )
             tree_arrays = tree_arrays._replace(leaf_value=jnp.asarray(new_out, jnp.float32))
         # no shrinkage; fold the init bias into every tree (rf.hpp:139-143)

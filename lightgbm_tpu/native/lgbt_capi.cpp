@@ -1,0 +1,355 @@
+// LGBM_* C ABI for lightgbm_tpu.
+//
+// Native counterpart of the reference's C API layer
+// (/root/reference/include/LightGBM/c_api.h:41-986, src/c_api.cpp): the same
+// exported symbols and signatures, so ctypes/SWIG/R-style callers written
+// against the reference's ABI work unchanged. The reference's C API fronts a
+// C++ core; here the core is the Python/JAX package, so this shim embeds (or
+// attaches to) CPython and proxies each call to lightgbm_tpu.capi_impl with
+// raw pointer addresses — buffers are read/written in place on the Python
+// side via ctypes, handles are small ints cast through void*.
+//
+// Works in two modes:
+//  * loaded into an existing Python process (the common ctypes test path):
+//    attaches to the running interpreter via PyGILState.
+//  * loaded from a plain C/C++ program: initializes an interpreter on first
+//    call (Py_InitializeEx(0)).
+//
+// Build: see lightgbm_tpu/capi.py (g++ -shared -fPIC $(python3-config
+// --includes --ldflags --embed)).
+
+#include <Python.h>
+
+#include <cstdarg>
+#include <cstdint>
+#include <string>
+
+#define LGBT_EXPORT extern "C" __attribute__((visibility("default")))
+
+static thread_local std::string g_last_error = "everything is fine";
+
+static void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+namespace {
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() {
+    if (!Py_IsInitialized()) {
+      // standalone C caller: bring up an interpreter (no signal handlers)
+      Py_InitializeEx(0);
+    }
+    st = PyGILState_Ensure();
+  }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject* impl_module() {
+  static PyObject* mod = nullptr;  // GIL-protected
+  if (mod == nullptr) {
+    mod = PyImport_ImportModule("lightgbm_tpu.capi_impl");
+  }
+  return mod;
+}
+
+// Call capi_impl.<fn>(fmt-args); returns new ref or nullptr (error set).
+PyObject* call_impl(const char* fn, const char* fmt, ...) {
+  PyObject* mod = impl_module();
+  if (mod == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* callee = PyObject_GetAttrString(mod, fn);
+  if (callee == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  if (args == nullptr) {
+    Py_DECREF(callee);
+    set_error_from_python();
+    return nullptr;
+  }
+  if (!PyTuple_Check(args)) {  // single-arg fmt builds a bare value
+    PyObject* t = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = t;
+  }
+  PyObject* ret = PyObject_CallObject(callee, args);
+  Py_DECREF(args);
+  Py_DECREF(callee);
+  if (ret == nullptr) set_error_from_python();
+  return ret;
+}
+
+inline long long as_id(const void* handle) {
+  return static_cast<long long>(reinterpret_cast<intptr_t>(handle));
+}
+
+inline void* id_to_handle(long long id) {
+  return reinterpret_cast<void*>(static_cast<intptr_t>(id));
+}
+
+// run a call returning a handle id into *out
+int handle_call_out(PyObject* ret, void** out) {
+  if (ret == nullptr) return -1;
+  long long id = PyLong_AsLongLong(ret);
+  Py_DECREF(ret);
+  if (id == -1 && PyErr_Occurred()) {
+    set_error_from_python();
+    return -1;
+  }
+  *out = id_to_handle(id);
+  return 0;
+}
+
+int void_call(PyObject* ret) {
+  if (ret == nullptr) return -1;
+  Py_DECREF(ret);
+  return 0;
+}
+
+}  // namespace
+
+LGBT_EXPORT const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+// ---------------------------------------------------------------------------
+// Dataset (c_api.h:41-370)
+// ---------------------------------------------------------------------------
+
+LGBT_EXPORT int LGBM_DatasetCreateFromFile(const char* filename,
+                                           const char* parameters,
+                                           const void* reference, void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_create_from_file", "(ssL)", filename,
+                parameters ? parameters : "", as_id(reference)),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major,
+                                          const char* parameters,
+                                          const void* reference, void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_create_from_mat", "(LiiiisL)",
+                static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+                data_type, nrow, ncol, is_row_major,
+                parameters ? parameters : "", as_id(reference)),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                                          const int32_t* indices,
+                                          const void* data, int data_type,
+                                          int64_t nindptr, int64_t nelem,
+                                          int64_t num_col,
+                                          const char* parameters,
+                                          const void* reference, void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_create_from_csr", "(LiLLiLLLsL)",
+                static_cast<long long>(reinterpret_cast<intptr_t>(indptr)),
+                indptr_type,
+                static_cast<long long>(reinterpret_cast<intptr_t>(indices)),
+                static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+                data_type, static_cast<long long>(nindptr),
+                static_cast<long long>(nelem), static_cast<long long>(num_col),
+                parameters ? parameters : "", as_id(reference)),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetCreateFromCSC(const void* col_ptr,
+                                          int col_ptr_type,
+                                          const int32_t* indices,
+                                          const void* data, int data_type,
+                                          int64_t ncol_ptr, int64_t nelem,
+                                          int64_t num_row,
+                                          const char* parameters,
+                                          const void* reference, void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_create_from_csc", "(LiLLiLLLsL)",
+                static_cast<long long>(reinterpret_cast<intptr_t>(col_ptr)),
+                col_ptr_type,
+                static_cast<long long>(reinterpret_cast<intptr_t>(indices)),
+                static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+                data_type, static_cast<long long>(ncol_ptr),
+                static_cast<long long>(nelem), static_cast<long long>(num_row),
+                parameters ? parameters : "", as_id(reference)),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetGetNumData(void* handle, int* out) {
+  Gil gil;
+  PyObject* r = call_impl("dataset_get_num_data", "(L)", as_id(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_DatasetGetNumFeature(void* handle, int* out) {
+  Gil gil;
+  PyObject* r = call_impl("dataset_get_num_feature", "(L)", as_id(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_DatasetSetField(void* handle, const char* field_name,
+                                     const void* field_data, int num_element,
+                                     int type) {
+  Gil gil;
+  return void_call(call_impl(
+      "dataset_set_field", "(LsLii)", as_id(handle), field_name,
+      static_cast<long long>(reinterpret_cast<intptr_t>(field_data)),
+      num_element, type));
+}
+
+LGBT_EXPORT int LGBM_DatasetSaveBinary(void* handle, const char* filename) {
+  Gil gil;
+  return void_call(
+      call_impl("dataset_save_binary", "(Ls)", as_id(handle), filename));
+}
+
+LGBT_EXPORT int LGBM_DatasetFree(void* handle) {
+  Gil gil;
+  return void_call(call_impl("dataset_free", "(L)", as_id(handle)));
+}
+
+// ---------------------------------------------------------------------------
+// Booster (c_api.h:380-920)
+// ---------------------------------------------------------------------------
+
+LGBT_EXPORT int LGBM_BoosterCreate(const void* train_data,
+                                   const char* parameters, void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("booster_create", "(Ls)", as_id(train_data),
+                parameters ? parameters : ""),
+      out);
+}
+
+LGBT_EXPORT int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                                int* out_num_iterations,
+                                                void** out) {
+  Gil gil;
+  PyObject* r = call_impl("booster_create_from_modelfile", "(s)", filename);
+  if (r == nullptr) return -1;
+  long long id = 0;
+  int iters = 0;
+  if (!PyArg_ParseTuple(r, "Li", &id, &iters)) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out = id_to_handle(id);
+  if (out_num_iterations != nullptr) *out_num_iterations = iters;
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterFree(void* handle) {
+  Gil gil;
+  return void_call(call_impl("booster_free", "(L)", as_id(handle)));
+}
+
+LGBT_EXPORT int LGBM_BoosterAddValidData(void* handle, const void* valid_data) {
+  Gil gil;
+  return void_call(call_impl("booster_add_valid_data", "(LL)", as_id(handle),
+                             as_id(valid_data)));
+}
+
+LGBT_EXPORT int LGBM_BoosterUpdateOneIter(void* handle, int* is_finished) {
+  Gil gil;
+  PyObject* r = call_impl("booster_update_one_iter", "(L)", as_id(handle));
+  if (r == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterGetEval(void* handle, int data_idx, int* out_len,
+                                    double* out_results) {
+  Gil gil;
+  PyObject* r = call_impl(
+      "booster_get_eval", "(LiL)", as_id(handle), data_idx,
+      static_cast<long long>(reinterpret_cast<intptr_t>(out_results)));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterGetNumClasses(void* handle, int* out_len) {
+  Gil gil;
+  PyObject* r = call_impl("booster_get_num_classes", "(L)", as_id(handle));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterSaveModel(void* handle, int start_iteration,
+                                      int num_iteration,
+                                      const char* filename) {
+  Gil gil;
+  return void_call(call_impl("booster_save_model", "(Liis)", as_id(handle),
+                             start_iteration, num_iteration, filename));
+}
+
+LGBT_EXPORT int LGBM_BoosterPredictForMat(void* handle, const void* data,
+                                          int data_type, int32_t nrow,
+                                          int32_t ncol, int is_row_major,
+                                          int predict_type, int num_iteration,
+                                          const char* parameter,
+                                          int64_t* out_len,
+                                          double* out_result) {
+  Gil gil;
+  PyObject* r = call_impl(
+      "booster_predict_for_mat", "(LLiiiiiisL)", as_id(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(data)), data_type,
+      nrow, ncol, is_row_major, predict_type, num_iteration,
+      parameter ? parameter : "",
+      static_cast<long long>(reinterpret_cast<intptr_t>(out_result)));
+  if (r == nullptr) return -1;
+  *out_len = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterPredictForFile(void* handle,
+                                           const char* data_filename,
+                                           int data_has_header,
+                                           int predict_type, int num_iteration,
+                                           const char* parameter,
+                                           const char* result_filename) {
+  Gil gil;
+  return void_call(call_impl("booster_predict_for_file", "(Lsiiiss)",
+                             as_id(handle), data_filename, data_has_header,
+                             predict_type, num_iteration,
+                             parameter ? parameter : "", result_filename));
+}

@@ -121,8 +121,9 @@ MIN_BUCKET_LOG2 = 10  # smallest gathered-segment bucket (1024 rows)
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "num_leaves", "max_depth", "num_bins", "params", "chunk", "axis_name",
-        "split_fn", "psum_hist", "forced_splits", "cegb", "hist_mode",
+        "num_leaves", "max_depth", "num_bins", "params", "num_group_bins",
+        "chunk", "axis_name", "split_fn", "psum_hist", "forced_splits", "cegb",
+        "hist_mode",
     ),
 )
 def grow_tree(
@@ -136,6 +137,7 @@ def grow_tree(
     max_depth: int,
     num_bins: int,
     params: SplitParams,
+    num_group_bins: Optional[int] = None,
     chunk: int = 4096,
     axis_name: Optional[str] = None,
     split_fn=None,
@@ -167,10 +169,21 @@ def grow_tree(
     (serial_tree_learner.cpp:107-115), so acquisition penalties amortize. When
     ``cegb.enabled`` the return is (tree, leaf_id, new_cegb_state).
     """
-    F, N = bins.shape
+    N = bins.shape[1]
+    F = feature_meta["num_bin"].shape[0]
     M = num_leaves
     B = num_bins
     f32 = jnp.float32
+
+    # EFB bundling (efb.py): bins is [num_groups, N] with the offset encoding;
+    # histograms run over groups at group width, then remap to feature space.
+    bundled = "group_id" in feature_meta
+    if bundled:
+        gid_arr = feature_meta["group_id"].astype(jnp.int32)  # [F]
+        off_arr = feature_meta["bin_offset"].astype(jnp.int32)  # [F]
+        B_hist = num_group_bins if num_group_bins is not None else B
+    else:
+        B_hist = B
 
     if split_fn is None:
         split_fn = find_best_split
@@ -192,6 +205,39 @@ def grow_tree(
     missing_arr = feature_meta["missing_type"].astype(jnp.int32)
     default_bin_arr = feature_meta["default_bin"].astype(jnp.int32)
     mono_arr = feature_meta["monotone"].astype(jnp.int32)
+
+    if bundled:
+        # feature-space gather plan for the [G, B_hist] -> [F, B] remap:
+        # sub-bin s != default maps to group bin off + (s - (s > default));
+        # the default row is recovered from leaf totals (efb.py encoding)
+        s_iota = jnp.arange(B, dtype=jnp.int32)[None, :]  # [1, B]
+        s0_col = default_bin_arr[:, None]
+        efb_valid = (s_iota < num_bin_arr[:, None]) & (s_iota != s0_col)  # [F, B]
+        efb_gidx = jnp.where(
+            efb_valid, off_arr[:, None] + s_iota - (s_iota > s0_col), 0
+        )
+        f_iota = jnp.arange(F, dtype=jnp.int32)
+
+        def remap_hist(group_hist, sum_g, sum_h, sum_n):
+            """[G, B_hist, 3] group histogram -> [F, B, 3] feature histogram.
+
+            Must run AFTER any cross-shard psum: the default-bin row is
+            (global) leaf totals minus the feature's non-default rows."""
+            fh = group_hist[gid_arr[:, None], efb_gidx]  # [F, B, 3]
+            fh = fh * efb_valid[:, :, None].astype(fh.dtype)
+            totals = jnp.stack(
+                [sum_g.astype(fh.dtype), sum_h.astype(fh.dtype), sum_n.astype(fh.dtype)]
+            )
+            rest = totals[None, :] - jnp.sum(fh, axis=1)  # [F, 3]
+            return fh.at[f_iota, default_bin_arr].set(rest)
+
+        def decode_col(group_col, f):
+            """Group-encoded column -> feature f's sub-bins (efb.decode_subbin)."""
+            r = group_col - off_arr[f]
+            in_range = (r >= 0) & (r < num_bin_arr[f] - 1)
+            s = r + (r >= default_bin_arr[f]).astype(jnp.int32)
+            return jnp.where(in_range, s, default_bin_arr[f])
+
     is_cat_arr = feature_meta.get("is_categorical")
     if is_cat_arr is None:
         is_cat_arr = jnp.zeros((F,), bool)
@@ -231,7 +277,10 @@ def grow_tree(
         def make_branch(S):
             def branch(order, begin, pcnt, f, threshold, default_left):
                 start, off, seg, pos, valid = _segment_slice(order, begin, pcnt, S)
-                colv = bins[f, seg].astype(jnp.int32)
+                if bundled:
+                    colv = decode_col(bins[gid_arr[f], seg].astype(jnp.int32), f)
+                else:
+                    colv = bins[f, seg].astype(jnp.int32)
                 gl = _decision_go_left(colv, threshold, default_left, miss, dbin, nanb, iscat, member)
                 # stable 4-class sort keeps out-of-segment rows in place:
                 # [pre-segment | left | right | post-segment]
@@ -261,12 +310,12 @@ def grow_tree(
         def make_branch(S):
             def branch(order, begin, cnt):
                 _, _, seg, _, valid = _segment_slice(order, begin, cnt, S)
-                b_seg = jnp.take(bins, seg, axis=1)  # [F, S]
+                b_seg = jnp.take(bins, seg, axis=1)  # [F or G, S]
                 g_seg = jnp.take(grad, seg)
                 h_seg = jnp.take(hess, seg)
                 bag_seg = jnp.take(bag_mask, seg) * valid.astype(f32)
                 vals = leaf_values(g_seg, h_seg, bag_seg)
-                return leaf_histogram(b_seg, vals, B, chunk=chunk)
+                return leaf_histogram(b_seg, vals, B_hist, chunk=chunk)
 
             return branch
 
@@ -337,7 +386,7 @@ def grow_tree(
 
     # ---- root ----------------------------------------------------------
     root_vals = masked_values(jnp.ones((N,), f32))
-    root_hist = leaf_histogram(bins, root_vals, B, chunk=chunk, axis_name=hist_axis)
+    root_hist = leaf_histogram(bins, root_vals, B_hist, chunk=chunk, axis_name=hist_axis)
     # Root totals from the histogram of feature 0 would miss rows in padded bins;
     # sum the mask directly instead (psum'd under shard_map like GBDT's root sync,
     # serial_tree_learner.cpp:271 BeforeTrain).
@@ -348,6 +397,14 @@ def grow_tree(
         root_g = jax.lax.psum(root_g, axis_name)
         root_h = jax.lax.psum(root_h, axis_name)
         root_n = jax.lax.psum(root_n, axis_name)
+    if bundled:
+        if axis_name is not None and not psum_hist:
+            raise NotImplementedError(
+                "EFB-bundled datasets require globally combined histograms "
+                "(the default-bin remap needs global leaf totals); the "
+                "voting-parallel shard-local mode is unsupported"
+            )
+        root_hist = remap_hist(root_hist, root_g, root_h, root_n)
 
     no_con_min = jnp.full((M,), -jnp.inf, f32)
     no_con_max = jnp.full((M,), jnp.inf, f32)
@@ -464,7 +521,10 @@ def grow_tree(
                 s.leaf_phys.at[best_leaf].set(left_phys).at[new_leaf].set(right_phys)
             )
         else:
-            col = jax.lax.dynamic_slice(bins, (f, 0), (1, N))[0].astype(jnp.int32)
+            row = gid_arr[f] if bundled else f
+            col = jax.lax.dynamic_slice(bins, (row, 0), (1, N))[0].astype(jnp.int32)
+            if bundled:
+                col = decode_col(col, f)
             go_left = _decision_go_left(
                 col,
                 rec.threshold,
@@ -591,7 +651,14 @@ def grow_tree(
         else:
             small_mask = (leaf_id == small_idx).astype(f32)
             small_hist = leaf_histogram(
-                bins, masked_values(small_mask), B, chunk=chunk, axis_name=hist_axis
+                bins, masked_values(small_mask), B_hist, chunk=chunk, axis_name=hist_axis
+            )
+        if bundled:
+            small_hist = remap_hist(
+                small_hist,
+                jnp.where(left_smaller, rec.left_sum_grad, rec.right_sum_grad),
+                jnp.where(left_smaller, rec.left_sum_hess, rec.right_sum_hess),
+                jnp.where(left_smaller, rec.left_count, rec.right_count),
             )
         parent_hist = s.hist[best_leaf]
         large_hist = parent_hist - small_hist

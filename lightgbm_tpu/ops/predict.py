@@ -35,6 +35,11 @@ class PredictTree(NamedTuple):
     nan_bin: jax.Array  # [M-1] int32
     is_cat: jax.Array  # [M-1] bool
     cat_member: jax.Array  # [M-1, B] bool left-side bin membership bitsets
+    # EFB (efb.py): column to gather from the (possibly bundled) bin matrix,
+    # plus the per-node decode constants; efb all-False when unbundled
+    column: jax.Array  # [M-1] int32 (group id when bundled, else feature)
+    bin_offset: jax.Array  # [M-1] int32
+    efb: jax.Array  # [M-1] bool
     num_leaves: jax.Array  # scalar int32
 
 
@@ -47,6 +52,15 @@ def make_predict_tree(tree, feature_meta) -> PredictTree:
         is_cat_nodes = jnp.zeros(f.shape, bool)
     else:
         is_cat_nodes = is_cat.astype(bool)[f]
+    gid = feature_meta.get("group_id")
+    if gid is None:
+        column = f.astype(jnp.int32)
+        bin_offset = jnp.zeros(f.shape, jnp.int32)
+        efb = jnp.zeros(f.shape, bool)
+    else:
+        column = gid.astype(jnp.int32)[f]
+        bin_offset = feature_meta["bin_offset"].astype(jnp.int32)[f]
+        efb = jnp.ones(f.shape, bool)
     return PredictTree(
         split_feature=tree.split_feature.astype(jnp.int32),
         threshold_bin=tree.threshold_bin.astype(jnp.int32),
@@ -59,6 +73,9 @@ def make_predict_tree(tree, feature_meta) -> PredictTree:
         nan_bin=num_bin[f] - 1,
         is_cat=is_cat_nodes,
         cat_member=tree.cat_member,
+        column=column,
+        bin_offset=bin_offset,
+        efb=efb,
         num_leaves=tree.num_leaves.astype(jnp.int32),
     )
 
@@ -76,13 +93,19 @@ def tree_predict_leaf(bins_t: jax.Array, tree: PredictTree) -> jax.Array:
         node, _ = state
         active = node >= 0
         nsafe = jnp.maximum(node, 0)
-        f = tree.split_feature[nsafe]
-        col = jnp.take_along_axis(bins_t, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        col_idx = tree.column[nsafe]
+        col = jnp.take_along_axis(bins_t, col_idx[:, None], axis=1)[:, 0].astype(jnp.int32)
         thr = tree.threshold_bin[nsafe]
         dl = tree.default_left[nsafe]
         miss = tree.missing_type[nsafe]
         dbin = tree.default_bin[nsafe]
         nbin = tree.nan_bin[nsafe]
+        # EFB decode: group bin -> the node feature's sub-bin (efb.py encoding)
+        r = col - tree.bin_offset[nsafe]
+        dec = jnp.where(
+            (r >= 0) & (r < nbin), r + (r >= dbin).astype(jnp.int32), dbin
+        )
+        col = jnp.where(tree.efb[nsafe], dec, col)
         go_left = col <= thr
         go_left = jnp.where((miss == MISSING_ZERO) & (col == dbin), dl, go_left)
         go_left = jnp.where((miss == MISSING_NAN) & (col == nbin), dl, go_left)

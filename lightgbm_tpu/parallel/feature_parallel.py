@@ -46,6 +46,7 @@ def grow_tree_feature_parallel(
     max_depth: int,
     num_bins: int,
     params: SplitParams,
+    num_group_bins=None,
     chunk: int = 4096,
     forced_splits=(),
     cegb: CegbParams = CegbParams(),
@@ -93,6 +94,7 @@ def grow_tree_feature_parallel(
         num_leaves=num_leaves,
         max_depth=max_depth,
         num_bins=num_bins,
+        num_group_bins=num_group_bins,
         params=params,
         chunk=chunk,
         forced_splits=forced_splits,

@@ -80,6 +80,7 @@ def grow_tree_voting_parallel(
     top_k: int = 20,
     chunk: int = 4096,
     forced_splits=(),
+    num_group_bins=None,
 ):
     """Voting-parallel growth; returns (TreeArrays replicated, leaf_id sharded)."""
     meta_keys = sorted(feature_meta.keys())
@@ -104,6 +105,7 @@ def grow_tree_voting_parallel(
             split_fn=split_fn,
             psum_hist=False,  # histograms stay local; split_fn psums elected slice
             forced_splits=forced_splits,
+            num_group_bins=num_group_bins,
         )
 
     row = P("data")

@@ -12,6 +12,27 @@ from __future__ import annotations
 import os
 
 
+def honor_jax_platforms_env() -> None:
+    """Re-assert the JAX_PLATFORMS env var over sitecustomize's pin.
+
+    Plain jax honors the env var at import; an interpreter whose sitecustomize
+    later calls ``jax.config.update("jax_platforms", ...)`` silently overrides
+    it, so a subprocess launched with JAX_PLATFORMS=cpu would still try the
+    (possibly absent or hung) accelerator tunnel. Called from the package
+    __init__ to restore standard behavior; no-op when the env var is unset or
+    a backend already exists.
+    """
+    plats = os.environ.get("JAX_PLATFORMS")
+    if plats is None:
+        return
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", plats or None)
+    except (RuntimeError, ValueError):
+        pass  # backend already initialized — leave it alone
+
+
 def force_cpu_devices(n_devices: int = 1):
     """Pin jax to ``n_devices`` virtual CPU devices; returns the jax module.
 

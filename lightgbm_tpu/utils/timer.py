@@ -1,0 +1,81 @@
+"""Per-phase wall-clock timers (TIMETAG analogue).
+
+The reference accumulates per-phase timings (init/hist/find-split/split) behind
+the compile-time TIMETAG flag and prints them at teardown
+(/root/reference/src/treelearner/serial_tree_learner.cpp:19-47,
+src/boosting/gbdt.cpp:29-42). Here whole-tree growth is one fused XLA program,
+so the observable phases are the training-loop stages around it; enable with
+the LIGHTGBM_TPU_TIMETAG=1 environment variable (the runtime analogue of the
+reference's compile-time switch). Timed blocks block_until_ready their results
+so device work is attributed to the phase that launched it.
+
+For kernel-level breakdowns use LIGHTGBM_TPU_PROFILE=<dir> instead, which
+wraps training in a ``jax.profiler`` trace readable in TensorBoard/Perfetto —
+the TPU-native counterpart of poking timers into the C++ learner.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Dict
+
+from . import log
+
+ENV_FLAG = "LIGHTGBM_TPU_TIMETAG"
+ENV_PROFILE = "LIGHTGBM_TPU_PROFILE"
+
+
+def timetag_enabled() -> bool:
+    return os.environ.get(ENV_FLAG, "") not in ("", "0")
+
+
+class PhaseTimers:
+    """Accumulates wall seconds per named phase; no-op unless enabled."""
+
+    def __init__(self, enabled: bool | None = None) -> None:
+        self.enabled = timetag_enabled() if enabled is None else enabled
+        self.seconds: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            dt = time.time() - t0
+            self.seconds[name] = self.seconds.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> None:
+        if not self.enabled or not self.seconds:
+            return
+        total = sum(self.seconds.values())
+        log.info("phase timing (TIMETAG):")
+        for name, secs in sorted(self.seconds.items(), key=lambda kv: -kv[1]):
+            log.info(
+                "  %-18s %8.3fs  (%5.1f%%, %d calls)"
+                % (name, secs, 100.0 * secs / max(total, 1e-12), self.counts[name])
+            )
+        log.info("  %-18s %8.3fs" % ("total", total))
+
+
+@contextlib.contextmanager
+def maybe_profile():
+    """jax.profiler trace around training when LIGHTGBM_TPU_PROFILE is set."""
+    out_dir = os.environ.get(ENV_PROFILE, "")
+    if not out_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(out_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("Wrote jax profiler trace to %s" % out_dir)

@@ -1,0 +1,260 @@
+"""LGBM_* C ABI smoke test, shaped like the reference's ctypes suite
+(/root/reference/tests/c_api_test/test_.py:65-260): dataset creation from
+file/mat/CSR/CSC with a reference dataset, SetField, binary save/reload,
+booster train/eval/save, model reload + predict-for-mat/file.
+"""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.capi import (
+    C_API_DTYPE_FLOAT32,
+    C_API_DTYPE_FLOAT64,
+    C_API_DTYPE_INT32,
+    C_API_PREDICT_NORMAL,
+    load_lib,
+)
+
+LIB = load_lib()
+
+pytestmark = pytest.mark.skipif(LIB is None, reason="C API lib unavailable")
+
+EXAMPLES = "/root/reference/examples/binary_classification"
+
+
+def c_str(s):
+    return ctypes.c_char_p(s.encode("utf-8"))
+
+
+def _read_tsv(path):
+    rows = np.loadtxt(path, dtype=np.float64)
+    return rows[:, 1:], rows[:, 0].astype(np.float32)
+
+
+def _check(rc):
+    assert rc == 0, LIB.LGBM_GetLastError().decode()
+
+
+def _from_mat(X, label, params, ref=None):
+    handle = ctypes.c_void_p()
+    flat = np.ascontiguousarray(X, np.float64)
+    _check(
+        LIB.LGBM_DatasetCreateFromMat(
+            flat.ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64,
+            X.shape[0],
+            X.shape[1],
+            1,
+            c_str(params),
+            ref,
+            ctypes.byref(handle),
+        )
+    )
+    if label is not None:
+        lab = np.ascontiguousarray(label, np.float32)
+        _check(
+            LIB.LGBM_DatasetSetField(
+                handle, c_str("label"), lab.ctypes.data_as(ctypes.c_void_p),
+                len(lab), C_API_DTYPE_FLOAT32,
+            )
+        )
+    return handle
+
+
+def test_dataset_surface(tmp_path):
+    if not os.path.isdir(EXAMPLES):
+        pytest.skip("reference examples not mounted")
+    # from file
+    train = ctypes.c_void_p()
+    _check(
+        LIB.LGBM_DatasetCreateFromFile(
+            c_str(f"{EXAMPLES}/binary.train"), c_str("max_bin=15"), None,
+            ctypes.byref(train),
+        )
+    )
+    num_data = ctypes.c_int()
+    num_feature = ctypes.c_int()
+    _check(LIB.LGBM_DatasetGetNumData(train, ctypes.byref(num_data)))
+    _check(LIB.LGBM_DatasetGetNumFeature(train, ctypes.byref(num_feature)))
+    assert num_data.value == 7000
+    assert num_feature.value == 28
+
+    X, y = _read_tsv(f"{EXAMPLES}/binary.test")
+
+    # from mat, binned against the train set's mappers
+    test_mat = _from_mat(X, y, "max_bin=15", ref=train)
+    _check(LIB.LGBM_DatasetGetNumData(test_mat, ctypes.byref(num_data)))
+    assert num_data.value == 500
+    _check(LIB.LGBM_DatasetFree(test_mat))
+
+    # from CSR
+    nz = X != 0
+    indptr = np.zeros(X.shape[0] + 1, np.int32)
+    indptr[1:] = np.cumsum(nz.sum(axis=1)).astype(np.int32)
+    indices = np.nonzero(nz)[1].astype(np.int32)
+    data = X[nz].astype(np.float64)
+    h = ctypes.c_void_p()
+    _check(
+        LIB.LGBM_DatasetCreateFromCSR(
+            indptr.ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_INT32,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            data.ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64,
+            len(indptr),
+            len(data),
+            X.shape[1],
+            c_str("max_bin=15"),
+            train,
+            ctypes.byref(h),
+        )
+    )
+    _check(LIB.LGBM_DatasetGetNumData(h, ctypes.byref(num_data)))
+    assert num_data.value == 500
+    _check(LIB.LGBM_DatasetFree(h))
+
+    # from CSC
+    nzc = X.T != 0
+    col_ptr = np.zeros(X.shape[1] + 1, np.int32)
+    col_ptr[1:] = np.cumsum(nzc.sum(axis=1)).astype(np.int32)
+    row_idx = np.nonzero(nzc)[1].astype(np.int32)
+    cdata = X.T[nzc].astype(np.float64)
+    h = ctypes.c_void_p()
+    _check(
+        LIB.LGBM_DatasetCreateFromCSC(
+            col_ptr.ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_INT32,
+            row_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cdata.ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64,
+            len(col_ptr),
+            len(cdata),
+            X.shape[0],
+            c_str("max_bin=15"),
+            train,
+            ctypes.byref(h),
+        )
+    )
+    _check(LIB.LGBM_DatasetGetNumData(h, ctypes.byref(num_data)))
+    assert num_data.value == 500
+    _check(LIB.LGBM_DatasetFree(h))
+
+    # binary round-trip
+    binpath = str(tmp_path / "train.bin")
+    _check(LIB.LGBM_DatasetSaveBinary(train, c_str(binpath)))
+    _check(LIB.LGBM_DatasetFree(train))
+    train2 = ctypes.c_void_p()
+    _check(
+        LIB.LGBM_DatasetCreateFromFile(
+            c_str(binpath), c_str("max_bin=15"), None, ctypes.byref(train2)
+        )
+    )
+    _check(LIB.LGBM_DatasetGetNumData(train2, ctypes.byref(num_data)))
+    assert num_data.value == 7000
+    _check(LIB.LGBM_DatasetFree(train2))
+
+
+def test_booster_lifecycle(tmp_path):
+    rng = np.random.RandomState(0)
+    n = 1200
+    X = rng.randn(n, 6)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(np.float32)
+    train = _from_mat(X[: n // 2], y[: n // 2], "max_bin=31")
+    test = _from_mat(X[n // 2 :], y[n // 2 :], "max_bin=31", ref=train)
+
+    booster = ctypes.c_void_p()
+    _check(
+        LIB.LGBM_BoosterCreate(
+            train,
+            c_str("app=binary metric=auc num_leaves=15 min_data_in_leaf=10 verbose=-1"),
+            ctypes.byref(booster),
+        )
+    )
+    _check(LIB.LGBM_BoosterAddValidData(booster, test))
+
+    is_finished = ctypes.c_int(0)
+    auc = np.zeros(1, np.float64)
+    out_len = ctypes.c_int(0)
+    for _ in range(10):
+        _check(LIB.LGBM_BoosterUpdateOneIter(booster, ctypes.byref(is_finished)))
+        _check(
+            LIB.LGBM_BoosterGetEval(
+                booster, 1, ctypes.byref(out_len),
+                auc.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            )
+        )
+    assert out_len.value == 1
+    assert auc[0] > 0.9, auc[0]
+
+    nclass = ctypes.c_int(0)
+    _check(LIB.LGBM_BoosterGetNumClasses(booster, ctypes.byref(nclass)))
+    assert nclass.value == 1
+
+    model_path = str(tmp_path / "model.txt")
+    _check(LIB.LGBM_BoosterSaveModel(booster, 0, -1, c_str(model_path)))
+    _check(LIB.LGBM_BoosterFree(booster))
+    _check(LIB.LGBM_DatasetFree(train))
+    _check(LIB.LGBM_DatasetFree(test))
+
+    # reload + predict
+    booster2 = ctypes.c_void_p()
+    n_iters = ctypes.c_int(0)
+    _check(
+        LIB.LGBM_BoosterCreateFromModelfile(
+            c_str(model_path), ctypes.byref(n_iters), ctypes.byref(booster2)
+        )
+    )
+    assert n_iters.value == 10
+    Xq = np.ascontiguousarray(X[: n // 2], np.float64)
+    preds = np.zeros(n // 2, np.float64)
+    pred_len = ctypes.c_int64(0)
+    _check(
+        LIB.LGBM_BoosterPredictForMat(
+            booster2,
+            Xq.ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64,
+            Xq.shape[0],
+            Xq.shape[1],
+            1,
+            C_API_PREDICT_NORMAL,
+            -1,
+            c_str(""),
+            ctypes.byref(pred_len),
+            preds.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        )
+    )
+    assert pred_len.value == n // 2
+    # python API agrees with the ABI surface
+    import lightgbm_tpu as lgb
+
+    bst = lgb.Booster(model_file=model_path)
+    np.testing.assert_allclose(preds, bst.predict(X[: n // 2]), rtol=1e-12)
+
+    # predict-for-file
+    data_file = tmp_path / "pred_in.tsv"
+    with open(data_file, "w") as fh:
+        for i in range(50):
+            fh.write("0\t" + "\t".join("%.8f" % v for v in X[i]) + "\n")
+    result_file = tmp_path / "pred_out.txt"
+    _check(
+        LIB.LGBM_BoosterPredictForFile(
+            booster2, c_str(str(data_file)), 0, C_API_PREDICT_NORMAL, -1,
+            c_str(""), c_str(str(result_file)),
+        )
+    )
+    got = np.loadtxt(result_file)
+    np.testing.assert_allclose(got, bst.predict(X[:50]), rtol=1e-9)
+    _check(LIB.LGBM_BoosterFree(booster2))
+
+
+def test_get_last_error_reports():
+    bad = ctypes.c_void_p()
+    rc = LIB.LGBM_DatasetCreateFromFile(
+        c_str("/nonexistent/definitely_missing.txt"), c_str(""), None,
+        ctypes.byref(bad),
+    )
+    assert rc == -1
+    msg = LIB.LGBM_GetLastError().decode()
+    assert "missing" in msg or "No such" in msg or "not" in msg.lower()

@@ -164,7 +164,13 @@ def test_json_dump_categorical(cat_data):
 
     walk(tree0)
     assert found, "expected a categorical node in the dump"
-    assert all(isinstance(t, str) and "||" in t or isinstance(t, str) for t in found)
+    import re
+
+    # every categorical threshold is a "a||b||c" category-value list
+    assert all(
+        isinstance(t, str) and re.fullmatch(r"\d+(\|\|\d+)*", t) for t in found
+    )
+    assert any("||" in t for t in found), "expected a multi-category node"
 
 
 def test_codegen_compiles_with_categorical(cat_data, tmp_path):

@@ -1,0 +1,235 @@
+"""Golden cross-validation against the reference implementation.
+
+Fixtures in tests/golden/ were produced by the reference LightGBM CLI (built
+from /root/reference at v2.2.4) on its own example datasets
+(/root/reference/examples/*/train.conf, num_trees=25): ``model.txt`` is the
+reference-trained model, ``pred.txt`` the reference's predictions on the
+example's test set. These tests prove
+  (a) reference model files — including categorical bitset models — load and
+      predict identically through this package (gbdt_model_text.cpp parity),
+  (b) training here with the same conf reaches the reference's metric values
+      within tolerance (RNG for bagging/feature_fraction differs by design).
+
+Mirrors the reference's own consistency suite
+(tests/python_package_test/test_consistency.py:68-103).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+EXAMPLES = "/root/reference/examples"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(EXAMPLES), reason="reference examples not mounted"
+)
+
+
+def _load_tsv(path):
+    data = np.loadtxt(path, dtype=np.float64)
+    return data[:, 1:], data[:, 0]
+
+
+def _load_svm(path, n_features):
+    X, y = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            y.append(float(parts[0]))
+            row = np.zeros(n_features)
+            for tok in parts[1:]:
+                k, v = tok.split(":")
+                row[int(k)] = float(v)
+            X.append(row)
+    return np.asarray(X), np.asarray(y)
+
+
+class TestReferenceModelLoad:
+    """Reference model.txt -> our Booster -> predictions == reference's."""
+
+    def test_binary_model_predicts_identically(self):
+        X, _ = _load_tsv(f"{EXAMPLES}/binary_classification/binary.test")
+        bst = lgb.Booster(model_file=f"{GOLDEN}/binary_classification/model.txt")
+        ref = np.loadtxt(f"{GOLDEN}/binary_classification/pred.txt")
+        got = bst.predict(X)
+        np.testing.assert_allclose(got, ref, rtol=1e-9, atol=1e-12)
+
+    def test_regression_model_predicts_identically(self):
+        X, _ = _load_tsv(f"{EXAMPLES}/regression/regression.test")
+        bst = lgb.Booster(model_file=f"{GOLDEN}/regression/model.txt")
+        ref = np.loadtxt(f"{GOLDEN}/regression/pred.txt")
+        np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-9, atol=1e-12)
+
+    def test_lambdarank_model_predicts_identically(self):
+        bst = lgb.Booster(model_file=f"{GOLDEN}/lambdarank/model.txt")
+        X, _ = _load_svm(f"{EXAMPLES}/lambdarank/rank.test", bst.num_feature())
+        ref = np.loadtxt(f"{GOLDEN}/lambdarank/pred.txt")
+        np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-9, atol=1e-12)
+
+    def test_multiclass_model_predicts_identically(self):
+        X, _ = _load_tsv(f"{EXAMPLES}/multiclass_classification/multiclass.test")
+        bst = lgb.Booster(
+            model_file=f"{GOLDEN}/multiclass_classification/model.txt"
+        )
+        ref = np.loadtxt(f"{GOLDEN}/multiclass_classification/pred.txt")
+        np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-9, atol=1e-12)
+
+    def test_categorical_bitset_model_predicts_identically(self):
+        """A reference model with multi-word cat_threshold bitsets round-trips
+        through our parser and CategoricalDecision (tree.h:255-271)."""
+        X, _ = _load_tsv(f"{GOLDEN}/categorical/cat.test")
+        bst = lgb.Booster(model_file=f"{GOLDEN}/categorical/model.txt")
+        assert any(t.num_cat > 0 for t in bst._gbdt.trees())
+        ref = np.loadtxt(f"{GOLDEN}/categorical/pred.txt")
+        np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-9, atol=1e-12)
+
+    def test_reference_model_reserializes(self):
+        """Loaded reference model -> to-string -> reload -> same predictions."""
+        X, _ = _load_tsv(f"{GOLDEN}/categorical/cat.test")
+        bst = lgb.Booster(model_file=f"{GOLDEN}/categorical/model.txt")
+        bst2 = lgb.Booster(model_str=bst.model_to_string())
+        np.testing.assert_array_equal(bst.predict(X), bst2.predict(X))
+
+
+class TestTrainParity:
+    """Training with the example confs' params reaches reference metrics."""
+
+    def test_binary_conf(self):
+        # examples/binary_classification/train.conf, num_trees=25; reference
+        # final: train auc 0.915346, valid auc 0.817015 (train.log)
+        Xtr, ytr = _load_tsv(f"{EXAMPLES}/binary_classification/binary.train")
+        Xte, yte = _load_tsv(f"{EXAMPLES}/binary_classification/binary.test")
+        wtr = np.loadtxt(f"{EXAMPLES}/binary_classification/binary.train.weight")
+        params = {
+            "objective": "binary",
+            "max_bin": 255,
+            "learning_rate": 0.1,
+            "num_leaves": 63,
+            "feature_fraction": 0.8,
+            "bagging_freq": 5,
+            "bagging_fraction": 0.8,
+            "min_data_in_leaf": 50,
+            "min_sum_hessian_in_leaf": 5.0,
+            "verbose": -1,
+        }
+        params["metric"] = ["auc"]
+        dtr = lgb.Dataset(Xtr, label=ytr, weight=wtr)
+        res = {}
+        bst = lgb.train(
+            params,
+            dtr,
+            num_boost_round=25,
+            valid_sets=[dtr, lgb.Dataset(Xte, label=yte, reference=dtr)],
+            valid_names=["train", "valid"],
+            evals_result=res,
+            verbose_eval=False,
+        )
+        train_auc = res["train"]["auc"][-1]
+        valid_auc = res["valid"]["auc"][-1]
+        assert abs(train_auc - 0.915346) < 0.02, train_auc
+        assert abs(valid_auc - 0.817015) < 0.02, valid_auc
+
+    def test_regression_conf(self):
+        # examples/regression/train.conf, num_trees=25; reference final:
+        # train l2 0.260223, valid l2 0.266351
+        Xtr, ytr = _load_tsv(f"{EXAMPLES}/regression/regression.train")
+        Xte, yte = _load_tsv(f"{EXAMPLES}/regression/regression.test")
+        params = {
+            "objective": "regression",
+            "metric": "l2",
+            "max_bin": 255,
+            "learning_rate": 0.05,
+            "num_leaves": 31,
+            "feature_fraction": 0.9,
+            "bagging_freq": 5,
+            "bagging_fraction": 0.8,
+            "min_data_in_leaf": 100,
+            "min_sum_hessian_in_leaf": 5.0,
+            "verbose": -1,
+        }
+        # the reference CLI auto-loads the .init sidecars as init scores
+        # (dataset_loader.cpp LoadInitialScore)
+        init_tr = np.loadtxt(f"{EXAMPLES}/regression/regression.train.init")
+        init_te = np.loadtxt(f"{EXAMPLES}/regression/regression.test.init")
+        dtr = lgb.Dataset(Xtr, label=ytr, init_score=init_tr)
+        bst = lgb.train(params, dtr, num_boost_round=25)
+        l2 = float(np.mean((init_te + bst.predict(Xte, raw_score=True) - yte) ** 2))
+        assert abs(l2 - 0.266351) < 0.02, l2  # reference valid l2
+
+    def test_lambdarank_conf(self):
+        # examples/lambdarank/train.conf, num_trees=25; reference final:
+        # valid ndcg@5 0.651916
+        # libsvm feature ids run 1..300 -> 301 zero-based columns
+        Xtr, ytr = _load_svm(f"{EXAMPLES}/lambdarank/rank.train", 301)
+        Xte, yte = _load_svm(f"{EXAMPLES}/lambdarank/rank.test", 301)
+        qtr = np.loadtxt(f"{EXAMPLES}/lambdarank/rank.train.query", dtype=int)
+        qte = np.loadtxt(f"{EXAMPLES}/lambdarank/rank.test.query", dtype=int)
+        params = {
+            "objective": "lambdarank",
+            "metric": "ndcg",
+            "ndcg_eval_at": [1, 3, 5],
+            "max_bin": 255,
+            "learning_rate": 0.1,
+            "num_leaves": 31,
+            "min_data_in_leaf": 50,
+            "min_sum_hessian_in_leaf": 5.0,
+            "verbose": -1,
+        }
+        dtr = lgb.Dataset(Xtr, label=ytr, group=qtr)
+        res = {}
+        bst = lgb.train(
+            params,
+            dtr,
+            num_boost_round=25,
+            valid_sets=[lgb.Dataset(Xte, label=yte, group=qte, reference=dtr)],
+            valid_names=["valid"],
+            evals_result=res,
+            verbose_eval=False,
+        )
+        ndcg5 = res["valid"]["ndcg@5"][-1]
+        assert abs(ndcg5 - 0.651916) < 0.04, ndcg5
+
+
+class TestCliConsistency:
+    """Our CLI consumes the reference's own train.conf files
+    (test_consistency.py's CLI<->python axis)."""
+
+    def test_cli_trains_from_reference_conf(self, tmp_path):
+        import subprocess
+        import sys
+
+        conf = tmp_path / "train.conf"
+        base = f"{EXAMPLES}/binary_classification"
+        text = open(f"{base}/train.conf").read()
+        conf.write_text(text)
+        out_model = tmp_path / "model.txt"
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        subprocess.check_call(
+            [
+                sys.executable,
+                "-m",
+                "lightgbm_tpu",
+                f"config={conf}",
+                f"data={base}/binary.train",
+                f"valid_data={base}/binary.test",
+                "num_trees=5",
+                f"output_model={out_model}",
+            ],
+            env=env,
+            cwd="/root/repo",
+        )
+        assert out_model.exists()
+        bst = lgb.Booster(model_file=str(out_model))
+        X, y = _load_tsv(f"{base}/binary.test")
+        p = bst.predict(X)
+        order = np.argsort(p)
+        ranks = np.empty(len(y))
+        ranks[order] = np.arange(len(y))
+        pos = y == 1
+        aucv = (ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2) / (
+            pos.sum() * (len(y) - pos.sum())
+        )
+        assert aucv > 0.7

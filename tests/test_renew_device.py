@@ -1,0 +1,131 @@
+"""Device segment-percentile leaf renewal vs the host oracle.
+
+The host per-leaf percentile loop (objective.py renew_leaf_outputs) replicates
+regression_objective.hpp:18-75 exactly; segment_percentile must agree with it
+so L1/quantile/MAPE leaf renewal can run on device without N-sized host
+round-trips per tree (RenewTreeOutput, regression_objective.hpp:189-548).
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.objective import (
+    percentile,
+    segment_percentile,
+    weighted_percentile,
+)
+
+
+@pytest.mark.parametrize("alpha", [0.5, 0.9, 0.1])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_unweighted_matches_host(alpha, seed):
+    rng = np.random.RandomState(seed)
+    n, m = 5000, 16
+    vals = rng.randn(n).astype(np.float32)
+    leaf = rng.randint(0, m, n).astype(np.int32)
+    sel = rng.rand(n) > 0.3
+    old = np.full(m, 123.0, np.float32)
+
+    got = np.asarray(
+        segment_percentile(
+            jnp.asarray(vals), jnp.asarray(leaf), jnp.asarray(sel), None,
+            jnp.asarray(old), num_leaves=m, alpha=alpha, weighted=False,
+        )
+    )
+    for lf in range(m):
+        mask = (leaf == lf) & sel
+        if not mask.any():
+            expect = 123.0
+        else:
+            expect = percentile(vals[mask].astype(np.float64), alpha)
+        np.testing.assert_allclose(got[lf], expect, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("alpha", [0.5, 0.75])
+def test_weighted_matches_host(alpha):
+    rng = np.random.RandomState(3)
+    n, m = 4000, 8
+    vals = rng.randn(n).astype(np.float32)
+    w = rng.rand(n).astype(np.float32) * 2.0
+    leaf = rng.randint(0, m, n).astype(np.int32)
+    sel = rng.rand(n) > 0.2
+    old = np.zeros(m, np.float32)
+
+    got = np.asarray(
+        segment_percentile(
+            jnp.asarray(vals), jnp.asarray(leaf), jnp.asarray(sel),
+            jnp.asarray(w), jnp.asarray(old), num_leaves=m, alpha=alpha,
+            weighted=True,
+        )
+    )
+    for lf in range(m):
+        mask = (leaf == lf) & sel
+        expect = (
+            0.0
+            if not mask.any()
+            else weighted_percentile(
+                vals[mask].astype(np.float64), w[mask].astype(np.float64), alpha
+            )
+        )
+        np.testing.assert_allclose(got[lf], expect, rtol=1e-4, atol=1e-5)
+
+
+def test_empty_and_singleton_leaves():
+    vals = jnp.asarray(np.array([5.0, -2.0], np.float32))
+    leaf = jnp.asarray(np.array([0, 2], np.int32))
+    sel = jnp.asarray(np.ones(2, bool))
+    old = jnp.asarray(np.array([9.0, 9.0, 9.0, 9.0], np.float32))
+    got = np.asarray(
+        segment_percentile(
+            vals, leaf, sel, None, old, num_leaves=4, alpha=0.5, weighted=False
+        )
+    )
+    np.testing.assert_allclose(got, [5.0, 9.0, -2.0, 9.0])
+
+
+def test_l1_training_uses_device_renewal():
+    """End-to-end: regression_l1 training produces leaf medians (and matches a
+    small host-verified run)."""
+    rng = np.random.RandomState(0)
+    n = 1200
+    X = rng.randn(n, 5)
+    y = X[:, 0] * 3 + rng.standard_cauchy(n) * 0.1
+    bst = lgb.train(
+        {
+            "objective": "regression_l1",
+            "num_leaves": 7,
+            "min_data_in_leaf": 30,
+            "verbose": -1,
+            "learning_rate": 0.5,
+        },
+        lgb.Dataset(X, label=y),
+        num_boost_round=8,
+    )
+    pred = bst.predict(X)
+    mae = float(np.mean(np.abs(pred - y)))
+    assert mae < np.mean(np.abs(y - np.median(y))), mae
+
+
+def test_quantile_with_bagging_and_weights():
+    rng = np.random.RandomState(1)
+    n = 1500
+    X = rng.randn(n, 4)
+    y = X[:, 0] + rng.randn(n) * 0.5
+    w = rng.rand(n) + 0.5
+    bst = lgb.train(
+        {
+            "objective": "quantile",
+            "alpha": 0.8,
+            "num_leaves": 7,
+            "bagging_freq": 1,
+            "bagging_fraction": 0.7,
+            "verbose": -1,
+        },
+        lgb.Dataset(X, label=y, weight=w),
+        num_boost_round=8,
+    )
+    pred = bst.predict(X)
+    # ~80% of rows should sit under the 0.8-quantile prediction
+    frac_under = float(np.mean(y <= pred))
+    assert 0.6 < frac_under < 0.95, frac_under

@@ -1,0 +1,183 @@
+"""Sparse ingestion + EFB bundling (dataset.cpp:68-178, efb.py).
+
+With max_conflict_rate=0 bundling is exact: a bundled run must produce the
+same model as the densified run on the same data. The memory property is the
+point — a 5000-feature 99%-sparse dataset must construct a bin matrix with
+width << F and train in bounded memory.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+sparse = pytest.importorskip("scipy.sparse")
+
+
+def _random_sparse(n, f, density, seed=0, nan_frac=0.0):
+    rng = np.random.RandomState(seed)
+    X = sparse.random(
+        n, f, density=density, format="csr", random_state=rng, dtype=np.float64
+    )
+    y = np.asarray(
+        (X[:, 0].toarray().ravel() + X[:, 1].toarray().ravel()) > 0.2, np.float64
+    )
+    # some label signal from many columns so trees use bundled features
+    sig = np.zeros(n)
+    for j in range(0, min(f, 50), 5):
+        sig += X[:, j].toarray().ravel()
+    y = (sig + 0.1 * rng.randn(n) > np.median(sig)).astype(np.float64)
+    return X, y
+
+
+PARAMS = {
+    "objective": "binary",
+    "num_leaves": 15,
+    "min_data_in_leaf": 20,
+    "learning_rate": 0.2,
+    "verbose": -1,
+    "max_conflict_rate": 0.0,
+}
+
+
+def test_efb_bundles_and_matches_dense():
+    X, y = _random_sparse(2000, 80, density=0.02, seed=3)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    binned = ds._binned
+    assert binned.is_bundled, "2%-dense features should bundle"
+    assert binned.num_groups <= binned.num_features / 4
+
+    bst_sparse = lgb.train(PARAMS, lgb.Dataset(X, label=y), num_boost_round=8)
+    bst_dense = lgb.train(
+        PARAMS, lgb.Dataset(X.toarray(), label=y), num_boost_round=8
+    )
+    Xd = X.toarray()
+    # conflict-free bundling is exact up to f32 summation order (the bundled
+    # default-bin row is totals-minus-rest): same splits, near-equal values
+    np.testing.assert_allclose(
+        bst_sparse.predict(Xd), bst_dense.predict(Xd), rtol=1e-6, atol=1e-7
+    )
+    for ts, td in zip(bst_sparse._gbdt.trees(), bst_dense._gbdt.trees()):
+        np.testing.assert_array_equal(ts.split_feature, td.split_feature)
+        np.testing.assert_allclose(ts.threshold, td.threshold, rtol=1e-12)
+
+
+def test_wide_sparse_trains_in_bounded_memory():
+    n, f = 3000, 5000
+    X, y = _random_sparse(n, f, density=0.01, seed=7)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    binned = ds._binned
+    assert binned.is_bundled
+    width = binned.num_groups
+    assert width < f / 10, "bundled width %d not << %d" % (width, f)
+    # the bin matrix is [G, N] uint8 -> actually bounded
+    assert binned.bins.nbytes < 50e6
+    bst = lgb.train(PARAMS, ds, num_boost_round=5)
+    pred = bst.predict(X.toarray()[:100])
+    assert np.all(np.isfinite(pred))
+
+
+def test_valid_set_binned_against_bundled_reference():
+    X, y = _random_sparse(1500, 60, density=0.03, seed=5)
+    Xv, yv = _random_sparse(400, 60, density=0.03, seed=6)
+    dtr = lgb.Dataset(X, label=y)
+    res = {}
+    lgb.train(
+        dict(PARAMS, metric="binary_logloss"),
+        dtr,
+        num_boost_round=5,
+        valid_sets=[lgb.Dataset(Xv, label=yv, reference=dtr)],
+        valid_names=["valid"],
+        evals_result=res,
+        verbose_eval=False,
+    )
+    assert len(res["valid"]["binary_logloss"]) == 5
+    assert np.isfinite(res["valid"]["binary_logloss"][-1])
+
+
+def test_dense_valid_set_against_bundled_reference_matches_sparse():
+    """A dense ndarray valid set must be re-encoded into the bundled layout of
+    its (sparse, EFB-bundled) reference — regression for the path that built a
+    per-feature matrix and let group-space decode read it as groups."""
+    X, y = _random_sparse(1500, 60, density=0.03, seed=5)
+    Xv, yv = _random_sparse(400, 60, density=0.03, seed=6)
+    dtr = lgb.Dataset(X, label=y)
+
+    def run(valid_data):
+        res = {}
+        lgb.train(
+            dict(PARAMS, metric="binary_logloss"),
+            dtr,
+            num_boost_round=5,
+            valid_sets=[lgb.Dataset(valid_data, label=yv, reference=dtr)],
+            valid_names=["valid"],
+            evals_result=res,
+            verbose_eval=False,
+        )
+        return res["valid"]["binary_logloss"]
+
+    ll_sparse = run(Xv)
+    ll_dense = run(Xv.toarray())
+    np.testing.assert_allclose(ll_dense, ll_sparse, rtol=1e-9)
+
+
+def test_binary_file_roundtrip_preserves_bundling(tmp_path):
+    X, y = _random_sparse(800, 40, density=0.05, seed=9)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    if not ds._binned.is_bundled:
+        pytest.skip("no bundle formed")
+    path = str(tmp_path / "sparse.bin")
+    from lightgbm_tpu.dataset import load_binary_dataset, save_binary_dataset
+
+    save_binary_dataset(ds._binned, path)
+    re = load_binary_dataset(path)
+    assert re.is_bundled
+    np.testing.assert_array_equal(re.bins, ds._binned.bins)
+    np.testing.assert_array_equal(re.group_id, ds._binned.group_id)
+
+
+def test_masked_mode_matches_bucketed_on_bundled():
+    """The two histogram modes agree on bundled data (differential oracle)."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import construct_dataset
+    from lightgbm_tpu.ops.grow import grow_tree
+    from lightgbm_tpu.ops.split import SplitParams
+
+    X, y = _random_sparse(1200, 50, density=0.04, seed=11)
+    ds = construct_dataset(X, Config.from_params(PARAMS), label=y)
+    assert ds.is_bundled
+    meta = {k: jnp.asarray(v) for k, v in ds.feature_meta_arrays().items()}
+    n, f = ds.num_data, ds.num_features
+    score = np.zeros(n, np.float32)
+    p = 1.0 / (1.0 + np.exp(-score))
+    kw = dict(
+        num_leaves=15,
+        max_depth=-1,
+        num_bins=ds.max_num_bin,
+        num_group_bins=ds.max_group_bins,
+        params=SplitParams(0.0, 0.0, 0.0, 20, 1e-3, 0.0),
+        chunk=512,
+    )
+    args = (
+        jnp.asarray(ds.bins),
+        jnp.asarray(p - y, jnp.float32),
+        jnp.asarray(p * (1 - p), jnp.float32),
+        jnp.ones((n,), jnp.float32),
+        jnp.ones((f,), bool),
+        meta,
+    )
+    tm, lm = grow_tree(*args, hist_mode="masked", **kw)
+    tb, lb = grow_tree(*args, hist_mode="bucketed", **kw)
+    assert int(tm.num_leaves) == int(tb.num_leaves)
+    nl = int(tm.num_leaves)
+    np.testing.assert_array_equal(
+        np.asarray(tm.split_feature)[: nl - 1], np.asarray(tb.split_feature)[: nl - 1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tm.threshold_bin)[: nl - 1], np.asarray(tb.threshold_bin)[: nl - 1]
+    )
+    np.testing.assert_array_equal(np.asarray(lm), np.asarray(lb))
