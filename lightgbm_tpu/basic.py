@@ -25,6 +25,52 @@ from .utils import log
 from .utils.log import LightGBMError
 
 
+def _data_from_pandas(data, feature_name="auto", categorical_feature="auto",
+                      pandas_categorical=None):
+    """DataFrame -> (float64 matrix, names, categorical cols, category lists).
+
+    Reference semantics (python-package/lightgbm/basic.py:255-344), own shape:
+    'category'-dtype columns are replaced by their integer codes (NaN for
+    missing); the per-column category order is captured at train time and
+    re-applied at predict time so codes stay aligned. Returns None when
+    ``data`` is not a DataFrame.
+    """
+    if not (hasattr(data, "dtypes") and hasattr(data, "columns")):
+        return None
+    df = data
+    names = (
+        [str(c) for c in df.columns] if feature_name == "auto" else list(feature_name)
+    )
+    cat_cols = [c for c in df.columns if str(df[c].dtype) == "category"]
+    if categorical_feature == "auto":
+        categorical = [str(c) for c in cat_cols]
+    else:
+        categorical = list(categorical_feature)
+    if pandas_categorical is None:  # training
+        pandas_categorical = [list(df[c].cat.categories) for c in cat_cols]
+    elif len(cat_cols) != len(pandas_categorical):  # prediction
+        raise LightGBMError(
+            "train and predict data have a different number of categorical columns"
+        )
+    out = np.empty(df.shape, np.float64)
+    for j, c in enumerate(df.columns):
+        col = df[c]
+        if str(col.dtype) == "category":
+            cats = pandas_categorical[cat_cols.index(c)]
+            codes = col.cat.set_categories(cats).cat.codes.to_numpy().astype(np.float64)
+            codes[codes < 0] = np.nan  # unseen category / NaN -> missing
+            out[:, j] = codes
+        else:
+            try:
+                out[:, j] = col.to_numpy(dtype=np.float64, na_value=np.nan)
+            except (TypeError, ValueError):
+                log.fatal(
+                    "DataFrame.dtypes must be int, float, bool or category; "
+                    "column %r is %s" % (str(c), col.dtype)
+                )
+    return out, names, categorical, pandas_categorical
+
+
 def _to_2d_float(data, allow_sparse: bool = False) -> np.ndarray:
     if hasattr(data, "values"):  # pandas
         data = data.values
@@ -69,8 +115,26 @@ class Dataset:
         self._binned: Optional[BinnedDataset] = None
         self.used_indices: Optional[np.ndarray] = None
         self._predictor = None
+        self.pandas_categorical = None  # per-column category order (DataFrames)
 
     # -- construction ----------------------------------------------------
+
+    def _apply_metadata_overrides(self, md) -> None:
+        """Honor user-supplied label/weight/init_score/group over file-borne
+        metadata (Metadata::Init semantics, dataset.h:40-248)."""
+        if self.label is not None:
+            md.label = np.asarray(self.label, np.float32).reshape(-1)
+        if self.weight is not None:
+            md.weight = np.asarray(self.weight, np.float32).reshape(-1)
+        if self.init_score is not None:
+            md.init_score = np.asarray(self.init_score, np.float64)
+        if self.group is not None:
+            from .dataset import Metadata
+
+            md.query_boundaries = Metadata(
+                md.num_data, group=np.asarray(self.group)
+            ).query_boundaries
+        md._validate()
 
     def construct(self, config: Optional[Config] = None) -> "Dataset":
         if self._binned is not None:
@@ -83,22 +147,7 @@ class Dataset:
 
             if is_binary_dataset_file(self.data):
                 self._binned = load_binary_dataset(self.data)
-                md = self._binned.metadata
-                # honor user-supplied metadata overrides exactly like the text
-                # path (Metadata::Init semantics, dataset.h:40-248)
-                if self.label is not None:
-                    md.label = np.asarray(self.label, np.float32).reshape(-1)
-                if self.weight is not None:
-                    md.weight = np.asarray(self.weight, np.float32).reshape(-1)
-                if self.init_score is not None:
-                    md.init_score = np.asarray(self.init_score, np.float64)
-                if self.group is not None:
-                    from .dataset import Metadata
-
-                    md.query_boundaries = Metadata(
-                        md.num_data, group=np.asarray(self.group)
-                    ).query_boundaries
-                md._validate()
+                self._apply_metadata_overrides(self._binned.metadata)
                 if self.reference is not None:
                     # a binary file carries its own BinMappers; if they differ
                     # from the reference's, eval-from-bins would silently score
@@ -115,6 +164,17 @@ class Dataset:
                             "with reference= set, or pass the raw data instead"
                             % (self.data,)
                         )
+                self._config = config
+                return self
+            if config.two_round and self.reference is None:
+                # low-memory streaming load: the full float matrix never
+                # materializes (dataset_loader.cpp two_round branch)
+                from .dist_loader import apply_sidecars, load_two_round
+
+                binned, row_idx = load_two_round(self.data, config)
+                apply_sidecars(binned, self.data, row_idx)
+                self._apply_metadata_overrides(binned.metadata)
+                self._binned = binned
                 self._config = config
                 return self
             from .io import load_sidecar, load_text_file
@@ -134,15 +194,24 @@ class Dataset:
             if names and self.feature_name == "auto":
                 self.feature_name = names
             self.data = X
-        data = _to_2d_float(self.data, allow_sparse=True)
         feature_names = None
-        if isinstance(self.feature_name, (list, tuple)):
-            feature_names = list(self.feature_name)
-        elif hasattr(self.data, "columns"):
-            feature_names = [str(c) for c in self.data.columns]
         cats = None
-        if isinstance(self.categorical_feature, (list, tuple)):
-            cats = list(self.categorical_feature)
+        if self.reference is not None and self.pandas_categorical is None:
+            # validation data re-uses the training set's category order
+            self.reference.construct(config)
+            self.pandas_categorical = self.reference.pandas_categorical
+        from_pandas = _data_from_pandas(
+            self.data, self.feature_name, self.categorical_feature,
+            self.pandas_categorical,
+        )
+        if from_pandas is not None:
+            data, feature_names, cats, self.pandas_categorical = from_pandas
+        else:
+            data = _to_2d_float(self.data, allow_sparse=True)
+            if isinstance(self.feature_name, (list, tuple)):
+                feature_names = list(self.feature_name)
+            if isinstance(self.categorical_feature, (list, tuple)):
+                cats = list(self.categorical_feature)
         ref_binned = None
         if self.reference is not None:
             self.reference.construct(config)
@@ -341,6 +410,8 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict = {}
         self._valid_names: List[str] = []
+        self._valid_datasets: List[Dataset] = []
+        self.pandas_categorical = None
         if train_set is not None:
             self.config = Config.from_params(params)
             binned = train_set.get_binned(self.config)
@@ -350,6 +421,7 @@ class Booster:
             cls = _boosting_class(boosting)
             self._gbdt = cls(self.config, binned, objective, metrics)
             self._train_dataset = train_set
+            self.pandas_categorical = train_set.pandas_categorical
         elif model_file is not None:
             with open(model_file) as fh:
                 self._load(fh.read(), params)
@@ -360,6 +432,23 @@ class Booster:
 
     def _load(self, text: str, params: Dict) -> None:
         self.config = Config.from_params(params) if params else Config()
+        # trailing pandas_categorical:<json> line (same tail format as the
+        # reference python package writes after the model text)
+        marker = "\npandas_categorical:"
+        pos = text.rfind(marker)
+        if pos >= 0:
+            import json as _json
+
+            line_end = text.find("\n", pos + 1)
+            payload = text[pos + len(marker): line_end if line_end > 0 else None]
+            try:
+                self.pandas_categorical = _json.loads(payload)
+            except ValueError:
+                raise LightGBMError(
+                    "Model file has a corrupt pandas_categorical record: %r"
+                    % payload[:80]
+                )
+            text = text[:pos] + (text[line_end:] if line_end > 0 else "")
         self._gbdt = load_model_from_string(text, gbdt_mod.GBDT, self.config)
         obj = objective_from_model_string(getattr(self._gbdt, "loaded_objective", None), self.config)
         self._gbdt.objective = obj
@@ -383,6 +472,7 @@ class Booster:
         metrics = self._make_metrics(self.config)
         self._gbdt.add_valid(binned, metrics, name)
         self._valid_names.append(name)
+        self._valid_datasets.append(data)
         return self
 
     def update(self, train_set=None, fobj=None) -> bool:
@@ -419,9 +509,10 @@ class Booster:
     def eval_valid(self, feval=None) -> List:
         out = []
         for i, name in enumerate(self._gbdt.valid_names):
+            ds = self._valid_datasets[i] if i < len(self._valid_datasets) else None
             out.extend(
                 self._eval_set(
-                    self._gbdt._valid_score_np(i), name, self._gbdt.valid_metrics[i], feval, None
+                    self._gbdt._valid_score_np(i), name, self._gbdt.valid_metrics[i], feval, ds
                 )
             )
         return out
@@ -454,7 +545,10 @@ class Booster:
         pred_contrib: bool = False,
         **kwargs,
     ) -> np.ndarray:
-        X = _to_2d_float(data)
+        from_pandas = _data_from_pandas(
+            data, pandas_categorical=self.pandas_categorical or []
+        )
+        X = from_pandas[0] if from_pandas is not None else _to_2d_float(data)
         if pred_leaf:
             return self._gbdt.predict_leaf_index(X, num_iteration)
         if pred_contrib:
@@ -481,7 +575,19 @@ class Booster:
         return self
 
     def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0) -> str:
-        return save_model_to_string(self._gbdt, start_iteration, num_iteration)
+        s = save_model_to_string(self._gbdt, start_iteration, num_iteration)
+        import json as _json
+
+        try:
+            tail = _json.dumps(self.pandas_categorical)
+        except TypeError:
+            # fail loudly, like the reference: a silently stringified category
+            # (e.g. a Timestamp) would map every value to missing after reload
+            raise LightGBMError(
+                "pandas categorical columns must hold JSON-serializable "
+                "categories (str/int/float/bool) to save the model"
+            )
+        return s + "\npandas_categorical:%s\n" % tail
 
     def dump_model(self, num_iteration: int = -1) -> dict:
         return dump_model_to_json(self._gbdt, num_iteration)

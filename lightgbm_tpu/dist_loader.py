@@ -1,0 +1,309 @@
+"""Two-round (low-memory) and distributed (multi-host) dataset loading.
+
+Reference behaviors re-designed host-side:
+  * two-round loading (dataset_loader.cpp:226-266 two_round branch): pass 1
+    streams the file to count rows and collect a bin-construction sample;
+    pass 2 streams again and writes bins straight into the packed [F, N]
+    matrix — the full float matrix never exists in memory.
+  * rank row-sharding at load time (dataset_loader.cpp:762-798): in
+    distributed training each host keeps only the rows a deterministic
+    row->rank assignment gives it (mod by default, contiguous blocks with
+    pre_partition semantics left to the caller's file split).
+  * feature-sharded distributed binning (dataset_loader.cpp:801-944): each
+    rank finds BinMappers for its contiguous slice of features from its local
+    sample, then the mappers are allgathered so every rank bins every feature
+    identically. The exchange is a pluggable callable; on multi-host JAX use
+    ``jax_mapper_exchange`` (process_allgather over DCN), in-process it
+    defaults to "already complete".
+
+The compute path stays unchanged: the result is the same BinnedDataset the
+in-memory constructor produces, ready for jit/shard_map training.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
+from .config import Config
+from .dataset import (
+    BinnedDataset,
+    K_ZERO_THRESHOLD,
+    Metadata,
+    _parse_categorical,
+)
+from .io import _MISSING_TOKENS, _is_number, _parse_delimited, _parse_libsvm, _resolve_label, _sniff_format, load_sidecar
+from .utils import log
+
+
+# ---------------------------------------------------------------------------
+# chunked text streaming
+# ---------------------------------------------------------------------------
+
+def _file_meta(path: str, has_header: bool):
+    """Sniff format/separator/header from the head of the file."""
+    head: List[str] = []
+    with open(path) as fh:
+        for ln in fh:
+            ln = ln.rstrip("\r\n")
+            if ln.strip():
+                head.append(ln)
+            if len(head) >= 21:
+                break
+    if not head:
+        log.fatal("Data file %s is empty" % path)
+    fmt = _sniff_format(head[1 if has_header else 0 : 21])
+    sep = "\t" if fmt == "tsv" else ","
+    header = None
+    use_header = has_header
+    if fmt != "libsvm":
+        toks = [t.strip() for t in head[0].split(sep)]
+        if not all(_is_number(t) or t in _MISSING_TOKENS for t in toks):
+            use_header = True
+        if use_header:
+            header = toks
+    return fmt, sep, use_header, header
+
+
+def iter_text_chunks(
+    path: str,
+    chunk_rows: int = 65536,
+    has_header: bool = False,
+    label_column: str = "",
+    row_filter: Optional[Callable[[int], bool]] = None,
+    num_features: Optional[int] = None,
+):
+    """Stream (X_chunk, y_chunk, global_row_indices) without loading the file.
+
+    ``row_filter(global_row)`` keeps only selected data rows (rank sharding);
+    ``num_features`` pins the libsvm matrix width (pass the pass-1 width on
+    pass 2 so chunks agree).
+    """
+    fmt, sep, use_header, header = _file_meta(path, has_header)
+    label_idx = _resolve_label(label_column, header)
+
+    def parse(lines):
+        if fmt == "libsvm":
+            X, y = _parse_libsvm(lines, num_features)
+            return X, y
+        X, y, _ = _parse_delimited(lines, sep, label_idx, None)
+        return X, y
+
+    buf: List[str] = []
+    kept: List[int] = []
+    row = 0
+    with open(path) as fh:
+        first = use_header
+        for ln in fh:
+            if first:
+                first = False
+                continue
+            ln = ln.rstrip("\r\n")
+            if not ln.strip():
+                continue
+            if row_filter is None or row_filter(row):
+                buf.append(ln)
+                kept.append(row)
+            row += 1
+            if len(buf) >= chunk_rows:
+                X, y = parse(buf)
+                yield X, y, np.asarray(kept, np.int64)
+                buf, kept = [], []
+    if buf:
+        X, y = parse(buf)
+        yield X, y, np.asarray(kept, np.int64)
+
+
+# ---------------------------------------------------------------------------
+# mapper exchange seams
+# ---------------------------------------------------------------------------
+
+def local_exchange(owned: List[Tuple[int, Optional[dict]]]) -> List[Tuple[int, Optional[dict]]]:
+    """Single-process world: this rank owns every feature already."""
+    return owned
+
+
+def jax_mapper_exchange(owned: List[Tuple[int, Optional[dict]]]):
+    """Allgather (feature_idx, mapper_dict) lists across JAX processes.
+
+    The multi-host analogue of the reference's buffered BinMapper allgather
+    (dataset_loader.cpp:877-944), over DCN via process_allgather.
+    """
+    import json
+
+    import jax
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps(owned).encode()
+    n = np.frombuffer(payload, np.uint8)
+    sizes = multihost_utils.process_allgather(np.asarray([n.size], np.int64))
+    width = int(sizes.max())
+    buf = np.zeros(width, np.uint8)
+    buf[: n.size] = n
+    gathered = multihost_utils.process_allgather(buf)
+    out: List[Tuple[int, Optional[dict]]] = []
+    for r in range(jax.process_count()):
+        blob = bytes(gathered[r][: int(sizes[r, 0])])
+        out.extend((int(f), m) for f, m in json.loads(blob))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the loader
+# ---------------------------------------------------------------------------
+
+def load_two_round(
+    path: str,
+    config: Config,
+    rank: int = 0,
+    num_machines: int = 1,
+    mapper_exchange: Optional[Callable] = None,
+    chunk_rows: int = 65536,
+) -> Tuple[BinnedDataset, np.ndarray]:
+    """Stream-load ``path`` into a BinnedDataset; returns (binned, row_idx).
+
+    ``row_idx`` holds the kept rows' global indices (identity for
+    ``num_machines == 1``) so callers can subset per-row sidecar files.
+    """
+    if num_machines > 1:
+        row_filter = lambda i: i % num_machines == rank  # noqa: E731
+    else:
+        row_filter = None
+
+    # ---- pass 1: row count + bin-construction sample -------------------
+    sample_cap = int(config.bin_construct_sample_cnt)
+    sample_chunks: List[np.ndarray] = []
+    label_chunks: List[np.ndarray] = []
+    n_local = 0
+    n_seen_for_sample = 0
+    num_features = 0
+    rng = np.random.RandomState(config.data_random_seed & 0x7FFFFFFF)
+    for X, y, idx in iter_text_chunks(
+        path, chunk_rows, config.header, config.label_column, row_filter
+    ):
+        n_local += X.shape[0]
+        num_features = max(num_features, X.shape[1])
+        if y is not None:
+            label_chunks.append(np.asarray(y, np.float64))
+        # stride-sample the chunk so the pass-1 memory stays ~sample_cap rows
+        n_seen_for_sample += X.shape[0]
+        keep = min(
+            X.shape[0],
+            max(1, int(round(sample_cap * X.shape[0] / max(n_seen_for_sample, 1)))),
+        )
+        if keep >= X.shape[0]:
+            sample_chunks.append(X)
+        else:
+            sample_chunks.append(X[rng.choice(X.shape[0], keep, replace=False)])
+    if n_local == 0:
+        log.fatal("Data file %s has no rows for rank %d" % (path, rank))
+    sample = np.vstack([c if c.shape[1] == num_features else
+                        np.pad(c, ((0, 0), (0, num_features - c.shape[1])))
+                        for c in sample_chunks])
+    del sample_chunks
+    if sample.shape[0] > sample_cap:
+        sample = sample[rng.choice(sample.shape[0], sample_cap, replace=False)]
+
+    # ---- distributed binning: own a contiguous feature slice ------------
+    # Only a real cross-rank exchange justifies splitting the binning work;
+    # without one every rank bins every feature from its local sample (still
+    # correct, just duplicated work — the standalone-shard fallback).
+    cat_idx = _parse_categorical(config.categorical_feature, num_features, None)
+    if num_machines > 1 and mapper_exchange is not None:
+        per = (num_features + num_machines - 1) // num_machines
+        lo, hi = rank * per, min(num_features, (rank + 1) * per)
+    else:
+        lo, hi = 0, num_features
+    if mapper_exchange is None:
+        mapper_exchange = local_exchange
+
+    owned: List[Tuple[int, Optional[dict]]] = []
+    for j in range(lo, hi):
+        col = sample[:, j]
+        keep = np.isnan(col) | (np.abs(col) > K_ZERO_THRESHOLD)
+        m = BinMapper()
+        m.find_bin(
+            col[keep],
+            sample.shape[0],
+            config.max_bin,
+            config.min_data_in_bin,
+            config.min_data_in_leaf,
+            bin_type=BIN_CATEGORICAL if j in cat_idx else BIN_NUMERICAL,
+            use_missing=config.use_missing,
+            zero_as_missing=config.zero_as_missing,
+        )
+        owned.append((j, None if m.is_trivial else m.to_dict()))
+    gathered = sorted(mapper_exchange(owned))
+    if len(gathered) != num_features:
+        log.fatal(
+            "Mapper exchange returned %d features, expected %d"
+            % (len(gathered), num_features)
+        )
+    mappers: List[BinMapper] = []
+    used: List[int] = []
+    for j, md in gathered:
+        if md is not None:
+            mappers.append(BinMapper.from_dict(md))
+            used.append(j)
+    if not used:
+        log.warning(
+            "There are no meaningful features, as all feature values are constant."
+        )
+
+    # ---- pass 2: stream bins straight into the packed matrix -----------
+    max_bin = max((m.num_bin for m in mappers), default=2)
+    dtype = np.uint8 if max_bin <= 256 else np.int32
+    bins = np.empty((len(used), n_local), dtype)
+    row_idx = np.empty(n_local, np.int64)
+    pos = 0
+    have_labels = bool(label_chunks)
+    labels = (
+        np.concatenate(label_chunks) if have_labels else None
+    )
+    for X, _, idx in iter_text_chunks(
+        path, chunk_rows, config.header, config.label_column, row_filter,
+        num_features=num_features,
+    ):
+        k = X.shape[0]
+        for f, (m, j) in enumerate(zip(mappers, used)):
+            col = X[:, j] if j < X.shape[1] else np.zeros(k)
+            bins[f, pos : pos + k] = m.values_to_bins(col).astype(dtype)
+        row_idx[pos : pos + k] = idx
+        pos += k
+
+    metadata = Metadata(n_local, label=labels)
+    mono = list(config.monotone_constraints) if config.monotone_constraints else []
+    binned = BinnedDataset(
+        bins, mappers, used, num_features, metadata, monotone_constraints=mono
+    )
+    return binned, row_idx
+
+
+def apply_sidecars(
+    binned: BinnedDataset, path: str, row_idx: np.ndarray
+) -> BinnedDataset:
+    """Attach weight/query/init sidecar files, subset to this rank's rows."""
+    md = binned.metadata
+    w = load_sidecar(path, "weight")
+    if w is not None:
+        md.weight = np.asarray(w, np.float32)[row_idx]
+    init = load_sidecar(path, "init")
+    if init is not None:
+        md.init_score = np.asarray(init, np.float64)[row_idx]
+    q = load_sidecar(path, "query")
+    if q is not None:
+        # queries cannot straddle ranks under mod-sharding; the reference
+        # shards by whole query for ranking data (dataset_loader.cpp:775-795).
+        bounds = np.concatenate([[0], np.cumsum(q.astype(np.int64))])
+        if row_idx.size != bounds[-1]:
+            qid = np.searchsorted(bounds, row_idx, side="right") - 1
+            counts = np.bincount(qid, minlength=len(q))
+            kept = counts[counts > 0]
+            md.query_boundaries = np.concatenate([[0], np.cumsum(kept)]).astype(
+                np.int64
+            )
+        else:
+            md.query_boundaries = bounds
+    md._validate()
+    return binned

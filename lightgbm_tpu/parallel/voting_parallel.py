@@ -35,8 +35,13 @@ def _voting_split_fn(top_k: int, axis_name: str):
                  feature_meta, feature_mask, params):
         F = hist_local.shape[0]
         k = min(top_k, F)
-        # local leaf sums from the local histogram (any feature's bins cover
-        # every local row; use feature 0 — smaller_leaf_splits_ local sums)
+        # local leaf sums from the local histogram: INVARIANT — every row of a
+        # leaf lands in exactly one bin of every feature's histogram, so any
+        # feature's bins sum to the leaf totals (feature 0 here, the
+        # smaller_leaf_splits_ local sums). True for dense per-feature
+        # histograms; an EFB group histogram would break it (a feature's
+        # non-default rows only), but grow_tree already rejects bundled +
+        # shard-local histograms before this traces (ops/grow.py:400-406).
         local_g = jnp.sum(hist_local[0, :, 0])
         local_h = jnp.sum(hist_local[0, :, 1])
         local_n = jnp.sum(hist_local[0, :, 2])

@@ -1,0 +1,117 @@
+"""Metric naming/aliasing matrix.
+
+The reference resolves metric aliases in Config::GetMetricType + the metric
+factory (/root/reference/src/metric/metric.cpp:16-60) and its python suite
+asserts the resulting eval keys across spellings
+(tests/python_package_test/test_engine.py:879-1170 test_metrics). This suite
+asserts the same contract: every alias spelling produces the canonical eval
+name, objectives imply their default metric, and metric='None' disables eval.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+RNG = np.random.RandomState(17)
+X = RNG.randn(500, 5)
+Y_REG = X[:, 0] * 2.0 + RNG.randn(500) * 0.3
+Y_BIN = (X[:, 0] > 0).astype(np.float64)
+
+FAST = {"verbosity": -1, "num_leaves": 7, "min_data_in_leaf": 5}
+
+
+def _eval_names(objective, y, metric=None, extra=None):
+    params = dict(FAST, objective=objective)
+    if metric is not None:
+        params["metric"] = metric
+    if extra:
+        params.update(extra)
+    res = {}
+    dtr = lgb.Dataset(X, label=y)
+    lgb.train(
+        params,
+        dtr,
+        num_boost_round=2,
+        valid_sets=[lgb.Dataset(X, label=y, reference=dtr)],
+        valid_names=["v"],
+        evals_result=res,
+        verbose_eval=False,
+    )
+    return sorted(res.get("v", {}).keys())
+
+
+class TestAliasResolution:
+    @pytest.mark.parametrize(
+        "spelling", ["l2", "mse", "mean_squared_error", "regression"]
+    )
+    def test_l2_spellings(self, spelling):
+        assert _eval_names("regression", Y_REG, spelling) == ["l2"]
+
+    @pytest.mark.parametrize(
+        "spelling", ["rmse", "root_mean_squared_error", "l2_root"]
+    )
+    def test_rmse_spellings(self, spelling):
+        assert _eval_names("regression", Y_REG, spelling) == ["rmse"]
+
+    @pytest.mark.parametrize("spelling", ["l1", "mae", "mean_absolute_error"])
+    def test_l1_spellings(self, spelling):
+        assert _eval_names("regression", Y_REG, spelling) == ["l1"]
+
+    @pytest.mark.parametrize("spelling", ["binary_logloss", "binary"])
+    def test_binary_logloss_spellings(self, spelling):
+        assert _eval_names("binary", Y_BIN, spelling) == ["binary_logloss"]
+
+    def test_multiple_metrics_coexist(self):
+        names = _eval_names("binary", Y_BIN, ["binary_logloss", "binary_error", "auc"])
+        assert names == ["auc", "binary_error", "binary_logloss"]
+
+    def test_kl_alias(self):
+        y01 = (Y_BIN * 0.8 + 0.1).astype(np.float64)
+        assert _eval_names("cross_entropy", y01, "kullback_leibler") == _eval_names(
+            "cross_entropy", y01, "kldiv"
+        )
+
+
+class TestDefaultMetrics:
+    def test_objective_implies_metric(self):
+        assert _eval_names("regression", Y_REG) == ["l2"]
+        assert _eval_names("binary", Y_BIN) == ["binary_logloss"]
+
+    def test_multiclass_default(self):
+        y3 = RNG.randint(0, 3, 500).astype(np.float64)
+        assert _eval_names("multiclass", y3, extra={"num_class": 3}) == [
+            "multi_logloss"
+        ]
+
+    def test_none_disables_eval(self):
+        assert _eval_names("binary", Y_BIN, "None") == []
+
+    def test_unknown_metric_warns_and_skips(self):
+        assert _eval_names("binary", Y_BIN, "no_such_metric") == []
+
+
+class TestMetricValues:
+    def test_rmse_is_sqrt_l2(self):
+        params = dict(FAST, objective="regression", metric=["l2", "rmse"])
+        res = {}
+        dtr = lgb.Dataset(X, label=Y_REG)
+        lgb.train(
+            params, dtr, num_boost_round=3,
+            valid_sets=[lgb.Dataset(X, label=Y_REG, reference=dtr)],
+            valid_names=["v"], evals_result=res, verbose_eval=False,
+        )
+        np.testing.assert_allclose(
+            res["v"]["rmse"], np.sqrt(res["v"]["l2"]), rtol=1e-6
+        )
+
+    def test_binary_error_matches_threshold(self):
+        params = dict(FAST, objective="binary", metric="binary_error")
+        res = {}
+        dtr = lgb.Dataset(X, label=Y_BIN)
+        bst = lgb.train(
+            params, dtr, num_boost_round=5,
+            valid_sets=[lgb.Dataset(X, label=Y_BIN, reference=dtr)],
+            valid_names=["v"], evals_result=res, verbose_eval=False,
+        )
+        manual = float(((bst.predict(X) > 0.5) != Y_BIN).mean())
+        np.testing.assert_allclose(res["v"]["binary_error"][-1], manual, atol=1e-9)

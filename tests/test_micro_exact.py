@@ -1,0 +1,151 @@
+"""Exact-prediction micro-datasets for missing-value and categorical handling.
+
+The reference validates its missing-value semantics with tiny hand-built
+datasets where a single correct split must produce exact predictions
+(/root/reference/tests/python_package_test/test_engine.py:96-290), and its
+degenerate constant-feature behavior with 4-row datasets
+(test_engine.py:795-858). Same strategy here, own datasets and assertions.
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _one_col(x):
+    return np.asarray(x, np.float64).reshape(-1, 1)
+
+
+def _auc(y, p):
+    y = np.asarray(y, bool)
+    diff = p[y][:, None] - p[~y][None, :]
+    return float(((diff > 0) + 0.5 * (diff == 0)).mean())
+
+
+MICRO = {
+    "verbosity": -1,
+    "min_data_in_leaf": 1,
+    "min_data_in_bin": 1,
+    "num_leaves": 2,
+    "learning_rate": 1.0,
+    "boost_from_average": False,
+    "objective": "regression",
+}
+
+
+class TestMissingValueExact:
+    def test_nan_bin_separates_when_use_missing(self):
+        # values 0..7 plus NaN; NaN rows carry label 1 like the low values —
+        # one split with default-left NaN routing reproduces labels exactly
+        x = [0, 1, 2, 3, 4, 5, 6, 7, np.nan]
+        y = [1, 1, 1, 1, 0, 0, 0, 0, 1]
+        ds = lgb.Dataset(_one_col(x), label=np.asarray(y, np.float64))
+        bst = lgb.train(dict(MICRO, zero_as_missing=False), ds, num_boost_round=1)
+        pred = bst.predict(_one_col(x))
+        np.testing.assert_almost_equal(pred, y)
+        assert _auc(y, pred) > 0.999
+
+    def test_zero_as_missing_groups_zero_with_nan(self):
+        # zero_as_missing=True: the 0 row and the NaN row are both "missing"
+        # and land with the high-value side (label 0) — exact reconstruction
+        x = [0, 1, 2, 3, 4, 5, 6, 7, np.nan]
+        y = [0, 1, 1, 1, 0, 0, 0, 0, 0]
+        ds = lgb.Dataset(_one_col(x), label=np.asarray(y, np.float64))
+        bst = lgb.train(dict(MICRO, zero_as_missing=True), ds, num_boost_round=1)
+        pred = bst.predict(_one_col(x))
+        np.testing.assert_almost_equal(pred, y)
+
+    def test_use_missing_false_nan_follows_zero(self):
+        # with missing handling disabled, NaN cannot get its own branch: it is
+        # treated like the lowest bin, so rows 0 and NaN predict identically
+        x = [0, 1, 2, 3, 4, 5, 6, 7, np.nan]
+        y = [0, 1, 1, 1, 0, 0, 0, 0, 0]
+        ds = lgb.Dataset(_one_col(x), label=np.asarray(y, np.float64))
+        bst = lgb.train(dict(MICRO, use_missing=False), ds, num_boost_round=1)
+        pred = bst.predict(_one_col(x))
+        np.testing.assert_almost_equal(pred[-1], pred[0], decimal=5)
+        assert _auc(y, pred) > 0.83
+
+    def test_nan_prediction_goes_default_direction(self):
+        # a feature never missing at train time: NaN at predict time takes the
+        # default (zero-bin) direction, never crashes (tree.h:216 semantics)
+        rng = np.random.RandomState(5)
+        X = rng.randn(500, 3)
+        y = (X[:, 0] > 0).astype(np.float64)
+        bst = lgb.train(
+            {"objective": "binary", "verbosity": -1, "num_leaves": 7},
+            lgb.Dataset(X, label=y),
+            num_boost_round=10,
+        )
+        Xq = X[:10].copy()
+        Xq[:, 0] = np.nan
+        pred = bst.predict(Xq)
+        assert np.all(np.isfinite(pred))
+        # all-NaN rows all route identically through feature-0 splits
+        assert np.allclose(pred, pred[0]) or len(np.unique(pred.round(12))) <= 4
+
+
+class TestCategoricalExact:
+    def test_alternating_categories_need_bitset(self):
+        # 8 categories, alternating labels: impossible for one numerical split,
+        # exact for one many-vs-many categorical split
+        x = [0, 1, 2, 3, 4, 5, 6, 7]
+        y = [0, 1, 0, 1, 0, 1, 0, 1]
+        ds = lgb.Dataset(
+            _one_col(x), label=np.asarray(y, np.float64), categorical_feature=[0]
+        )
+        bst = lgb.train(
+            dict(MICRO, min_data_per_group=1, cat_smooth=1, cat_l2=0),
+            ds,
+            num_boost_round=1,
+        )
+        pred = bst.predict(_one_col(x))
+        np.testing.assert_almost_equal(pred, y)
+
+    def test_categorical_nan_vs_value(self):
+        # only two "levels": category 0 and missing — split must separate them
+        x = [0, np.nan, 0, np.nan, 0, np.nan]
+        y = [0, 1, 0, 1, 0, 1]
+        ds = lgb.Dataset(
+            _one_col(x), label=np.asarray(y, np.float64), categorical_feature=[0]
+        )
+        bst = lgb.train(
+            dict(MICRO, min_data_per_group=1, cat_smooth=1, cat_l2=0),
+            ds,
+            num_boost_round=1,
+        )
+        pred = bst.predict(_one_col(x))
+        np.testing.assert_almost_equal(pred, y)
+
+
+class TestConstantFeatures:
+    """All-constant features leave only the base prediction
+    (test_engine.py:795-858 shape: tiny y, assert the exact base value)."""
+
+    def _run(self, y, params):
+        y = np.asarray(y, np.float64)
+        X = np.zeros((len(y), 1))
+        p = dict(
+            params,
+            verbosity=-1,
+            min_data_in_leaf=1,
+            min_data_in_bin=1,
+            boost_from_average=True,
+        )
+        bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=2)
+        return bst.predict(X)
+
+    def test_regression_predicts_mean(self):
+        pred = self._run([0.0, 10.0, 0.0, 10.0], {"objective": "regression"})
+        np.testing.assert_allclose(pred, 5.0, atol=1e-6)
+        pred = self._run([-1.0, 1.0, -2.0, 2.0], {"objective": "regression"})
+        np.testing.assert_allclose(pred, 0.0, atol=1e-6)
+
+    def test_binary_predicts_base_rate(self):
+        pred = self._run([0.0, 1.0, 1.0, 1.0], {"objective": "binary"})
+        np.testing.assert_allclose(pred, 0.75, atol=1e-5)
+
+    def test_multiclass_predicts_class_frequencies(self):
+        pred = self._run(
+            [0.0, 1.0, 2.0, 0.0], {"objective": "multiclass", "num_class": 3}
+        )
+        np.testing.assert_allclose(pred, [[0.5, 0.25, 0.25]] * 4, atol=1e-5)

@@ -1,0 +1,120 @@
+"""pandas DataFrame handling: auto-categorical detection and code alignment.
+
+Reference semantics (/root/reference/python-package/lightgbm/basic.py:255-344
+_data_from_pandas + tests/python_package_test/test_engine.py:554 pandas
+categorical test): 'category'-dtype columns become integer codes, the training
+category order is persisted with the model, and prediction re-applies it so a
+reordered or partially-missing category set still maps correctly.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pd = pytest.importorskip("pandas")
+
+PARAMS = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
+
+
+def _frame(n=1200, seed=0):
+    rng = np.random.RandomState(seed)
+    cat = pd.Series(rng.choice(["lo", "mid", "hi", "peak"], n), dtype="category")
+    eff = {"lo": -2.0, "mid": -0.5, "hi": 0.5, "peak": 2.0}
+    df = pd.DataFrame(
+        {
+            "x0": rng.randn(n),
+            "c": cat,
+            "x1": rng.randn(n),
+        }
+    )
+    y = (
+        df["x0"].to_numpy()
+        + np.asarray([eff[v] for v in cat])
+        + 0.3 * rng.randn(n)
+        > 0
+    ).astype(np.float64)
+    return df, y
+
+
+def test_auto_categorical_improves_over_dropped_column():
+    df, y = _frame()
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y), num_boost_round=20)
+    p = bst.predict(df)
+    without = lgb.train(
+        PARAMS, lgb.Dataset(df[["x0", "x1"]], label=y), num_boost_round=20
+    ).predict(df[["x0", "x1"]])
+
+    def auc(p):
+        pos, neg = p[y == 1], p[y == 0]
+        return ((pos[:, None] > neg[None, :]) + 0.5 * (pos[:, None] == neg[None, :])).mean()
+
+    assert auc(p) > auc(without) + 0.05
+    assert bst.feature_name() == ["x0", "c", "x1"]
+
+
+def test_category_order_is_stable_across_frames():
+    df, y = _frame()
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y), num_boost_round=10)
+    base = bst.predict(df)
+    # a frame whose categorical carries a different declared order must map
+    # values (not codes) to the training categories
+    df2 = df.copy()
+    df2["c"] = df2["c"].cat.reorder_categories(["peak", "hi", "mid", "lo"])
+    np.testing.assert_allclose(bst.predict(df2), base, rtol=1e-12)
+    # string column re-cast from raw values: same predictions
+    df3 = df.copy()
+    df3["c"] = pd.Series(list(df["c"].astype(str)), dtype="category")
+    np.testing.assert_allclose(bst.predict(df3), base, rtol=1e-12)
+
+
+def test_unseen_category_routes_as_missing():
+    df, y = _frame(n=600)
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y), num_boost_round=5)
+    df2 = df.head(8).copy()
+    df2["c"] = pd.Series(
+        ["lo", "brand_new", "hi", "brand_new", "mid", "peak", "brand_new", "lo"],
+        dtype="category",
+    )
+    pred = bst.predict(df2)
+    assert np.all(np.isfinite(pred))
+
+
+def test_model_io_preserves_pandas_categories(tmp_path):
+    df, y = _frame(n=800, seed=3)
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y), num_boost_round=8)
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    assert "pandas_categorical:" in path.read_text()
+    bst2 = lgb.Booster(model_file=str(path))
+    np.testing.assert_allclose(bst2.predict(df), bst.predict(df), rtol=1e-12)
+
+
+def test_valid_set_inherits_training_categories():
+    df, y = _frame()
+    dfv, yv = _frame(n=300, seed=9)
+    dtr = lgb.Dataset(df, label=y)
+    res = {}
+    lgb.train(
+        dict(PARAMS, metric="auc"),
+        dtr,
+        num_boost_round=8,
+        valid_sets=[lgb.Dataset(dfv, label=yv, reference=dtr)],
+        valid_names=["v"],
+        evals_result=res,
+        verbose_eval=False,
+    )
+    assert res["v"]["auc"][-1] > 0.85
+
+
+def test_nan_in_category_column():
+    df, y = _frame(n=500)
+    df.loc[df.index[:50], "c"] = np.nan
+    bst = lgb.train(PARAMS, lgb.Dataset(df, label=y), num_boost_round=5)
+    assert np.all(np.isfinite(bst.predict(df)))
+
+
+def test_bad_object_dtype_fatals():
+    df = pd.DataFrame({"a": [1.0, 2.0], "s": ["x", "y"]})  # plain object col
+    with pytest.raises(Exception):
+        lgb.train(PARAMS, lgb.Dataset(df, label=np.array([0.0, 1.0])),
+                  num_boost_round=1)

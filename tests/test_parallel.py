@@ -151,6 +151,64 @@ class TestFeatureParallel:
         )
         _assert_same_tree(tree_s, tree_fp, leaf_s, leaf_fp)
 
+    def test_bins_stay_sharded_no_full_allgather(self):
+        """Communication-shape evidence: the compiled feature-parallel program
+        never all-gathers the [F, N] bin matrix — XLA shards the histogram +
+        threshold scan over the feature axis, and cross-shard payloads stay
+        small (the reference's analogue ships 2 SplitInfo records per sync,
+        feature_parallel_tree_learner.cpp:66, not the data)."""
+        import re
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ds, meta, grad, hess = _setup(n=512, f=8, seed=1)
+        n, f = ds.num_data, ds.num_features
+        kw = dict(
+            num_leaves=15, max_depth=-1, num_bins=ds.max_num_bin,
+            params=PARAMS, chunk=256,
+        )
+        mesh = feature_mesh(jax.devices())
+        fcol = NamedSharding(mesh, P("feature", None))
+        fvec = NamedSharding(mesh, P("feature"))
+        rep = NamedSharding(mesh, P())
+        bins = jax.device_put(jnp.asarray(ds.bins), fcol)
+        meta_s = {k: jax.device_put(v, fvec) for k, v in meta.items()}
+        ones = jax.device_put(jnp.ones((n,), jnp.float32), rep)
+        fmask = jax.device_put(jnp.ones((f,), bool), fvec)
+        grad_r = jax.device_put(grad, rep)
+        hess_r = jax.device_put(hess, rep)
+
+        txt = grow_tree.lower(
+            bins, grad_r, hess_r, ones, fmask, meta_s, **kw
+        ).compile().as_text()
+
+        bins_elems = bins.size
+        # every collective's arrays must be far smaller than the bin matrix
+        # (histograms [F,B,3], winning columns [N], scalars — never [F,N]).
+        # Scan every shape token on a collective line — covers tuple-typed
+        # results like "(f32[8,512]{1,0}, f32[8]{0}) all-reduce(...)" and the
+        # operand list alike.
+        collective = re.compile(
+            r"\b(all-gather|all-reduce|collective-permute|all-to-all)\("
+        )
+        shape = re.compile(r"\w+\[([\d,]*)\]")
+        checked = 0
+        offenders = []
+        for line in txt.splitlines():
+            if not collective.search(line):
+                continue
+            checked += 1
+            for m in shape.finditer(line):
+                dims = [int(d) for d in m.group(1).split(",") if d]
+                elems = int(np.prod(dims)) if dims else 1
+                if elems >= bins_elems:
+                    offenders.append(line.strip()[:140])
+                    break
+        assert checked > 0, "compiled program has no collectives to inspect"
+        assert not offenders, "bin-matrix-sized collectives found:\n%s" % "\n".join(
+            offenders
+        )
+
 
 class TestVotingParallel:
     def test_exact_when_topk_covers_features(self):

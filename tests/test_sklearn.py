@@ -1,0 +1,186 @@
+"""sklearn-wrapper conformance suite.
+
+Covers the themes of the reference's sklearn tests
+(/root/reference/tests/python_package_test/test_sklearn.py: estimator quality
+per task, custom objective/metric hooks, early stopping, joblib persistence,
+get_params/set_params/clone compatibility) against this package's wrappers.
+"""
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn.base import clone  # noqa: E402
+from sklearn.datasets import make_classification, make_regression  # noqa: E402
+from sklearn.metrics import log_loss, mean_squared_error, roc_auc_score  # noqa: E402
+from sklearn.model_selection import train_test_split  # noqa: E402
+
+SPEED = {"n_estimators": 20, "num_leaves": 15, "min_child_samples": 5}
+
+
+def _binary(n=1200, seed=42):
+    X, y = make_classification(
+        n_samples=n, n_features=10, n_informative=5, random_state=seed
+    )
+    return train_test_split(X, y, test_size=0.25, random_state=seed)
+
+
+class TestRegressor:
+    def test_fit_predict_quality(self):
+        X, y = make_regression(n_samples=1000, n_features=8, noise=5.0, random_state=0)
+        Xtr, Xte, ytr, yte = train_test_split(X, y, test_size=0.25, random_state=0)
+        reg = lgb.LGBMRegressor(**SPEED).fit(Xtr, ytr)
+        base = mean_squared_error(yte, np.full(len(yte), ytr.mean()))
+        assert mean_squared_error(yte, reg.predict(Xte)) < 0.3 * base
+
+    def test_custom_objective(self):
+        # hand-rolled L2 gradients through the fobj hook must roughly match
+        # the built-in regression objective
+        def l2_obj(y_true, y_pred):
+            return y_pred - y_true, np.ones_like(y_true)
+
+        X, y = make_regression(n_samples=800, n_features=6, noise=2.0, random_state=1)
+        builtin = lgb.LGBMRegressor(**SPEED).fit(X, y).predict(X)
+        custom = lgb.LGBMRegressor(objective=l2_obj, **SPEED).fit(X, y).predict(X)
+        # custom-objective models have no boost_from_average shift
+        assert np.corrcoef(builtin, custom + y.mean())[0, 1] > 0.95
+
+    def test_regression_l1_alias(self):
+        X, y = make_regression(n_samples=600, n_features=5, noise=2.0, random_state=2)
+        reg = lgb.LGBMRegressor(objective="regression_l1", **SPEED).fit(X, y)
+        assert np.isfinite(reg.predict(X[:5])).all()
+
+
+class TestClassifier:
+    def test_binary_quality_and_proba(self):
+        Xtr, Xte, ytr, yte = _binary()
+        clf = lgb.LGBMClassifier(**SPEED).fit(Xtr, ytr)
+        proba = clf.predict_proba(Xte)
+        assert proba.shape == (len(yte), 2)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+        assert roc_auc_score(yte, proba[:, 1]) > 0.9
+        assert set(np.unique(clf.predict(Xte))) <= set(clf.classes_)
+
+    def test_string_labels_round_trip(self):
+        Xtr, Xte, ytr, yte = _binary(n=600)
+        names = np.array(["neg", "pos"])
+        clf = lgb.LGBMClassifier(**SPEED).fit(Xtr, names[ytr])
+        pred = clf.predict(Xte)
+        assert set(pred) <= {"neg", "pos"}
+        assert (pred == names[yte]).mean() > 0.8
+        assert list(clf.classes_) == ["neg", "pos"]
+
+    def test_multiclass_proba_shape(self):
+        X, y = make_classification(
+            n_samples=900, n_features=10, n_informative=6, n_classes=3,
+            random_state=3,
+        )
+        clf = lgb.LGBMClassifier(**SPEED).fit(X, y)
+        proba = clf.predict_proba(X)
+        assert proba.shape == (len(y), 3)
+        np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+        assert log_loss(y, proba) < 0.7
+        assert clf.n_classes_ == 3
+
+    def test_early_stopping_sets_best_iteration(self):
+        Xtr, Xte, ytr, yte = _binary()
+        clf = lgb.LGBMClassifier(n_estimators=100, num_leaves=31)
+        clf.fit(
+            Xtr, ytr,
+            eval_set=[(Xte, yte)],
+            eval_metric="binary_logloss",
+            early_stopping_rounds=5,
+        )
+        assert 0 < clf.best_iteration_ <= 100
+        assert "valid_0" in clf.evals_result_ or len(clf.evals_result_) > 0
+
+    def test_custom_eval_metric(self):
+        def miss_rate(y_true, y_pred):
+            return "miss", float(((y_pred > 0.5) != y_true).mean()), False
+
+        Xtr, Xte, ytr, yte = _binary(n=600)
+        clf = lgb.LGBMClassifier(**SPEED)
+        clf.fit(Xtr, ytr, eval_set=[(Xte, yte)], eval_metric=miss_rate)
+        res = next(iter(clf.evals_result_.values()))
+        assert "miss" in res
+        assert res["miss"][-1] < 0.25
+
+
+class TestRanker:
+    def test_fit_requires_group(self):
+        X = np.random.RandomState(0).randn(100, 4)
+        y = np.random.RandomState(0).randint(0, 3, 100)
+        with pytest.raises(Exception):
+            lgb.LGBMRanker(**SPEED).fit(X, y)
+
+    def test_ranking_quality(self):
+        rng = np.random.RandomState(4)
+        n_q, per_q = 40, 20
+        X = rng.randn(n_q * per_q, 6)
+        rel = np.clip((X[:, 0] * 2 + rng.randn(len(X)) * 0.5).round(), 0, 3)
+        group = np.full(n_q, per_q)
+        rk = lgb.LGBMRanker(**SPEED).fit(X, rel, group=group)
+        score = rk.predict(X)
+        # within-query ordering should correlate with relevance
+        corr = np.corrcoef(score, rel)[0, 1]
+        assert corr > 0.5
+
+
+class TestSklearnPlumbing:
+    def test_get_set_params_and_clone(self):
+        clf = lgb.LGBMClassifier(num_leaves=7, learning_rate=0.3, max_bin=63)
+        params = clf.get_params()
+        assert params["num_leaves"] == 7
+        assert params["max_bin"] == 63  # kwargs pass-through
+        twin = clone(clf)
+        assert twin.get_params()["num_leaves"] == 7
+        twin.set_params(num_leaves=11)
+        assert twin.get_params()["num_leaves"] == 11
+        assert clf.get_params()["num_leaves"] == 7
+
+    def test_pickle_round_trip(self):
+        Xtr, Xte, ytr, yte = _binary(n=600)
+        clf = lgb.LGBMClassifier(**SPEED).fit(Xtr, ytr)
+        blob = pickle.dumps(clf)
+        clf2 = pickle.loads(blob)
+        np.testing.assert_array_equal(
+            clf2.predict_proba(Xte), clf.predict_proba(Xte)
+        )
+
+    def test_joblib_round_trip(self, tmp_path):
+        import joblib
+
+        X, y = make_regression(n_samples=400, n_features=5, random_state=5)
+        reg = lgb.LGBMRegressor(**SPEED).fit(X, y)
+        path = tmp_path / "model.joblib"
+        joblib.dump(reg, path)
+        reg2 = joblib.load(path)
+        np.testing.assert_array_equal(reg2.predict(X[:20]), reg.predict(X[:20]))
+
+    def test_feature_importances(self):
+        Xtr, _, ytr, _ = _binary(n=600)
+        clf = lgb.LGBMClassifier(**SPEED).fit(Xtr, ytr)
+        imp = clf.feature_importances_
+        assert imp.shape == (Xtr.shape[1],)
+        assert imp.sum() > 0
+        gains = lgb.LGBMClassifier(importance_type="gain", **SPEED).fit(
+            Xtr, ytr
+        ).feature_importances_
+        assert gains.dtype.kind == "f" and gains.sum() > 0
+
+    def test_unfitted_predict_raises(self):
+        with pytest.raises(Exception):
+            lgb.LGBMRegressor().predict(np.zeros((2, 3)))
+
+    def test_dataframe_input(self):
+        pd = pytest.importorskip("pandas")
+        Xtr, Xte, ytr, yte = _binary(n=600)
+        cols = ["f%d" % i for i in range(Xtr.shape[1])]
+        clf = lgb.LGBMClassifier(**SPEED).fit(
+            pd.DataFrame(Xtr, columns=cols), pd.Series(ytr)
+        )
+        proba = clf.predict_proba(pd.DataFrame(Xte, columns=cols))
+        assert roc_auc_score(yte, proba[:, 1]) > 0.9
