@@ -1,0 +1,107 @@
+# lgb.Booster — trained model surface.
+# API counterpart of the reference R-package/R/lgb.Booster.R +
+# lgb.Predictor.R over this package's .Call bridge.
+
+lgb.Booster.new <- function(train_set, params) {
+  lgb.Dataset.construct(train_set)
+  bst <- new.env(parent = emptyenv())
+  bst$handle <- .Call(LGBT_R_BoosterCreate, train_set$handle,
+                      lgb.params2str(params))
+  bst$params <- params
+  bst$valid_names <- character(0L)
+  bst$record_evals <- list()
+  bst$best_iter <- -1L
+  class(bst) <- "lgb.Booster"
+  bst
+}
+
+lgb.Booster.add.valid <- function(bst, valid_set, name) {
+  lgb.Dataset.construct(valid_set)
+  .Call(LGBT_R_BoosterAddValidData, bst$handle, valid_set$handle)
+  bst$valid_names <- c(bst$valid_names, name)
+  invisible(bst)
+}
+
+# One boosting round; TRUE when training can stop (no splittable leaf).
+lgb.Booster.update <- function(bst) {
+  .Call(LGBT_R_BoosterUpdateOneIter, lgb.check.handle(bst$handle, "Booster"))
+}
+
+# Metric values for data_idx (0 = train, 1.. = valids in add order).
+lgb.Booster.eval <- function(bst, data_idx) {
+  .Call(LGBT_R_BoosterGetEval, lgb.check.handle(bst$handle, "Booster"),
+        as.integer(data_idx))
+}
+
+#' Predict with a trained booster
+#'
+#' @param object lgb.Booster
+#' @param data matrix / data.frame to score
+#' @param rawscore return raw (pre-link) scores
+#' @param predleaf return leaf indices
+#' @param predcontrib return SHAP feature contributions
+#' @param num_iteration number of iterations to use (-1 = all / best)
+#' @param ... passed through as prediction parameters
+#' @export
+predict.lgb.Booster <- function(object, data, rawscore = FALSE,
+                                predleaf = FALSE, predcontrib = FALSE,
+                                num_iteration = -1L, ...) {
+  ptype <- 0L # C_API_PREDICT_NORMAL
+  if (rawscore) ptype <- 1L
+  if (predleaf) ptype <- 2L
+  if (predcontrib) ptype <- 3L
+  if (num_iteration < 0L && object$best_iter > 0L) {
+    num_iteration <- object$best_iter
+  }
+  m <- lgb.to.matrix(data)
+  pred <- .Call(LGBT_R_BoosterPredictForMat,
+                lgb.check.handle(object$handle, "Booster"),
+                m, nrow(m), ncol(m), ptype, as.integer(num_iteration),
+                lgb.params2str(list(...)))
+  n_class <- .Call(LGBT_R_BoosterGetNumClasses, object$handle)
+  width <- length(pred) / nrow(m)
+  if (width > 1L && !predleaf) {
+    # multiclass / contrib predictions come back row-major [nrow, width]
+    pred <- matrix(pred, nrow = nrow(m), ncol = width, byrow = TRUE)
+  }
+  pred
+}
+
+#' Save a booster as a reference-format text model file
+#' @param booster lgb.Booster
+#' @param filename output path
+#' @param num_iteration iterations to save (-1 = all)
+#' @export
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  stopifnot(inherits(booster, "lgb.Booster"))
+  .Call(LGBT_R_BoosterSaveModel, booster$handle, as.integer(num_iteration),
+        filename)
+  invisible(booster)
+}
+
+#' Load a booster from a reference-format text model file
+#' @param filename model path
+#' @export
+lgb.load <- function(filename) {
+  bst <- new.env(parent = emptyenv())
+  bst$handle <- .Call(LGBT_R_BoosterCreateFromModelfile, filename)
+  bst$params <- list()
+  bst$valid_names <- character(0L)
+  bst$record_evals <- list()
+  bst$best_iter <- -1L
+  class(bst) <- "lgb.Booster"
+  bst
+}
+
+#' Extract a recorded evaluation series from a trained model
+#' @param booster lgb.Booster returned by \code{lgb.train}
+#' @param data_name validation set name
+#' @param eval_name metric name
+#' @export
+lgb.get.eval.result <- function(booster, data_name, eval_name) {
+  series <- booster$record_evals[[data_name]][[eval_name]]
+  if (is.null(series)) {
+    stop(sprintf("no recorded metric %s on %s", eval_name, data_name))
+  }
+  unlist(series)
+}

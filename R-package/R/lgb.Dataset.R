@@ -1,0 +1,98 @@
+# lgb.Dataset — binned dataset surface.
+# API counterpart of the reference R-package/R/lgb.Dataset.R, implemented as a
+# plain environment + externalptr over this package's .Call bridge (the
+# reference uses R6; an environment keeps the dependency footprint at base R).
+
+#' Construct a lgb.Dataset
+#'
+#' Bins \code{data} (numeric matrix, data.frame or dgCMatrix) for training.
+#' Construction is lazy: binning happens on first use, so that a validation
+#' set created with \code{lgb.Dataset.create.valid} shares the training
+#' set's bin mappers (BinMapper reuse, reference dataset_loader semantics).
+#'
+#' @param data matrix / data.frame / dgCMatrix, or path to a text/binary file
+#' @param label numeric response vector
+#' @param weight per-row weights
+#' @param group query sizes for ranking objectives
+#' @param init_score starting scores
+#' @param reference training lgb.Dataset whose binning this set must reuse
+#' @param params named list of dataset parameters (max_bin, ...)
+#' @export
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, reference = NULL, params = list()) {
+  ds <- new.env(parent = emptyenv())
+  ds$raw_data <- data
+  ds$label <- label
+  ds$weight <- weight
+  ds$group <- group
+  ds$init_score <- init_score
+  ds$reference <- reference
+  ds$params <- params
+  ds$handle <- NULL
+  class(ds) <- "lgb.Dataset"
+  ds
+}
+
+#' Validation dataset sharing the training set's binning
+#' @param dataset the training lgb.Dataset
+#' @param data,label,... as in \code{lgb.Dataset}
+#' @export
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL, ...) {
+  stopifnot(inherits(dataset, "lgb.Dataset"))
+  lgb.Dataset(data, label = label, reference = dataset, ...)
+}
+
+# Materialize the native handle (construct-on-first-use).
+lgb.Dataset.construct <- function(ds) {
+  if (!is.null(ds$handle)) {
+    return(invisible(ds))
+  }
+  pstr <- lgb.params2str(ds$params)
+  ref_handle <- NULL
+  if (!is.null(ds$reference)) {
+    lgb.Dataset.construct(ds$reference)
+    ref_handle <- ds$reference$handle
+  }
+  data <- ds$raw_data
+  if (is.character(data)) {
+    ds$handle <- .Call(LGBT_R_DatasetCreateFromFile, data, pstr, ref_handle)
+  } else if (is(data, "dgCMatrix")) {
+    ds$handle <- .Call(LGBT_R_DatasetCreateFromCSC, data@p, data@i, data@x,
+                       nrow(data), pstr, ref_handle)
+  } else {
+    m <- lgb.to.matrix(data)
+    ds$handle <- .Call(LGBT_R_DatasetCreateFromMat, m, nrow(m), ncol(m),
+                       pstr, ref_handle)
+  }
+  if (!is.null(ds$label)) {
+    .Call(LGBT_R_DatasetSetField, ds$handle, "label", as.double(ds$label))
+  }
+  if (!is.null(ds$weight)) {
+    .Call(LGBT_R_DatasetSetField, ds$handle, "weight", as.double(ds$weight))
+  }
+  if (!is.null(ds$group)) {
+    .Call(LGBT_R_DatasetSetField, ds$handle, "group", as.integer(ds$group))
+  }
+  if (!is.null(ds$init_score)) {
+    .Call(LGBT_R_DatasetSetField, ds$handle, "init_score",
+          as.double(ds$init_score))
+  }
+  invisible(ds)
+}
+
+#' Save a constructed dataset in the reference-compatible binary format
+#' @param dataset lgb.Dataset
+#' @param fname output path
+#' @export
+lgb.Dataset.save <- function(dataset, fname) {
+  lgb.Dataset.construct(dataset)
+  .Call(LGBT_R_DatasetSaveBinary, dataset$handle, fname)
+  invisible(dataset)
+}
+
+#' @export
+dim.lgb.Dataset <- function(x) {
+  lgb.Dataset.construct(x)
+  c(.Call(LGBT_R_DatasetGetNumData, x$handle),
+    .Call(LGBT_R_DatasetGetNumFeature, x$handle))
+}

@@ -1,0 +1,89 @@
+# lgb.train / lightgbm — training drivers.
+# API counterpart of the reference R-package/R/lgb.train.R + lightgbm.R:
+# the boosting loop lives behind LGBM_BoosterUpdateOneIter; this layer adds
+# validation tracking, early stopping, and eval recording.
+
+#' Train a gradient boosting model
+#'
+#' @param params named list of training parameters (objective, num_leaves,
+#'   learning_rate, tree_learner, ...)
+#' @param data training lgb.Dataset
+#' @param nrounds number of boosting rounds
+#' @param valids named list of validation lgb.Dataset objects
+#' @param early_stopping_rounds stop when no validation metric improves for
+#'   this many rounds (NULL disables)
+#' @param verbose 1 prints per-round eval lines, <= 0 is silent
+#' @param eval_freq print every eval_freq rounds
+#' @return a trained lgb.Booster with \code{record_evals} and
+#'   \code{best_iter} populated
+#' @export
+lgb.train <- function(params = list(), data, nrounds = 100L, valids = list(),
+                      early_stopping_rounds = NULL, verbose = 1L,
+                      eval_freq = 1L) {
+  stopifnot(inherits(data, "lgb.Dataset"), nrounds >= 1L)
+  bst <- lgb.Booster.new(data, params)
+  if (length(valids) > 0L) {
+    stopifnot(!is.null(names(valids)), all(nzchar(names(valids))))
+    for (name in names(valids)) {
+      lgb.Booster.add.valid(bst, valids[[name]], name)
+    }
+  }
+
+  best_score <- Inf
+  best_iter <- -1L
+  stale <- 0L
+  for (i in seq_len(nrounds)) {
+    finished <- lgb.Booster.update(bst)
+    if (length(bst$valid_names) > 0L) {
+      for (vi in seq_along(bst$valid_names)) {
+        vals <- lgb.Booster.eval(bst, vi)
+        vname <- bst$valid_names[vi]
+        for (mi in seq_along(vals)) {
+          key <- sprintf("metric_%d", mi)
+          bst$record_evals[[vname]][[key]] <-
+            c(bst$record_evals[[vname]][[key]], vals[mi])
+        }
+        if (verbose > 0L && i %% eval_freq == 0L) {
+          message(sprintf("[%d] %s: %s", i, vname,
+                          paste(signif(vals, 6L), collapse = " ")))
+        }
+        # early stopping tracks the first metric of the first valid set;
+        # the ABI reports metrics in minimize orientation via sign
+        if (vi == 1L && length(vals) > 0L && !is.null(early_stopping_rounds)) {
+          score <- vals[1L]
+          if (score < best_score) {
+            best_score <- score
+            best_iter <- i
+            stale <- 0L
+          } else {
+            stale <- stale + 1L
+            if (stale >= early_stopping_rounds) {
+              if (verbose > 0L) {
+                message(sprintf("early stop at round %d (best %d)", i, best_iter))
+              }
+              bst$best_iter <- best_iter
+              return(bst)
+            }
+          }
+        }
+      }
+    }
+    if (isTRUE(finished)) {
+      break
+    }
+  }
+  bst$best_iter <- best_iter
+  bst
+}
+
+#' Simple training entry point (label + matrix in one call)
+#' @param data feature matrix
+#' @param label response vector
+#' @param params named list of parameters
+#' @param nrounds boosting rounds
+#' @param ... forwarded to \code{lgb.train}
+#' @export
+lightgbm <- function(data, label, params = list(), nrounds = 100L, ...) {
+  train_set <- lgb.Dataset(data, label = label)
+  lgb.train(params = params, data = train_set, nrounds = nrounds, ...)
+}

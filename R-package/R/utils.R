@@ -1,0 +1,43 @@
+# Internal helpers for the lightgbm.tpu R surface.
+# Counterpart of the reference R-package/R/utils.R (lgb.params2str etc.),
+# written for this package's .Call bridge (src/lightgbm_tpu_R.cpp).
+
+# Render a named list as the "k1=v1 k2=v2" string the C ABI's parameter
+# parser consumes (Config::KV2Map semantics: later keys win, vectors join
+# with commas).
+lgb.params2str <- function(params) {
+  if (length(params) == 0L) {
+    return("")
+  }
+  stopifnot(!is.null(names(params)), all(nzchar(names(params))))
+  pairs <- vapply(seq_along(params), function(i) {
+    val <- params[[i]]
+    if (is.logical(val)) {
+      val <- ifelse(val, "true", "false")
+    }
+    paste0(names(params)[i], "=", paste(as.character(val), collapse = ","))
+  }, character(1L))
+  paste(pairs, collapse = " ")
+}
+
+# Coerce R inputs to the double column-major matrix the bridge expects.
+lgb.to.matrix <- function(data) {
+  if (is(data, "dgCMatrix")) {
+    return(data) # handled by the CSC path
+  }
+  if (is.data.frame(data)) {
+    data <- as.matrix(data)
+  }
+  if (!is.matrix(data)) {
+    data <- matrix(data, ncol = 1L)
+  }
+  storage.mode(data) <- "double"
+  data
+}
+
+lgb.check.handle <- function(x, what) {
+  if (is.null(x)) {
+    stop(sprintf("lightgbm.tpu: %s handle is NULL (object already freed?)", what))
+  }
+  x
+}

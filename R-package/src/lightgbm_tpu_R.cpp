@@ -1,0 +1,297 @@
+// .Call bridge from R to the lightgbm_tpu C ABI.
+//
+// Counterpart of the reference's src/lightgbm_R.cpp (SEXP wrappers over
+// c_api.h): each R entry point converts SEXP arguments to the C ABI types,
+// invokes the LGBM_* function from lgbt_c_api.h, and raises an R error
+// carrying LGBM_GetLastError() on failure. Handles are stored as R
+// externalptr objects with finalizers, so Datasets/Boosters free themselves
+// at gc like the reference's R6 class finalize() methods do.
+//
+// Built by R CMD INSTALL via src/Makevars, which links ../../lightgbm_tpu/
+// native/_lgbt_capi.so (the embedded-interpreter ABI shim — see
+// lightgbm_tpu/capi.py for its build line).
+
+#include <R.h>
+#include <Rinternals.h>
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "../../lightgbm_tpu/native/lgbt_c_api.h"
+
+#define CHECK_CALL(x)                           \
+  if ((x) != 0) {                               \
+    Rf_error("lightgbm.tpu: %s", LGBM_GetLastError()); \
+  }
+
+namespace {
+
+// externalptr tag distinguishing our handles from foreign pointers
+SEXP dataset_tag() {
+  static SEXP tag = Rf_install("lgbt_dataset_handle");
+  return tag;
+}
+SEXP booster_tag() {
+  static SEXP tag = Rf_install("lgbt_booster_handle");
+  return tag;
+}
+
+void dataset_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    LGBM_DatasetFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+void booster_finalizer(SEXP ptr) {
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h != nullptr) {
+    LGBM_BoosterFree(h);
+    R_ClearExternalPtr(ptr);
+  }
+}
+
+SEXP wrap_handle(void* h, SEXP tag, void (*fin)(SEXP)) {
+  SEXP ptr = PROTECT(R_MakeExternalPtr(h, tag, R_NilValue));
+  R_RegisterCFinalizerEx(ptr, fin, TRUE);
+  UNPROTECT(1);
+  return ptr;
+}
+
+void* unwrap(SEXP ptr, SEXP tag, const char* what) {
+  if (TYPEOF(ptr) != EXTPTRSXP || R_ExternalPtrTag(ptr) != tag) {
+    Rf_error("lightgbm.tpu: expected a %s handle", what);
+  }
+  void* h = R_ExternalPtrAddr(ptr);
+  if (h == nullptr) {
+    Rf_error("lightgbm.tpu: %s handle already freed", what);
+  }
+  return h;
+}
+
+void* dataset_or_null(SEXP ptr) {
+  if (Rf_isNull(ptr)) return nullptr;
+  return unwrap(ptr, dataset_tag(), "Dataset");
+}
+
+}  // namespace
+
+extern "C" {
+
+SEXP LGBT_R_DatasetCreateFromFile(SEXP filename, SEXP parameters,
+                                  SEXP reference) {
+  void* out = nullptr;
+  CHECK_CALL(LGBM_DatasetCreateFromFile(CHAR(Rf_asChar(filename)),
+                                        CHAR(Rf_asChar(parameters)),
+                                        dataset_or_null(reference), &out));
+  return wrap_handle(out, dataset_tag(), dataset_finalizer);
+}
+
+// data: numeric matrix in column-major R layout
+SEXP LGBT_R_DatasetCreateFromMat(SEXP data, SEXP nrow, SEXP ncol,
+                                 SEXP parameters, SEXP reference) {
+  void* out = nullptr;
+  CHECK_CALL(LGBM_DatasetCreateFromMat(
+      REAL(data), C_API_DTYPE_FLOAT64, Rf_asInteger(nrow), Rf_asInteger(ncol),
+      /*is_row_major=*/0, CHAR(Rf_asChar(parameters)),
+      dataset_or_null(reference), &out));
+  return wrap_handle(out, dataset_tag(), dataset_finalizer);
+}
+
+// CSC pieces from a dgCMatrix (p, i, x slots)
+SEXP LGBT_R_DatasetCreateFromCSC(SEXP col_ptr, SEXP indices, SEXP data,
+                                 SEXP num_row, SEXP parameters,
+                                 SEXP reference) {
+  const int64_t ncol_ptr = XLENGTH(col_ptr);
+  const int64_t nelem = XLENGTH(data);
+  std::vector<int64_t> p(ncol_ptr);
+  const int* p32 = INTEGER(col_ptr);
+  for (int64_t i = 0; i < ncol_ptr; ++i) p[i] = p32[i];
+  void* out = nullptr;
+  CHECK_CALL(LGBM_DatasetCreateFromCSC(
+      p.data(), C_API_DTYPE_INT64, INTEGER(indices), REAL(data),
+      C_API_DTYPE_FLOAT64, ncol_ptr, nelem,
+      static_cast<int64_t>(Rf_asInteger(num_row)), CHAR(Rf_asChar(parameters)),
+      dataset_or_null(reference), &out));
+  return wrap_handle(out, dataset_tag(), dataset_finalizer);
+}
+
+SEXP LGBT_R_DatasetGetNumData(SEXP handle) {
+  int out = 0;
+  CHECK_CALL(
+      LGBM_DatasetGetNumData(unwrap(handle, dataset_tag(), "Dataset"), &out));
+  return Rf_ScalarInteger(out);
+}
+
+SEXP LGBT_R_DatasetGetNumFeature(SEXP handle) {
+  int out = 0;
+  CHECK_CALL(LGBM_DatasetGetNumFeature(unwrap(handle, dataset_tag(), "Dataset"),
+                                       &out));
+  return Rf_ScalarInteger(out);
+}
+
+// field_name in {"label", "weight", "init_score"}: numeric; "group": integer
+SEXP LGBT_R_DatasetSetField(SEXP handle, SEXP field_name, SEXP field_data) {
+  void* h = unwrap(handle, dataset_tag(), "Dataset");
+  const char* name = CHAR(Rf_asChar(field_name));
+  const int n = static_cast<int>(XLENGTH(field_data));
+  if (std::strcmp(name, "group") == 0 || std::strcmp(name, "query") == 0) {
+    CHECK_CALL(LGBM_DatasetSetField(h, name, INTEGER(field_data), n,
+                                    C_API_DTYPE_INT32));
+  } else {
+    // label/weight/init_score ride as float32, like the reference R bridge
+    std::vector<float> buf(n);
+    const double* src = REAL(field_data);
+    for (int i = 0; i < n; ++i) buf[i] = static_cast<float>(src[i]);
+    CHECK_CALL(
+        LGBM_DatasetSetField(h, name, buf.data(), n, C_API_DTYPE_FLOAT32));
+  }
+  return R_NilValue;
+}
+
+SEXP LGBT_R_DatasetSaveBinary(SEXP handle, SEXP filename) {
+  CHECK_CALL(LGBM_DatasetSaveBinary(unwrap(handle, dataset_tag(), "Dataset"),
+                                    CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+SEXP LGBT_R_DatasetFree(SEXP handle) {
+  dataset_finalizer(handle);
+  return R_NilValue;
+}
+
+SEXP LGBT_R_BoosterCreate(SEXP train_data, SEXP parameters) {
+  void* out = nullptr;
+  CHECK_CALL(LGBM_BoosterCreate(unwrap(train_data, dataset_tag(), "Dataset"),
+                                CHAR(Rf_asChar(parameters)), &out));
+  return wrap_handle(out, booster_tag(), booster_finalizer);
+}
+
+SEXP LGBT_R_BoosterCreateFromModelfile(SEXP filename) {
+  void* out = nullptr;
+  int iters = 0;
+  CHECK_CALL(LGBM_BoosterCreateFromModelfile(CHAR(Rf_asChar(filename)), &iters,
+                                             &out));
+  SEXP ptr = PROTECT(wrap_handle(out, booster_tag(), booster_finalizer));
+  Rf_setAttrib(ptr, Rf_install("num_iterations"), Rf_ScalarInteger(iters));
+  UNPROTECT(1);
+  return ptr;
+}
+
+SEXP LGBT_R_BoosterFree(SEXP handle) {
+  booster_finalizer(handle);
+  return R_NilValue;
+}
+
+SEXP LGBT_R_BoosterAddValidData(SEXP handle, SEXP valid_data) {
+  CHECK_CALL(
+      LGBM_BoosterAddValidData(unwrap(handle, booster_tag(), "Booster"),
+                               unwrap(valid_data, dataset_tag(), "Dataset")));
+  return R_NilValue;
+}
+
+SEXP LGBT_R_BoosterUpdateOneIter(SEXP handle) {
+  int finished = 0;
+  CHECK_CALL(LGBM_BoosterUpdateOneIter(unwrap(handle, booster_tag(), "Booster"),
+                                       &finished));
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBT_R_BoosterGetNumClasses(SEXP handle) {
+  int out = 0;
+  CHECK_CALL(LGBM_BoosterGetNumClasses(unwrap(handle, booster_tag(), "Booster"),
+                                       &out));
+  return Rf_ScalarInteger(out);
+}
+
+// numeric vector of metric values on data_idx (0 = train, 1.. = valids)
+SEXP LGBT_R_BoosterGetEval(SEXP handle, SEXP data_idx) {
+  double buf[64];
+  int len = 0;
+  CHECK_CALL(LGBM_BoosterGetEval(unwrap(handle, booster_tag(), "Booster"),
+                                 Rf_asInteger(data_idx), &len, buf));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, len));
+  std::memcpy(REAL(out), buf, sizeof(double) * len);
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBT_R_BoosterSaveModel(SEXP handle, SEXP num_iteration, SEXP filename) {
+  CHECK_CALL(LGBM_BoosterSaveModel(unwrap(handle, booster_tag(), "Booster"),
+                                   /*start_iteration=*/0,
+                                   Rf_asInteger(num_iteration),
+                                   CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+// data: column-major numeric matrix; returns numeric vector of predictions
+SEXP LGBT_R_BoosterPredictForMat(SEXP handle, SEXP data, SEXP nrow, SEXP ncol,
+                                 SEXP predict_type, SEXP num_iteration,
+                                 SEXP parameter) {
+  void* h = unwrap(handle, booster_tag(), "Booster");
+  const int nr = Rf_asInteger(nrow);
+  const int nc = Rf_asInteger(ncol);
+  const int ptype = Rf_asInteger(predict_type);
+  int num_class = 1;
+  CHECK_CALL(LGBM_BoosterGetNumClasses(h, &num_class));
+  int64_t cap = static_cast<int64_t>(nr) * num_class;
+  if (ptype == C_API_PREDICT_CONTRIB) cap = static_cast<int64_t>(nr) * (nc + 1) * num_class;
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, cap));
+  int64_t out_len = 0;
+  CHECK_CALL(LGBM_BoosterPredictForMat(
+      h, REAL(data), C_API_DTYPE_FLOAT64, nr, nc, /*is_row_major=*/0, ptype,
+      Rf_asInteger(num_iteration), CHAR(Rf_asChar(parameter)), &out_len,
+      REAL(out)));
+  if (out_len != cap) {
+    SEXP trimmed = PROTECT(Rf_allocVector(REALSXP, out_len));
+    std::memcpy(REAL(trimmed), REAL(out), sizeof(double) * out_len);
+    UNPROTECT(2);
+    return trimmed;
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBT_R_BoosterPredictForFile(SEXP handle, SEXP data_filename,
+                                  SEXP data_has_header, SEXP predict_type,
+                                  SEXP num_iteration, SEXP parameter,
+                                  SEXP result_filename) {
+  CHECK_CALL(LGBM_BoosterPredictForFile(
+      unwrap(handle, booster_tag(), "Booster"), CHAR(Rf_asChar(data_filename)),
+      Rf_asLogical(data_has_header), Rf_asInteger(predict_type),
+      Rf_asInteger(num_iteration), CHAR(Rf_asChar(parameter)),
+      CHAR(Rf_asChar(result_filename))));
+  return R_NilValue;
+}
+
+// registration table (R >= 3.4 native routine registration)
+static const R_CallMethodDef kCallMethods[] = {
+    {"LGBT_R_DatasetCreateFromFile", (DL_FUNC)&LGBT_R_DatasetCreateFromFile, 3},
+    {"LGBT_R_DatasetCreateFromMat", (DL_FUNC)&LGBT_R_DatasetCreateFromMat, 5},
+    {"LGBT_R_DatasetCreateFromCSC", (DL_FUNC)&LGBT_R_DatasetCreateFromCSC, 6},
+    {"LGBT_R_DatasetGetNumData", (DL_FUNC)&LGBT_R_DatasetGetNumData, 1},
+    {"LGBT_R_DatasetGetNumFeature", (DL_FUNC)&LGBT_R_DatasetGetNumFeature, 1},
+    {"LGBT_R_DatasetSetField", (DL_FUNC)&LGBT_R_DatasetSetField, 3},
+    {"LGBT_R_DatasetSaveBinary", (DL_FUNC)&LGBT_R_DatasetSaveBinary, 2},
+    {"LGBT_R_DatasetFree", (DL_FUNC)&LGBT_R_DatasetFree, 1},
+    {"LGBT_R_BoosterCreate", (DL_FUNC)&LGBT_R_BoosterCreate, 2},
+    {"LGBT_R_BoosterCreateFromModelfile",
+     (DL_FUNC)&LGBT_R_BoosterCreateFromModelfile, 1},
+    {"LGBT_R_BoosterFree", (DL_FUNC)&LGBT_R_BoosterFree, 1},
+    {"LGBT_R_BoosterAddValidData", (DL_FUNC)&LGBT_R_BoosterAddValidData, 2},
+    {"LGBT_R_BoosterUpdateOneIter", (DL_FUNC)&LGBT_R_BoosterUpdateOneIter, 1},
+    {"LGBT_R_BoosterGetNumClasses", (DL_FUNC)&LGBT_R_BoosterGetNumClasses, 1},
+    {"LGBT_R_BoosterGetEval", (DL_FUNC)&LGBT_R_BoosterGetEval, 2},
+    {"LGBT_R_BoosterSaveModel", (DL_FUNC)&LGBT_R_BoosterSaveModel, 3},
+    {"LGBT_R_BoosterPredictForMat", (DL_FUNC)&LGBT_R_BoosterPredictForMat, 7},
+    {"LGBT_R_BoosterPredictForFile", (DL_FUNC)&LGBT_R_BoosterPredictForFile, 7},
+    {NULL, NULL, 0}};
+
+void R_init_lightgbm_tpu(DllInfo* dll) {
+  R_registerRoutines(dll, NULL, kCallMethods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
+}
+
+}  // extern "C"

@@ -1,0 +1,121 @@
+/* C ABI of lightgbm_tpu — header for C/C++/SWIG/R callers.
+ *
+ * Mirrors the reference ABI (/root/reference/include/LightGBM/c_api.h:41-986)
+ * for the entry points lightgbm_tpu exports from native/lgbt_capi.cpp;
+ * programs written against the reference's lib_lightgbm.so link and run
+ * unchanged against _lgbt_capi.so for this surface. Handles are opaque
+ * pointers; every call returns 0 on success, -1 on error with the message
+ * available from LGBM_GetLastError().
+ */
+#ifndef LIGHTGBM_TPU_C_API_H_
+#define LIGHTGBM_TPU_C_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+/* dtype tags for raw buffers (c_api.h:24-33) */
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32 (2)
+#define C_API_DTYPE_INT64 (3)
+
+/* prediction kinds (c_api.h:35-39) */
+#define C_API_PREDICT_NORMAL (0)
+#define C_API_PREDICT_RAW_SCORE (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+#define C_API_PREDICT_CONTRIB (3)
+
+/* Last error message of this thread (c_api.h:50). */
+const char* LGBM_GetLastError();
+
+/* ------------------------------------------------------------------ */
+/* Dataset                                                             */
+/* ------------------------------------------------------------------ */
+
+/* Load + bin a text/binary dataset file (c_api.h:66). */
+int LGBM_DatasetCreateFromFile(const char* filename, const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+
+/* Bin a dense row- or column-major matrix (c_api.h:217). */
+int LGBM_DatasetCreateFromMat(const void* data, int data_type, int32_t nrow,
+                              int32_t ncol, int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+/* Bin a CSR matrix without densifying (c_api.h:140). */
+int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t nindptr, int64_t nelem,
+                              int64_t num_col, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+/* Bin a CSC matrix without densifying (c_api.h:178). */
+int LGBM_DatasetCreateFromCSC(const void* col_ptr, int col_ptr_type,
+                              const int32_t* indices, const void* data,
+                              int data_type, int64_t ncol_ptr, int64_t nelem,
+                              int64_t num_row, const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out);
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int* out);
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out);
+
+/* Set label/weight/init_score/group (c_api.h:310). */
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element, int type);
+
+int LGBM_DatasetSaveBinary(DatasetHandle handle, const char* filename);
+int LGBM_DatasetFree(DatasetHandle handle);
+
+/* ------------------------------------------------------------------ */
+/* Booster                                                             */
+/* ------------------------------------------------------------------ */
+
+int LGBM_BoosterCreate(const DatasetHandle train_data, const char* parameters,
+                       BoosterHandle* out);
+int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+int LGBM_BoosterFree(BoosterHandle handle);
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data);
+
+/* One boosting iteration; *is_finished=1 when no splittable leaf remains
+ * (c_api.h:480). */
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
+
+/* Metric values on data_idx (0=train, 1..=valid sets) (c_api.h:547). */
+int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
+                        double* out_results);
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, const char* filename);
+
+/* Predict over a dense matrix (c_api.h:807); out_result must hold
+ * nrow * num_class (or nrow * (ncol+1) * num_class for contribs). */
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+
+/* Predict a file to a result file (c_api.h:570). */
+int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
+                               int data_has_header, int predict_type,
+                               int num_iteration, const char* parameter,
+                               const char* result_filename);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* LIGHTGBM_TPU_C_API_H_ */

@@ -1,0 +1,34 @@
+/* SWIG interface for the lightgbm_tpu C ABI — the Java/JNI binding seam.
+ *
+ * Counterpart of the reference's swig/lightgbmlib.i: `swig -java -c++` over
+ * this file generates the JNI C++ shim plus the Java proxy classes
+ * (lightgbmtpulib.java, lightgbmtpulibJNI.java, SWIGTYPE_* handle wrappers);
+ * compiling the shim against jni.h and linking _lgbt_capi.so yields the Java
+ * binding the same way the reference builds lightgbmlib.jar (CMakeLists
+ * USE_SWIG branch). Generation is CI-tested (tests/test_swig.py); compiling
+ * the JNI side needs a JDK, which this image does not carry.
+ */
+%module lightgbmtpulib
+
+%{
+#include "../lightgbm_tpu/native/lgbt_c_api.h"
+%}
+
+%include "stdint.i"
+%include "carrays.i"
+%include "cpointer.i"
+
+/* pointer helpers for out-params, mirroring lightgbmlib.i's usage:
+ * new_intp()/intp_value() etc. on the Java side */
+%pointer_functions(int, intp)
+%pointer_functions(int64_t, int64_tp)
+%pointer_functions(double, doublep)
+%pointer_functions(void*, voidpp)
+
+/* flat native arrays for data/result buffers */
+%array_functions(double, doubleArray)
+%array_functions(float, floatArray)
+%array_functions(int, intArray)
+%array_functions(int64_t, longArray)
+
+%include "../lightgbm_tpu/native/lgbt_c_api.h"

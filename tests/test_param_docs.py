@@ -1,0 +1,34 @@
+"""Parameter-doc lockstep check (reference: helpers/parameter_generator.py +
+the .ci/test.sh diff that keeps config.h <-> Parameters.rst in sync).
+
+docs/Parameters.md must exactly match what helpers/gen_param_docs.py renders
+from the live Config dataclass — a config.py change without a doc regen fails
+here, the same contract the reference enforces in CI.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parameters_md_in_lockstep():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "helpers", "gen_param_docs.py"), "--check"],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_docs_cover_every_field_and_alias():
+    sys.path.insert(0, REPO)
+    import dataclasses
+
+    from lightgbm_tpu.config import PARAM_ALIASES, Config
+
+    text = open(os.path.join(REPO, "docs", "Parameters.md")).read()
+    for f in dataclasses.fields(Config):
+        assert "`%s`" % f.name in text, "Parameters.md missing field %s" % f.name
+    for alias in PARAM_ALIASES:
+        assert "`%s`" % alias in text, "Parameters.md missing alias %s" % alias

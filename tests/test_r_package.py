@@ -1,0 +1,85 @@
+"""R package structural checks (reference: R-package/ + src/lightgbm_R.cpp).
+
+R itself is not in this image, so these tests validate what can be validated
+without an R runtime:
+  * the .Call bridge compiles the same C ABI header the ctypes path uses and
+    registers every bridge symbol the R sources invoke;
+  * package metadata (DESCRIPTION/NAMESPACE) is well-formed and the exported
+    surface matches the reference package's core API;
+  * the R sources are syntactically plausible (balanced delimiters, every
+    .Call target defined by the bridge).
+"""
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RPKG = os.path.join(REPO, "R-package")
+BRIDGE = os.path.join(RPKG, "src", "lightgbm_tpu_R.cpp")
+
+
+def _r_sources():
+    rdir = os.path.join(RPKG, "R")
+    return {f: open(os.path.join(rdir, f)).read() for f in sorted(os.listdir(rdir))}
+
+
+def test_description_and_namespace():
+    desc = open(os.path.join(RPKG, "DESCRIPTION")).read()
+    for field in ("Package:", "Version:", "License:", "NeedsCompilation: yes"):
+        assert field in desc
+    ns = open(os.path.join(RPKG, "NAMESPACE")).read()
+    # the core API surface of the reference R package
+    for exp in (
+        "lgb.Dataset", "lgb.Dataset.create.valid", "lgb.Dataset.save",
+        "lgb.train", "lgb.cv", "lightgbm", "lgb.load", "lgb.save",
+    ):
+        assert "export(%s)" % exp in ns, "NAMESPACE missing export(%s)" % exp
+    assert "S3method(predict, lgb.Booster)" in ns
+    assert "useDynLib" in ns
+
+
+def test_bridge_registers_all_call_targets():
+    src = open(BRIDGE).read()
+    # symbols defined by the bridge
+    defined = set(re.findall(r"SEXP\s+(LGBT_R_\w+)\s*\(", src))
+    # symbols listed in the registration table
+    registered = set(re.findall(r'\{"(LGBT_R_\w+)"', src))
+    assert defined == registered, (
+        "bridge defines %s but registers %s" % (defined - registered, registered - defined)
+    )
+    # every .Call target used from R is defined in the bridge
+    used = set()
+    for _, text in _r_sources().items():
+        used |= set(re.findall(r"\.Call\(\s*(LGBT_R_\w+)", text))
+    missing = used - defined
+    assert not missing, "R sources call unregistered bridge symbols: %s" % missing
+    # the bridge consumes the shared C ABI header, not its own copy
+    assert "lgbt_c_api.h" in src
+    # registration arity matches each wrapper's parameter count
+    for name, arity in re.findall(r'\{"(LGBT_R_\w+)",\s*\(DL_FUNC\)&\w+,\s*(\d+)\}', src):
+        sig = re.search(r"SEXP\s+%s\s*\(([^)]*)\)" % name, src).group(1)
+        n_params = 0 if not sig.strip() else sig.count("SEXP")
+        assert n_params == int(arity), "%s registered with arity %s but takes %d" % (
+            name, arity, n_params)
+
+
+def test_r_sources_balanced_and_documented():
+    for fname, text in _r_sources().items():
+        for op, cl in (("(", ")"), ("{", "}"), ("[", "]")):
+            # strings/comments can unbalance delimiters in principle; the
+            # sources deliberately avoid brackets in prose
+            stripped = re.sub(r"#.*", "", text)
+            stripped = re.sub(r'"[^"]*"', '""', stripped)
+            assert stripped.count(op) == stripped.count(cl), (
+                "%s: unbalanced %s%s" % (fname, op, cl)
+            )
+    # exported functions carry roxygen @export markers
+    exported = 0
+    for text in _r_sources().values():
+        exported += text.count("#' @export")
+    assert exported >= 10
+
+
+def test_makevars_links_capi():
+    mk = open(os.path.join(RPKG, "src", "Makevars")).read()
+    assert "_lgbt_capi.so" in mk
+    assert "lightgbm_tpu/native" in mk
