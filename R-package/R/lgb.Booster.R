@@ -58,7 +58,6 @@ predict.lgb.Booster <- function(object, data, rawscore = FALSE,
                 lgb.check.handle(object$handle, "Booster"),
                 m, nrow(m), ncol(m), ptype, as.integer(num_iteration),
                 lgb.params2str(list(...)))
-  n_class <- .Call(LGBT_R_BoosterGetNumClasses, object$handle)
   width <- length(pred) / nrow(m)
   if (width > 1L && !predleaf) {
     # multiclass / contrib predictions come back row-major [nrow, width]

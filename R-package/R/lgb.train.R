@@ -29,6 +29,14 @@ lgb.train <- function(params = list(), data, nrounds = 100L, valids = list(),
     }
   }
 
+  # orientation of the first configured metric: the ABI reports raw metric
+  # values, so maximize-metrics flip sign for the improvement test (same
+  # fixed higher-better set the reference R callbacks use)
+  maximize_metrics <- c("auc", "ndcg", "map", "average_precision")
+  first_metric <- unlist(params$metric)[1L]
+  sign_flip <- if (!is.null(first_metric) &&
+                   first_metric %in% maximize_metrics) -1.0 else 1.0
+
   best_score <- Inf
   best_iter <- -1L
   stale <- 0L
@@ -47,10 +55,10 @@ lgb.train <- function(params = list(), data, nrounds = 100L, valids = list(),
           message(sprintf("[%d] %s: %s", i, vname,
                           paste(signif(vals, 6L), collapse = " ")))
         }
-        # early stopping tracks the first metric of the first valid set;
-        # the ABI reports metrics in minimize orientation via sign
+        # early stopping tracks the first metric of the first valid set,
+        # sign-flipped for maximize-metrics so "improve" always means smaller
         if (vi == 1L && length(vals) > 0L && !is.null(early_stopping_rounds)) {
-          score <- vals[1L]
+          score <- sign_flip * vals[1L]
           if (score < best_score) {
             best_score <- score
             best_iter <- i

@@ -206,16 +206,26 @@ SEXP LGBT_R_BoosterGetNumClasses(SEXP handle) {
   return Rf_ScalarInteger(out);
 }
 
-// numeric vector of metric values on data_idx (0 = train, 1.. = valids)
+// numeric vector of metric values on data_idx (0 = train, 1.. = valids);
+// buffer sized by LGBM_BoosterGetEvalCounts, like the reference R bridge
 SEXP LGBT_R_BoosterGetEval(SEXP handle, SEXP data_idx) {
-  double buf[64];
+  void* h = unwrap(handle, booster_tag(), "Booster");
+  int count = 0;
+  CHECK_CALL(LGBM_BoosterGetEvalCounts(h, &count));
+  std::vector<double> buf(count > 0 ? count : 1);
   int len = 0;
-  CHECK_CALL(LGBM_BoosterGetEval(unwrap(handle, booster_tag(), "Booster"),
-                                 Rf_asInteger(data_idx), &len, buf));
+  CHECK_CALL(LGBM_BoosterGetEval(h, Rf_asInteger(data_idx), &len, buf.data()));
   SEXP out = PROTECT(Rf_allocVector(REALSXP, len));
-  std::memcpy(REAL(out), buf, sizeof(double) * len);
+  std::memcpy(REAL(out), buf.data(), sizeof(double) * len);
   UNPROTECT(1);
   return out;
+}
+
+SEXP LGBT_R_BoosterGetCurrentIteration(SEXP handle) {
+  int out = 0;
+  CHECK_CALL(LGBM_BoosterGetCurrentIteration(
+      unwrap(handle, booster_tag(), "Booster"), &out));
+  return Rf_ScalarInteger(out);
 }
 
 SEXP LGBT_R_BoosterSaveModel(SEXP handle, SEXP num_iteration, SEXP filename) {
@@ -237,7 +247,17 @@ SEXP LGBT_R_BoosterPredictForMat(SEXP handle, SEXP data, SEXP nrow, SEXP ncol,
   int num_class = 1;
   CHECK_CALL(LGBM_BoosterGetNumClasses(h, &num_class));
   int64_t cap = static_cast<int64_t>(nr) * num_class;
-  if (ptype == C_API_PREDICT_CONTRIB) cap = static_cast<int64_t>(nr) * (nc + 1) * num_class;
+  if (ptype == C_API_PREDICT_CONTRIB) {
+    cap = static_cast<int64_t>(nr) * (nc + 1) * num_class;
+  } else if (ptype == C_API_PREDICT_LEAF_INDEX) {
+    // one value per tree: num_class trees per completed iteration
+    int cur_iter = 0;
+    CHECK_CALL(LGBM_BoosterGetCurrentIteration(h, &cur_iter));
+    int64_t n_iter = cur_iter;
+    const int req = Rf_asInteger(num_iteration);
+    if (req > 0 && req < cur_iter) n_iter = req;
+    cap = static_cast<int64_t>(nr) * n_iter * num_class;
+  }
   SEXP out = PROTECT(Rf_allocVector(REALSXP, cap));
   int64_t out_len = 0;
   CHECK_CALL(LGBM_BoosterPredictForMat(
@@ -284,6 +304,8 @@ static const R_CallMethodDef kCallMethods[] = {
     {"LGBT_R_BoosterUpdateOneIter", (DL_FUNC)&LGBT_R_BoosterUpdateOneIter, 1},
     {"LGBT_R_BoosterGetNumClasses", (DL_FUNC)&LGBT_R_BoosterGetNumClasses, 1},
     {"LGBT_R_BoosterGetEval", (DL_FUNC)&LGBT_R_BoosterGetEval, 2},
+    {"LGBT_R_BoosterGetCurrentIteration",
+     (DL_FUNC)&LGBT_R_BoosterGetCurrentIteration, 1},
     {"LGBT_R_BoosterSaveModel", (DL_FUNC)&LGBT_R_BoosterSaveModel, 3},
     {"LGBT_R_BoosterPredictForMat", (DL_FUNC)&LGBT_R_BoosterPredictForMat, 7},
     {"LGBT_R_BoosterPredictForFile", (DL_FUNC)&LGBT_R_BoosterPredictForFile, 7},

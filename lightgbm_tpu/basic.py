@@ -171,9 +171,28 @@ class Dataset:
                 # materializes (dataset_loader.cpp two_round branch)
                 from .dist_loader import apply_sidecars, load_two_round
 
-                binned, row_idx = load_two_round(self.data, config)
+                names = (
+                    list(self.feature_name)
+                    if isinstance(self.feature_name, (list, tuple))
+                    else None
+                )
+                cats = (
+                    self.categorical_feature
+                    if self.categorical_feature not in (None, "auto")
+                    else None
+                )
+                binned, row_idx = load_two_round(
+                    self.data, config,
+                    feature_names=names, categorical_feature=cats,
+                )
                 apply_sidecars(binned, self.data, row_idx)
                 self._apply_metadata_overrides(binned.metadata)
+                if self._predictor is not None:
+                    # continued training: stream-predict init scores so the
+                    # raw matrix still never materializes whole
+                    binned.metadata.init_score = self._predictor_file_scores(
+                        self.data, config, binned.num_total_features
+                    )
                 self._binned = binned
                 self._config = config
                 return self
@@ -212,6 +231,10 @@ class Dataset:
                 feature_names = list(self.feature_name)
             if isinstance(self.categorical_feature, (list, tuple)):
                 cats = list(self.categorical_feature)
+            elif self.categorical_feature not in (None, "auto"):
+                # comma-joined / "name:col" string spec (_parse_categorical
+                # resolves names against the file header's feature_names)
+                cats = self.categorical_feature
         ref_binned = None
         if self.reference is not None:
             self.reference.construct(config)
@@ -235,6 +258,29 @@ class Dataset:
         if self.free_raw_data:
             self.data = None
         return self
+
+    def _predictor_file_scores(
+        self, path: str, config, num_features: int
+    ) -> np.ndarray:
+        """Init scores from the predictor, streamed chunk-wise over the file
+        (the two-round analogue of _predictor_raw_scores: bounded memory)."""
+        from .dist_loader import iter_text_chunks
+
+        parts = []
+        for X, _, _ in iter_text_chunks(
+            path,
+            has_header=config.header,
+            label_column=config.label_column,
+            num_features=num_features,
+        ):
+            if X.shape[1] < num_features:
+                X = np.pad(X, ((0, 0), (0, num_features - X.shape[1])))
+            raw = self._predictor.predict_raw(X)
+            parts.append(raw.T if raw.ndim == 2 else raw)
+        scores = np.concatenate(parts, axis=-1)
+        if scores.ndim == 2:
+            return scores.reshape(-1)  # class-major flatten
+        return scores
 
     def _predictor_raw_scores(self, data: np.ndarray) -> np.ndarray:
         if hasattr(data, "toarray"):  # continued training on sparse input

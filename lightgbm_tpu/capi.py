@@ -108,6 +108,10 @@ def _bind(lib: ctypes.CDLL) -> None:
     ]
     lib.LGBM_BoosterGetNumClasses.restype = c.c_int
     lib.LGBM_BoosterGetNumClasses.argtypes = [vp, c.POINTER(c.c_int)]
+    lib.LGBM_BoosterGetCurrentIteration.restype = c.c_int
+    lib.LGBM_BoosterGetCurrentIteration.argtypes = [vp, c.POINTER(c.c_int)]
+    lib.LGBM_BoosterGetEvalCounts.restype = c.c_int
+    lib.LGBM_BoosterGetEvalCounts.argtypes = [vp, c.POINTER(c.c_int)]
     lib.LGBM_BoosterSaveModel.restype = c.c_int
     lib.LGBM_BoosterSaveModel.argtypes = [vp, c.c_int, c.c_int, c.c_char_p]
     lib.LGBM_BoosterPredictForMat.restype = c.c_int

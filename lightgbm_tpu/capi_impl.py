@@ -254,6 +254,18 @@ def booster_get_num_classes(bid: int) -> int:
     return _boosters[bid].booster.num_model_per_iteration()
 
 
+def booster_get_current_iteration(bid: int) -> int:
+    # c_api.h:470 LGBM_BoosterGetCurrentIteration
+    return _boosters[bid].booster.current_iteration
+
+
+def booster_get_eval_counts(bid: int) -> int:
+    # c_api.h:528 LGBM_BoosterGetEvalCounts: number of metric values one
+    # booster_get_eval call writes (callers size their buffer with this)
+    bst = _boosters[bid].booster
+    return len(bst.eval_train())
+
+
 def booster_save_model(
     bid: int, start_iteration: int, num_iteration: int, filename: str
 ) -> None:

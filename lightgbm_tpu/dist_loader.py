@@ -160,57 +160,101 @@ def load_two_round(
     num_machines: int = 1,
     mapper_exchange: Optional[Callable] = None,
     chunk_rows: int = 65536,
+    feature_names: Optional[List[str]] = None,
+    categorical_feature=None,
 ) -> Tuple[BinnedDataset, np.ndarray]:
     """Stream-load ``path`` into a BinnedDataset; returns (binned, row_idx).
 
     ``row_idx`` holds the kept rows' global indices (identity for
     ``num_machines == 1``) so callers can subset per-row sidecar files.
+    ``feature_names``/``categorical_feature`` override the file header and
+    ``config.categorical_feature`` (the Dataset(...) constructor arguments,
+    same precedence as the in-memory path).
     """
     if num_machines > 1:
+        if mapper_exchange is None:
+            # Each rank only sees its own row shard; fitting BinMappers from
+            # local samples would give every rank different bin boundaries and
+            # cross-rank histogram psums would sum incompatible bins. The
+            # reference always syncs mappers over the network
+            # (dataset_loader.cpp:877-944); demand the same here.
+            log.fatal(
+                "load_two_round with num_machines > 1 requires a "
+                "mapper_exchange (e.g. jax_mapper_exchange) so all ranks bin "
+                "identically"
+            )
         row_filter = lambda i: i % num_machines == rank  # noqa: E731
     else:
         row_filter = None
 
-    # ---- pass 1: row count + bin-construction sample -------------------
-    sample_cap = int(config.bin_construct_sample_cnt)
-    sample_chunks: List[np.ndarray] = []
+    # header names (label column dropped) when the caller didn't pass any —
+    # same derivation as io.load_text_file's delimited path
+    if feature_names is None:
+        fmt_, sep_, use_hdr_, header_ = _file_meta(path, config.header)
+        if header_ is not None:
+            lidx = _resolve_label(config.label_column, header_)
+            feature_names = [h for i, h in enumerate(header_) if i != lidx]
+
+    # ---- pass 1: row count + reservoir bin-construction sample ----------
+    # Algorithm R over the row stream: memory stays at sample_cap rows and
+    # every row is kept with equal probability — a head-sorted file does not
+    # bias the bin boundaries (the uniform-sample contract of the in-memory
+    # path's _sample_rows and the reference's SampleTextData).
+    sample_cap = max(1, int(config.bin_construct_sample_cnt))
     label_chunks: List[np.ndarray] = []
+    reservoir: Optional[np.ndarray] = None
+    filled = 0
     n_local = 0
-    n_seen_for_sample = 0
     num_features = 0
     rng = np.random.RandomState(config.data_random_seed & 0x7FFFFFFF)
     for X, y, idx in iter_text_chunks(
         path, chunk_rows, config.header, config.label_column, row_filter
     ):
-        n_local += X.shape[0]
         num_features = max(num_features, X.shape[1])
         if y is not None:
             label_chunks.append(np.asarray(y, np.float64))
-        # stride-sample the chunk so the pass-1 memory stays ~sample_cap rows
-        n_seen_for_sample += X.shape[0]
-        keep = min(
-            X.shape[0],
-            max(1, int(round(sample_cap * X.shape[0] / max(n_seen_for_sample, 1)))),
-        )
-        if keep >= X.shape[0]:
-            sample_chunks.append(X)
-        else:
-            sample_chunks.append(X[rng.choice(X.shape[0], keep, replace=False)])
+        # width alignment (libsvm rows can widen the matrix mid-stream;
+        # absent trailing columns are zeros, matching pass 2's padding)
+        if reservoir is None:
+            reservoir = np.zeros((sample_cap, X.shape[1]))
+        if X.shape[1] > reservoir.shape[1]:
+            reservoir = np.pad(
+                reservoir, ((0, 0), (0, X.shape[1] - reservoir.shape[1]))
+            )
+        elif X.shape[1] < reservoir.shape[1]:
+            X = np.pad(X, ((0, 0), (0, reservoir.shape[1] - X.shape[1])))
+        k = X.shape[0]
+        take = min(sample_cap - filled, k)
+        if take > 0:
+            reservoir[filled : filled + take] = X[:take]
+            filled += take
+        if take < k:
+            rest = X[take:]
+            # 1-based stream position of each remaining row
+            t = n_local + take + np.arange(1, rest.shape[0] + 1)
+            accept = rng.random_sample(rest.shape[0]) < sample_cap / t
+            n_acc = int(accept.sum())
+            if n_acc:
+                slots = rng.randint(0, sample_cap, size=n_acc)
+                # duplicate slots resolve in row order (last wins), matching
+                # the sequential algorithm
+                reservoir[slots] = rest[accept]
+        n_local += k
     if n_local == 0:
         log.fatal("Data file %s has no rows for rank %d" % (path, rank))
-    sample = np.vstack([c if c.shape[1] == num_features else
-                        np.pad(c, ((0, 0), (0, num_features - c.shape[1])))
-                        for c in sample_chunks])
-    del sample_chunks
-    if sample.shape[0] > sample_cap:
-        sample = sample[rng.choice(sample.shape[0], sample_cap, replace=False)]
+    sample = reservoir[:filled]
+    if sample.shape[1] < num_features:
+        sample = np.pad(sample, ((0, 0), (0, num_features - sample.shape[1])))
 
     # ---- distributed binning: own a contiguous feature slice ------------
-    # Only a real cross-rank exchange justifies splitting the binning work;
-    # without one every rank bins every feature from its local sample (still
-    # correct, just duplicated work — the standalone-shard fallback).
-    cat_idx = _parse_categorical(config.categorical_feature, num_features, None)
-    if num_machines > 1 and mapper_exchange is not None:
+    cat_idx = _parse_categorical(
+        categorical_feature
+        if categorical_feature is not None
+        else config.categorical_feature,
+        num_features,
+        feature_names,
+    )
+    if num_machines > 1:
         per = (num_features + num_machines - 1) // num_machines
         lo, hi = rank * per, min(num_features, (rank + 1) * per)
     else:
@@ -274,8 +318,15 @@ def load_two_round(
 
     metadata = Metadata(n_local, label=labels)
     mono = list(config.monotone_constraints) if config.monotone_constraints else []
+    if feature_names is not None and len(feature_names) != num_features:
+        log.warning(
+            "Ignoring %d feature names for %d features"
+            % (len(feature_names), num_features)
+        )
+        feature_names = None
     binned = BinnedDataset(
-        bins, mappers, used, num_features, metadata, monotone_constraints=mono
+        bins, mappers, used, num_features, metadata,
+        feature_names=feature_names, monotone_constraints=mono,
     )
     return binned, row_idx
 

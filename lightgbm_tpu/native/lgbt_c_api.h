@@ -97,6 +97,13 @@ int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished);
 int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx, int* out_len,
                         double* out_results);
 int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len);
+
+/* Completed boosting iterations (c_api.h:470). */
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out);
+
+/* Number of metric values one LGBM_BoosterGetEval call writes — size the
+ * out_results buffer with this (c_api.h:528). */
+int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len);
 int LGBM_BoosterSaveModel(BoosterHandle handle, int start_iteration,
                           int num_iteration, const char* filename);
 

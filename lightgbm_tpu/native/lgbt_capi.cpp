@@ -313,6 +313,24 @@ LGBT_EXPORT int LGBM_BoosterGetNumClasses(void* handle, int* out_len) {
   return 0;
 }
 
+LGBT_EXPORT int LGBM_BoosterGetCurrentIteration(void* handle, int* out) {
+  Gil gil;
+  PyObject* r = call_impl("booster_get_current_iteration", "(L)", as_id(handle));
+  if (r == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterGetEvalCounts(void* handle, int* out_len) {
+  Gil gil;
+  PyObject* r = call_impl("booster_get_eval_counts", "(L)", as_id(handle));
+  if (r == nullptr) return -1;
+  *out_len = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
 LGBT_EXPORT int LGBM_BoosterSaveModel(void* handle, int start_iteration,
                                       int num_iteration,
                                       const char* filename) {

@@ -192,6 +192,14 @@ def test_booster_lifecycle(tmp_path):
     _check(LIB.LGBM_BoosterGetNumClasses(booster, ctypes.byref(nclass)))
     assert nclass.value == 1
 
+    cur_iter = ctypes.c_int(0)
+    _check(LIB.LGBM_BoosterGetCurrentIteration(booster, ctypes.byref(cur_iter)))
+    assert cur_iter.value == 10
+
+    eval_counts = ctypes.c_int(0)
+    _check(LIB.LGBM_BoosterGetEvalCounts(booster, ctypes.byref(eval_counts)))
+    assert eval_counts.value == out_len.value == 1
+
     model_path = str(tmp_path / "model.txt")
     _check(LIB.LGBM_BoosterSaveModel(booster, 0, -1, c_str(model_path)))
     _check(LIB.LGBM_BoosterFree(booster))

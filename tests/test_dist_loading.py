@@ -112,6 +112,103 @@ class TestTwoRound:
         assert binned.num_data == 5000
         assert binned.max_num_bin <= 15
 
+    def test_reservoir_sample_is_not_head_biased(self, tmp_path):
+        """A value-sorted file must produce bin boundaries spanning the whole
+        range, not just the file's head (Algorithm R uniformity; the old
+        per-chunk stride sampler over-weighted early chunks)."""
+        path = str(tmp_path / "sorted.csv")
+        n = 20000
+        vals = np.linspace(0.0, 100.0, n)  # ascending: head is all-small
+        with open(path, "w") as fh:
+            for i in range(n):
+                fh.write("%d,%.6f\n" % (i % 2, vals[i]))
+        cfg = Config.from_params(
+            {"max_bin": 32, "bin_construct_sample_cnt": 1000, "objective": "binary"}
+        )
+        binned, _ = load_two_round(path, cfg, chunk_rows=1000)
+        uppers = np.asarray(binned.mappers[0].bin_upper_bound, float)
+        finite = uppers[np.isfinite(uppers)]
+        # with a uniform sample the top bin boundary sits near the global max;
+        # a head-biased sample would cap out near the first chunks' values
+        assert finite.max() > 80.0, finite
+        assert finite.min() < 20.0, finite
+
+    def test_categorical_and_names_flow_through(self, tmp_path):
+        """Dataset(categorical_feature=..., header names) reach the two-round
+        loader: same bin types and names as the in-memory path."""
+        path = str(tmp_path / "h.csv")
+        rng = np.random.RandomState(3)
+        with open(path, "w") as fh:
+            fh.write("target,fnum,fcat\n")
+            for i in range(800):
+                fh.write(
+                    "%d,%.4f,%d\n"
+                    % (rng.randint(2), rng.randn(), rng.randint(5))
+                )
+        for spec in ([1], "name:fcat"):
+            one = lgb.Dataset(path, categorical_feature=spec).construct()._binned
+            two = lgb.Dataset(
+                path, categorical_feature=spec, params={"two_round": True}
+            ).construct()._binned
+            assert [m.bin_type for m in one.mappers] == [
+                m.bin_type for m in two.mappers
+            ]
+            assert two.mappers[1].bin_type == 1  # BIN_CATEGORICAL
+            assert two.feature_names == one.feature_names == ["fnum", "fcat"]
+
+    def test_init_model_continues_under_two_round(self, tmp_path):
+        """Continued training with two_round computes predictor init scores
+        (streamed) exactly like the in-memory path."""
+        path = str(tmp_path / "d.tsv")
+        _write_tsv(path, n=1200)
+        params = {
+            "objective": "binary", "num_leaves": 7, "verbosity": -1,
+            "max_bin": 31, "min_data_in_leaf": 10,
+        }
+        base = lgb.train(params, lgb.Dataset(path), num_boost_round=3)
+        cont_mem = lgb.train(
+            params, lgb.Dataset(path), num_boost_round=2, init_model=base
+        )
+        cont_2r = lgb.train(
+            params, lgb.Dataset(path, params={"two_round": True}),
+            num_boost_round=2, init_model=base,
+        )
+        assert cont_mem.model_to_string() == cont_2r.model_to_string()
+
+
+def _run_world(path, cfg, world, chunk_rows=300):
+    """Run every rank through load_two_round with an in-process allgather.
+
+    Two phases like a real collective: a publish pass so every rank's owned
+    mapper slice lands in the shared dict, then the real pass where each
+    rank's exchange returns the complete merged set.
+    """
+    published = {}
+
+    def make_exchange(rank):
+        def exchange(owned):
+            published[rank] = owned
+            merged = []
+            for r in sorted(published):
+                merged.extend(published[r])
+            return merged
+
+        return exchange
+
+    for rank in range(world):
+        try:
+            load_two_round(path, cfg, rank=rank, num_machines=world,
+                           mapper_exchange=make_exchange(rank),
+                           chunk_rows=chunk_rows)
+        except Exception:
+            pass  # early ranks see an incomplete exchange; publication is what matters
+    return [
+        load_two_round(path, cfg, rank=rank, num_machines=world,
+                       mapper_exchange=make_exchange(rank),
+                       chunk_rows=chunk_rows)
+        for rank in range(world)
+    ]
+
 
 class TestDistributed:
     def test_rank_shards_partition_the_rows(self, tmp_path):
@@ -120,15 +217,24 @@ class TestDistributed:
         cfg = Config.from_params({"max_bin": 31, "objective": "binary"})
         world = 4
         seen = []
-        for rank in range(world):
-            binned, row_idx = load_two_round(
-                path, cfg, rank=rank, num_machines=world, chunk_rows=300
-            )
+        for rank, (binned, row_idx) in enumerate(_run_world(path, cfg, world)):
             assert np.all(row_idx % world == rank)
             assert binned.num_data == row_idx.size
             seen.append(row_idx)
         allrows = np.sort(np.concatenate(seen))
         np.testing.assert_array_equal(allrows, np.arange(len(y)))
+
+    def test_multi_machine_requires_exchange(self, tmp_path):
+        """Without a mapper exchange each rank would fit different bin
+        boundaries from its local sample — refuse instead of silently
+        producing incompatible histograms across ranks."""
+        import pytest
+
+        path = str(tmp_path / "d.tsv")
+        _write_tsv(path, n=500)
+        cfg = Config.from_params({"max_bin": 31, "objective": "binary"})
+        with pytest.raises(Exception, match="mapper_exchange"):
+            load_two_round(path, cfg, rank=0, num_machines=2)
 
     def test_mapper_exchange_makes_ranks_agree(self, tmp_path):
         """Simulated allgather: every rank publishes its owned feature slice,
@@ -137,38 +243,7 @@ class TestDistributed:
         path = str(tmp_path / "d.tsv")
         _write_tsv(path)
         cfg = Config.from_params({"max_bin": 31, "objective": "binary"})
-        world = 3
-
-        published = {}
-
-        def make_exchange(rank):
-            def exchange(owned):
-                published[rank] = owned
-                # in-process "allgather": every rank sees every publication
-                merged = []
-                for r in sorted(published):
-                    merged.extend(published[r])
-                return merged
-
-            return exchange
-
-        # phase order mirrors a real allgather: all ranks publish first
-        from lightgbm_tpu.dist_loader import load_two_round as _load
-
-        # pre-publish every rank's owned mappers by running pass 1 logic via
-        # a first full call per rank (cheap at this size), then reload with
-        # the complete exchange
-        for rank in range(world):
-            try:
-                _load(path, cfg, rank=rank, num_machines=world,
-                      mapper_exchange=make_exchange(rank), chunk_rows=400)
-            except Exception:
-                pass  # early ranks see an incomplete exchange; publication is what matters
-        results = [
-            _load(path, cfg, rank=rank, num_machines=world,
-                  mapper_exchange=make_exchange(rank), chunk_rows=400)
-            for rank in range(world)
-        ]
+        results = _run_world(path, cfg, world=3, chunk_rows=400)
         _same_mappers(results[0][0].mappers, results[1][0].mappers)
         _same_mappers(results[1][0].mappers, results[2][0].mappers)
 
@@ -187,7 +262,7 @@ class TestDistributed:
             "max_bin": 31, "min_data_in_leaf": 10,
         }
         cfg = Config.from_params(cfg_params)
-        binned, row_idx = load_two_round(path, cfg, rank=2, num_machines=4)
+        binned, row_idx = _run_world(path, cfg, world=4)[2]
         ds = lgb.Dataset(np.zeros((1, 1)))  # shell; inject the binned shard
         ds._binned = binned
         ds._config = cfg
