@@ -29,11 +29,19 @@ lgb.train <- function(params = list(), data, nrounds = 100L, valids = list(),
     }
   }
 
-  # orientation of the first configured metric: the ABI reports raw metric
+  # orientation of the first effective metric: the ABI reports raw metric
   # values, so maximize-metrics flip sign for the improvement test (same
-  # fixed higher-better set the reference R callbacks use)
-  maximize_metrics <- c("auc", "ndcg", "map", "average_precision")
-  first_metric <- unlist(params$metric)[1L]
+  # fixed higher-better set the reference R callbacks use). The backend
+  # defaults the metric from the objective when none is set, and accepts
+  # comma-joined lists — resolve both before the lookup.
+  maximize_metrics <- c("auc", "ndcg", "map", "average_precision",
+                        "mean_average_precision", "lambdarank", "rank_xendcg")
+  metric_spec <- unlist(params$metric)
+  if (is.null(metric_spec) || !nzchar(metric_spec[1L])) {
+    metric_spec <- unlist(params$objective)
+  }
+  first_metric <- if (is.null(metric_spec)) NULL else
+    strsplit(as.character(metric_spec[1L]), ",", fixed = TRUE)[[1L]][1L]
   sign_flip <- if (!is.null(first_metric) &&
                    first_metric %in% maximize_metrics) -1.0 else 1.0
 

@@ -210,6 +210,19 @@ def _orchestrate() -> None:
 def _run() -> None:
     platform = os.environ.get("BENCH_WORKER_PLATFORM", "unknown")
     platforms = os.environ.get("BENCH_FORCE_PLATFORMS")
+    n_shards = 1
+    if platform not in ("tpu", "axon"):
+        # CPU fallback parallelism: split rows over virtual CPU devices and
+        # run the data-parallel tree learner (tree-for-tree equal to serial,
+        # tests/test_parallel.py). XLA's CPU scatter is single-threaded per
+        # shard, so the mesh is what buys multi-core throughput here. Must be
+        # set before the backend initializes.
+        n_shards = min(8, os.cpu_count() or 1)
+        if n_shards > 1:
+            flags = os.environ.get("XLA_FLAGS", "")
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=%d" % n_shards
+            ).strip()
     if platforms is not None:
         # apply in-process: the env var alone is overridden by sitecustomize's
         # jax.config.update pin (see _PROBE_SRC note). Also sync the env var —
@@ -226,6 +239,19 @@ def _run() -> None:
         jax.config.update("jax_platforms", platforms or None)
 
     import jax
+
+    # persistent compilation cache: the grow_tree program is large (the
+    # bucket lax.switch compiles one histogram+partition subprogram per
+    # power-of-2 segment size), so re-runs of the bench skip the multi-minute
+    # XLA compile entirely
+    try:
+        cache_dir = os.environ.get(
+            "BENCH_JAX_CACHE", os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    except Exception as e:  # cache is an optimization, never a blocker
+        print("bench: compilation cache unavailable: %s" % e, file=sys.stderr)
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu.metric import AUCMetric
@@ -253,6 +279,8 @@ def _run() -> None:
         "metric": "auc",
         "verbosity": -1,
     }
+    if n_shards > 1 and len(jax.devices()) >= n_shards:
+        params["tree_learner"] = "data"
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     booster = lgb.Booster(params=params, train_set=ds)

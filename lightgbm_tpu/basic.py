@@ -22,6 +22,7 @@ from .models import gbdt as gbdt_mod
 from .models.model_text import dump_model_to_json, load_model_from_string, save_model_to_string
 from .objective import create_objective, objective_from_model_string
 from .utils import log
+from .utils.vfile import vopen
 from .utils.log import LightGBMError
 
 
@@ -469,7 +470,7 @@ class Booster:
             self._train_dataset = train_set
             self.pandas_categorical = train_set.pandas_categorical
         elif model_file is not None:
-            with open(model_file) as fh:
+            with vopen(model_file) as fh:
                 self._load(fh.read(), params)
         elif model_str is not None:
             self._load(model_str, params)
@@ -616,7 +617,7 @@ class Booster:
     # -- model IO --------------------------------------------------------
 
     def save_model(self, filename: str, num_iteration: int = -1, start_iteration: int = 0) -> "Booster":
-        with open(filename, "w") as fh:
+        with vopen(filename, "w") as fh:
             fh.write(self.model_to_string(num_iteration, start_iteration))
         return self
 

@@ -11,6 +11,7 @@ Handles are small positive integers (0 is the NULL handle); the C side passes
 them around as opaque void*.
 """
 from __future__ import annotations
+from .utils.vfile import vopen
 
 import ctypes
 import itertools
@@ -261,9 +262,14 @@ def booster_get_current_iteration(bid: int) -> int:
 
 def booster_get_eval_counts(bid: int) -> int:
     # c_api.h:528 LGBM_BoosterGetEvalCounts: number of metric values one
-    # booster_get_eval call writes (callers size their buffer with this)
-    bst = _boosters[bid].booster
-    return len(bst.eval_train())
+    # booster_get_eval call writes (callers size their buffer with this).
+    # The count is fixed at booster construction, so evaluate once and cache —
+    # callers (the R bridge) ask on every GetEval and a fresh eval_train()
+    # here would add an O(num_data) pass per round.
+    cb = _boosters[bid]
+    if getattr(cb, "eval_count", None) is None:
+        cb.eval_count = len(cb.booster.eval_train())
+    return cb.eval_count
 
 
 def booster_save_model(
@@ -318,6 +324,6 @@ def booster_predict_for_file(
     out = np.atleast_2d(np.asarray(out, np.float64))
     if out.shape[0] == 1 and out.size > 1:
         out = out.T
-    with open(result_filename, "w") as fh:
+    with vopen(result_filename, "w") as fh:
         for row in out:
             fh.write("\t".join(repr(float(v)) for v in np.atleast_1d(row)) + "\n")

@@ -22,6 +22,7 @@ from .config import Config, load_config_file
 from .engine import train as train_api
 from .io import load_sidecar, load_text_file
 from .utils import log
+from .utils.vfile import vopen
 from .utils.log import LightGBMError
 
 
@@ -139,7 +140,7 @@ def run_predict(config: Config, params: Dict[str, str]) -> None:
         pred_early_stop_margin=config.pred_early_stop_margin,
     )
     out = np.asarray(preds)
-    with open(config.output_result, "w") as fh:
+    with vopen(config.output_result, "w") as fh:
         if out.ndim == 1:
             for v in out:
                 fh.write("%.18g\n" % v)
@@ -158,7 +159,7 @@ def run_convert_model(config: Config, params: Dict[str, str]) -> None:
 
     booster = Booster(model_file=config.input_model)
     code = save_model_to_ifelse(booster._gbdt, num_iteration=-1)
-    with open(config.convert_model, "w") as fh:
+    with vopen(config.convert_model, "w") as fh:
         fh.write(code)
     log.info("Finished converting model; source saved to %s" % config.convert_model)
 

@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from .utils import log
+from .utils.vfile import vopen
 
 # Alias -> canonical name. Mirrors config_auto.cpp's alias_table.
 PARAM_ALIASES: Dict[str, str] = {
@@ -345,6 +346,11 @@ class Config:
     tpu_hist_chunk: int = 16384
     # TPU-only: use float64 histogram accumulation on host-check paths.
     tpu_use_dp: bool = False
+    # TPU-only: MXU operand dtype for the Pallas histogram kernel —
+    # "float32" (exact, 3-pass MXU) or "bfloat16" (single pass, ~3x faster;
+    # grad/hess operands round to bf16, accumulation stays f32 — the
+    # reference GPU path's single-precision trade, GPU-Performance.rst:131).
+    tpu_hist_dtype: str = "float32"
 
     # resolved, not user-set
     is_parallel: bool = False
@@ -487,7 +493,7 @@ def _coerce(f: dataclasses.Field, v: Any):
 def load_config_file(path: str) -> Dict[str, str]:
     """Parse a LightGBM .conf file (``key = value`` lines, # comments)."""
     out: Dict[str, str] = {}
-    with open(path) as fh:
+    with vopen(path) as fh:
         for line in fh:
             line = line.split("#", 1)[0].strip()
             if not line or "=" not in line:

@@ -37,6 +37,7 @@ from .binning import (
 )
 from .config import Config
 from .utils import log
+from .utils.vfile import vopen
 
 
 class Metadata:
@@ -240,7 +241,7 @@ def save_binary_dataset(binned: BinnedDataset, path: str) -> None:
     arrays["meta_json"] = np.frombuffer(
         _json.dumps(meta).encode("utf-8"), dtype=np.uint8
     )
-    with open(path, "wb") as fh:
+    with vopen(path, "wb") as fh:
         np.savez_compressed(fh, **arrays)
 
 
@@ -248,10 +249,10 @@ def is_binary_dataset_file(path: str) -> bool:
     """True when ``path`` is a dataset written by save_binary (zip magic +
     our meta record) — the LoadFromBinFile sniff (dataset_loader.cpp:268)."""
     try:
-        with open(path, "rb") as fh:
+        with vopen(path, "rb") as fh:
             if fh.read(2) != b"PK":
                 return False
-        with np.load(path, allow_pickle=False) as z:
+        with vopen(path, "rb") as fh, np.load(fh, allow_pickle=False) as z:
             return "meta_json" in z.files
     except Exception:
         return False
@@ -261,7 +262,7 @@ def load_binary_dataset(path: str) -> BinnedDataset:
     """Reload a save_binary dataset (DatasetLoader::LoadFromBinFile)."""
     import json as _json
 
-    with np.load(path, allow_pickle=False) as z:
+    with vopen(path, "rb") as fh, np.load(fh, allow_pickle=False) as z:
         meta = _json.loads(bytes(z["meta_json"].tobytes()).decode("utf-8"))
         if meta.get("magic") != BINARY_MAGIC:
             log.fatal("File %s is not a lightgbm_tpu binary dataset" % path)

@@ -35,6 +35,7 @@ from .dataset import (
 )
 from .io import _MISSING_TOKENS, _is_number, _parse_delimited, _parse_libsvm, _resolve_label, _sniff_format, load_sidecar
 from .utils import log
+from .utils.vfile import vopen
 
 
 # ---------------------------------------------------------------------------
@@ -44,7 +45,7 @@ from .utils import log
 def _file_meta(path: str, has_header: bool):
     """Sniff format/separator/header from the head of the file."""
     head: List[str] = []
-    with open(path) as fh:
+    with vopen(path) as fh:
         for ln in fh:
             ln = ln.rstrip("\r\n")
             if ln.strip():
@@ -93,7 +94,7 @@ def iter_text_chunks(
     buf: List[str] = []
     kept: List[int] = []
     row = 0
-    with open(path) as fh:
+    with vopen(path) as fh:
         first = use_header
         for ln in fh:
             if first:
@@ -216,7 +217,10 @@ def load_two_round(
         # width alignment (libsvm rows can widen the matrix mid-stream;
         # absent trailing columns are zeros, matching pass 2's padding)
         if reservoir is None:
-            reservoir = np.zeros((sample_cap, X.shape[1]))
+            # grow geometrically toward sample_cap instead of preallocating
+            # cap rows up front — a short wide file (rows << cap) would
+            # otherwise allocate cap * F floats for nothing
+            reservoir = np.zeros((min(sample_cap, max(X.shape[0], 256)), X.shape[1]))
         if X.shape[1] > reservoir.shape[1]:
             reservoir = np.pad(
                 reservoir, ((0, 0), (0, X.shape[1] - reservoir.shape[1]))
@@ -224,6 +228,9 @@ def load_two_round(
         elif X.shape[1] < reservoir.shape[1]:
             X = np.pad(X, ((0, 0), (0, reservoir.shape[1] - X.shape[1])))
         k = X.shape[0]
+        if filled + k > reservoir.shape[0] and reservoir.shape[0] < sample_cap:
+            new_rows = min(sample_cap, max(2 * reservoir.shape[0], filled + k))
+            reservoir = np.pad(reservoir, ((0, new_rows - reservoir.shape[0]), (0, 0)))
         take = min(sample_cap - filled, k)
         if take > 0:
             reservoir[filled : filled + take] = X[:take]

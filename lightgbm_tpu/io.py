@@ -14,6 +14,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from .utils import log
+from .utils.vfile import is_remote, vexists, vopen
 
 
 def _sniff_format(lines: List[str]) -> str:
@@ -50,7 +51,7 @@ def load_text_file(
     detected by comparing the file's column count against the model — the
     reference Predictor's behavior for label-less prediction files.
     """
-    with open(path) as fh:
+    with vopen(path) as fh:
         raw_lines = [ln.rstrip("\r\n") for ln in fh if ln.strip()]
     if not raw_lines:
         log.fatal("Data file %s is empty" % path)
@@ -88,7 +89,7 @@ def load_text_file(
         has_label = bool(raw_lines) and ":" not in raw_lines[0].split()[0]
         from . import native
 
-        res = native.parse_libsvm(
+        res = None if is_remote(path) else native.parse_libsvm(
             path, use_header, has_label, model_num_features or 0
         )
         if res is not None:
@@ -96,7 +97,7 @@ def load_text_file(
         return _parse_libsvm(raw_lines, model_num_features) + (None,)
     from . import native
 
-    res = native.parse_delimited(path, use_header, sep, label_idx)
+    res = None if is_remote(path) else native.parse_delimited(path, use_header, sep, label_idx)
     if res is not None:
         X, y = res
         names = None
@@ -169,10 +170,10 @@ def _parse_libsvm(lines, model_num_features=None):
 def load_sidecar(path: str, kind: str) -> Optional[np.ndarray]:
     """<data>.weight / <data>.query / <data>.init sidecar files (metadata.cpp)."""
     side = path + "." + kind
-    if not os.path.exists(side):
+    if not vexists(side):
         return None
     vals = []
-    with open(side) as fh:
+    with vopen(side) as fh:
         for ln in fh:
             ln = ln.strip()
             if ln:

@@ -31,6 +31,7 @@ from ..ops.grow import grow_tree
 from ..ops.predict import PredictTree, make_predict_tree, tree_predict_value
 from ..ops.split import CegbParams, SplitParams
 from ..utils import log
+from ..utils.vfile import vopen
 from .tree import Tree
 
 K_EPSILON = 1e-15
@@ -195,7 +196,7 @@ class GBDT:
             return ()
         import json as _json
 
-        with open(fname) as fh:
+        with vopen(fname) as fh:
             root = _json.load(fh)
         if not root:
             return ()
@@ -414,6 +415,7 @@ class GBDT:
             num_group_bins=self.num_group_bins,
             params=self.split_params,
             chunk=cfg.tpu_hist_chunk,
+            hist_dtype=cfg.tpu_hist_dtype,
         )
         cegb_on = self.cegb_params.enabled
         if learner == "serial":

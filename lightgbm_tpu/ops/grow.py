@@ -123,7 +123,7 @@ MIN_BUCKET_LOG2 = 10  # smallest gathered-segment bucket (1024 rows)
     static_argnames=(
         "num_leaves", "max_depth", "num_bins", "params", "num_group_bins",
         "chunk", "axis_name", "split_fn", "psum_hist", "forced_splits", "cegb",
-        "hist_mode",
+        "hist_mode", "hist_dtype",
     ),
 )
 def grow_tree(
@@ -146,6 +146,7 @@ def grow_tree(
     cegb: CegbParams = CegbParams(),
     cegb_state: Optional[Tuple[jax.Array, jax.Array]] = None,
     hist_mode: str = "bucketed",
+    hist_dtype: str = "float32",
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [N]).
 
@@ -282,14 +283,23 @@ def grow_tree(
                 else:
                     colv = bins[f, seg].astype(jnp.int32)
                 gl = _decision_go_left(colv, threshold, default_left, miss, dbin, nanb, iscat, member)
-                # stable 4-class sort keeps out-of-segment rows in place:
-                # [pre-segment | left | right | post-segment]
-                klass = jnp.where(
-                    pos < off, 0, jnp.where(valid & gl, 1, jnp.where(valid, 2, 3))
+                # stable partition via prefix sums — O(S) scatter instead of
+                # an O(S log S) stable sort. Bucket layout afterwards:
+                # [pre-segment | left | right | post-segment]; out-of-segment
+                # rows keep their positions, in-segment rows land at
+                # off + rank-within-class (lefts first).
+                is_left = valid & gl
+                is_right = valid & ~gl
+                left_rank = jnp.cumsum(is_left.astype(jnp.int32)) - 1
+                right_rank = jnp.cumsum(is_right.astype(jnp.int32)) - 1
+                left_cnt = left_rank[-1] + 1
+                target = jnp.where(
+                    is_left,
+                    off + left_rank,
+                    jnp.where(is_right, off + left_cnt + right_rank, pos),
                 )
-                perm = jnp.argsort(klass, stable=True)
-                order2 = jax.lax.dynamic_update_slice(order, seg[perm], (start,))
-                left_cnt = jnp.sum((klass == 1).astype(jnp.int32))
+                out = jnp.zeros_like(seg).at[target].set(seg, unique_indices=True)
+                order2 = jax.lax.dynamic_update_slice(order, out, (start,))
                 return order2, left_cnt
 
             return branch
@@ -315,7 +325,7 @@ def grow_tree(
                 h_seg = jnp.take(hess, seg)
                 bag_seg = jnp.take(bag_mask, seg) * valid.astype(f32)
                 vals = leaf_values(g_seg, h_seg, bag_seg)
-                return leaf_histogram(b_seg, vals, B_hist, chunk=chunk)
+                return leaf_histogram(b_seg, vals, B_hist, chunk=chunk, hist_dtype=hist_dtype)
 
             return branch
 
@@ -386,7 +396,7 @@ def grow_tree(
 
     # ---- root ----------------------------------------------------------
     root_vals = masked_values(jnp.ones((N,), f32))
-    root_hist = leaf_histogram(bins, root_vals, B_hist, chunk=chunk, axis_name=hist_axis)
+    root_hist = leaf_histogram(bins, root_vals, B_hist, chunk=chunk, axis_name=hist_axis, hist_dtype=hist_dtype)
     # Root totals from the histogram of feature 0 would miss rows in padded bins;
     # sum the mask directly instead (psum'd under shard_map like GBDT's root sync,
     # serial_tree_learner.cpp:271 BeforeTrain).
@@ -651,7 +661,7 @@ def grow_tree(
         else:
             small_mask = (leaf_id == small_idx).astype(f32)
             small_hist = leaf_histogram(
-                bins, masked_values(small_mask), B_hist, chunk=chunk, axis_name=hist_axis
+                bins, masked_values(small_mask), B_hist, chunk=chunk, axis_name=hist_axis, hist_dtype=hist_dtype
             )
         if bundled:
             small_hist = remap_hist(

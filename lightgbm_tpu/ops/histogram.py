@@ -30,6 +30,13 @@ import numpy as np
 from . import hist_pallas
 
 
+def _default_backend() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
 def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:
     """Bound the transient one-hot tensor to ~64MB of f32."""
     budget = 64 * 1024 * 1024 // 4
@@ -63,7 +70,9 @@ def leaf_histogram(
       axis_name: if set, psum the result over that mesh axis (the data-parallel
         ReduceScatter path of data_parallel_tree_learner.cpp:161 collapsed into
         one XLA collective).
-      impl: "auto" (pallas on TPU, XLA contraction elsewhere), "pallas", "xla".
+      impl: "auto" (pallas on TPU, chunked scatter-add on CPU, one-hot
+        contraction elsewhere), "pallas", "scatter", or "xla" (the one-hot
+        contraction — also the differential oracle for the other two).
       hist_dtype: MXU operand dtype for the pallas kernel — "float32" (exact,
         matches the XLA fallback) or "bfloat16" (rounds grad/hess operands;
         accumulation stays f32 — the reference GPU path's single-precision
@@ -76,6 +85,38 @@ def leaf_histogram(
         hist = hist_pallas.histogram_pallas(
             bins, values, num_bins, chunk=max(chunk, 512), dtype_name=hist_dtype
         )
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        return hist
+    if impl == "scatter" or (impl == "auto" and _default_backend() == "cpu"):
+        # CPU: a scatter-add is the dense_bin.hpp:71 loop XLA can actually run
+        # well — F*N adds instead of the one-hot contraction's 2*F*N*B flops
+        # (B× waste). TPU keeps the MXU paths: scatter lowers poorly there.
+        # Chunked over rows like the one-hot path so the [F, C, K] update
+        # transient stays within the same ~64MB budget at any N.
+        F, N = bins.shape
+        K = values.shape[1]
+        C = (64 * 1024 * 1024 // 4) // max(F * (K + 1), 1)
+        C = max(256, min((C // 256) * 256, N))
+        if N % C != 0:
+            pad = (-N) % C
+            bins = jnp.pad(bins, ((0, 0), (0, pad)))
+            values = jnp.pad(values, ((0, pad), (0, 0)))
+            N += pad
+        n_chunks = N // C
+        offs = (jnp.arange(F, dtype=jnp.int32) * num_bins)[:, None]
+        bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
+        vals_c = values.reshape(n_chunks, C, K)
+
+        def body(acc, inputs):
+            b, v = inputs  # [F, C], [C, K]
+            idx = (b.astype(jnp.int32) + offs).reshape(-1)
+            upd = jnp.broadcast_to(v[None], (F, C, K)).reshape(F * C, K)
+            return acc.at[idx].add(upd), None
+
+        init = jnp.zeros((F * num_bins, K), jnp.float32)
+        hist, _ = jax.lax.scan(body, init, (bins_c, vals_c))
+        hist = hist.reshape(F, num_bins, K)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
         return hist

@@ -56,6 +56,7 @@ def grow_tree_data_parallel(
     params: SplitParams,
     num_group_bins=None,
     chunk: int = 4096,
+    hist_dtype: str = "float32",
     forced_splits=(),
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
@@ -93,6 +94,7 @@ def grow_tree_data_parallel(
             num_group_bins=num_group_bins,
             params=params,
             chunk=chunk,
+            hist_dtype=hist_dtype,
             axis_name="data",
             forced_splits=forced_splits,
             cegb=cegb,

@@ -48,6 +48,7 @@ def grow_tree_feature_parallel(
     params: SplitParams,
     num_group_bins=None,
     chunk: int = 4096,
+    hist_dtype: str = "float32",
     forced_splits=(),
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
@@ -97,6 +98,7 @@ def grow_tree_feature_parallel(
         num_group_bins=num_group_bins,
         params=params,
         chunk=chunk,
+        hist_dtype=hist_dtype,
         forced_splits=forced_splits,
         cegb=cegb,
         cegb_state=cegb_state,

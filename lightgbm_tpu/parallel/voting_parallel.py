@@ -84,6 +84,7 @@ def grow_tree_voting_parallel(
     params: SplitParams,
     top_k: int = 20,
     chunk: int = 4096,
+    hist_dtype: str = "float32",
     forced_splits=(),
     num_group_bins=None,
 ):
@@ -106,6 +107,7 @@ def grow_tree_voting_parallel(
             num_bins=num_bins,
             params=params,
             chunk=chunk,
+            hist_dtype=hist_dtype,
             axis_name="data",
             split_fn=split_fn,
             psum_hist=False,  # histograms stay local; split_fn psums elected slice
