@@ -22,9 +22,10 @@ from .engine import CVBooster, cv, train
 from .utils.log import LightGBMError
 
 try:
-    from .plotting import create_tree_digraph, plot_importance, plot_metric, plot_tree
+    from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                           plot_split_value_histogram, plot_tree)
 
-    _PLOT = ["plot_importance", "plot_metric", "plot_tree", "create_tree_digraph"]
+    _PLOT = ["plot_importance", "plot_metric", "plot_split_value_histogram", "plot_tree", "create_tree_digraph"]
 except ImportError:  # pragma: no cover - matplotlib/graphviz not installed
     _PLOT = []
 

@@ -342,6 +342,192 @@ class Dataset:
     def get_init_score(self):
         return self.init_score
 
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """Generic field setter (basic.py:1114 Dataset.set_field /
+        LGBM_DatasetSetField name dispatch)."""
+        if field_name == "label":
+            return self.set_label(data)
+        if field_name == "weight":
+            return self.set_weight(data)
+        if field_name == "init_score":
+            return self.set_init_score(data)
+        if field_name in ("group", "query"):
+            return self.set_group(data)
+        raise LightGBMError("Unknown field name: %s" % field_name)
+
+    def get_field(self, field_name: str):
+        """Generic field getter (basic.py:1162 Dataset.get_field)."""
+        if field_name == "label":
+            return self.get_label()
+        if field_name == "weight":
+            return self.get_weight()
+        if field_name == "init_score":
+            return self.get_init_score()
+        if field_name in ("group", "query"):
+            return self.get_group()
+        raise LightGBMError("Unknown field name: %s" % field_name)
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Re-declare categorical columns (basic.py:1201); a no-op when
+        unchanged. After construction the binned matrix fixed each column's
+        bin type — with raw data retained the dataset re-bins on next
+        construct (the reference's set_categorical_feature path), without it
+        the change is impossible."""
+        if self.categorical_feature == categorical_feature:
+            return self
+        if self._binned is not None:
+            if self.data is None or isinstance(self.data, str):
+                raise LightGBMError(
+                    "Cannot set categorical feature after freed raw data, set "
+                    "free_raw_data=False when construct Dataset to avoid this."
+                )
+            # raw rows retained: drop the binned form and re-bin lazily
+            self._binned = None
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        """Set feature names (basic.py:1273); validates before mutating."""
+        if self._binned is not None and isinstance(feature_name, (list, tuple)):
+            if len(feature_name) != self._binned.num_total_features:
+                raise LightGBMError(
+                    "Length of feature_name(%d) and num_feature(%d) don't match"
+                    % (len(feature_name), self._binned.num_total_features)
+                )
+            self._binned.feature_names = list(feature_name)
+        self.feature_name = feature_name
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Re-point this dataset at another training set's binning
+        (basic.py:1247). After construction, retained raw data re-bins with
+        the new reference's mappers on next use; without raw data the change
+        is impossible."""
+        if self.reference is reference:
+            return self
+        if self._binned is not None:
+            if self.data is None or isinstance(self.data, str):
+                raise LightGBMError(
+                    "Cannot set reference after freed raw data, set "
+                    "free_raw_data=False when construct Dataset to avoid this."
+                )
+            self._binned = None
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100) -> set:
+        """The set of Datasets reachable through .reference links
+        (basic.py:1507)."""
+        head = self
+        ref_chain: set = set()
+        while len(ref_chain) < ref_limit:
+            if isinstance(head, Dataset):
+                ref_chain.add(head)
+                if head.reference is not None:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return ref_chain
+
+    def get_data(self):
+        """Raw data as passed in (post-subset slicing, basic.py:1437)."""
+        if self.reference is not None and self.used_indices is not None:
+            ref_data = self.reference.get_data()
+            if ref_data is None:
+                return None
+            return ref_data[np.asarray(self.used_indices)]
+        return self.data
+
+    def get_feature_penalty(self):
+        """Per-feature penalty array, or None when unset (basic.py:1401)."""
+        cfg = getattr(self, "_config", None) or Config.from_params(self.params)
+        if cfg.feature_contri:
+            return np.asarray(cfg.feature_contri, np.float64)
+        return None
+
+    def get_monotone_constraints(self):
+        """Per-feature monotone constraint array, or None (basic.py:1413)."""
+        if self._binned is not None and self._binned.monotone_constraints:
+            return np.asarray(self._binned.monotone_constraints, np.int32)
+        cfg = getattr(self, "_config", None) or Config.from_params(self.params)
+        if cfg.monotone_constraints:
+            return np.asarray(cfg.monotone_constraints, np.int32)
+        return None
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Column-concatenate another constructed Dataset into this one
+        (basic.py:1537 Dataset.add_features_from / Dataset::AddFeaturesFrom).
+
+        Both datasets must be constructed, un-bundled (EFB off), and have the
+        same row count; the other's binned columns, mappers, and names are
+        appended in place. The other dataset keeps ownership of its raw data.
+        """
+        if self._binned is None or other._binned is None:
+            raise LightGBMError("Both source and target Datasets must be constructed before adding features")
+        a, b = self._binned, other._binned
+        if a.num_data != b.num_data:
+            raise LightGBMError(
+                "Cannot add features from other Dataset with a different number of rows (%d vs %d)"
+                % (b.num_data, a.num_data)
+            )
+        if a.is_bundled or b.is_bundled:
+            raise LightGBMError(
+                "Cannot add features to/from an EFB-bundled Dataset (disable "
+                "enable_bundle to use add_features_from)"
+            )
+        if a.bins.dtype != b.bins.dtype:
+            wide = np.promote_types(a.bins.dtype, b.bins.dtype)
+            a.bins = a.bins.astype(wide)
+            b_bins = b.bins.astype(wide)
+        else:
+            b_bins = b.bins
+        off = a.num_total_features
+        a.bins = np.concatenate([a.bins, b_bins], axis=0)
+        a.mappers = list(a.mappers) + list(b.mappers)
+        a.used_feature_idx = list(a.used_feature_idx) + [
+            off + j for j in b.used_feature_idx
+        ]
+        a.num_total_features += b.num_total_features
+        # de-collide names the way the reference's Merge does (suffix)
+        seen = set(a.feature_names)
+        merged = []
+        for name in b.feature_names:
+            new = name
+            while new in seen:
+                new = new + "_1"
+            seen.add(new)
+            merged.append(new)
+        a.feature_names = list(a.feature_names) + merged
+        if a.monotone_constraints or b.monotone_constraints:
+            a.monotone_constraints = (
+                list(a.monotone_constraints or [0] * off)
+                + list(b.monotone_constraints or [0] * b.num_total_features)
+            )
+        return self
+
+    def dump_text(self, filename: str) -> "Dataset":
+        """Write the raw (unbinned) rows as text — debugging aid
+        (basic.py:1557 Dataset.dump_text)."""
+        self.construct()
+        data = self.get_data()
+        if data is None or isinstance(data, str):
+            # text-file datasets replace .data with the loaded matrix at
+            # construct(); a remaining string means a binary dataset file,
+            # which keeps no raw rows
+            raise LightGBMError(
+                "Cannot dump_text: the Dataset keeps no raw rows "
+                "(freed, or loaded from a binary dataset file)"
+            )
+        arr = _to_2d_float(data)
+        if hasattr(arr, "toarray"):
+            arr = arr.toarray()
+        with vopen(filename, "w") as fh:
+            for row in np.asarray(arr, np.float64):
+                fh.write(",".join("%.17g" % v for v in row) + "\n")
+        return self
+
     def save_binary(self, filename: str) -> "Dataset":
         """Save the constructed (binned) dataset for fast reload
         (Dataset.save_binary, basic.py:1517; LGBM_DatasetSaveBinary)."""
@@ -459,6 +645,9 @@ class Booster:
         self._valid_names: List[str] = []
         self._valid_datasets: List[Dataset] = []
         self.pandas_categorical = None
+        self._attrs: Dict[str, str] = {}
+        self._train_data_name = "training"
+        self._network_initialized = False
         if train_set is not None:
             self.config = Config.from_params(params)
             binned = train_set.get_binned(self.config)
@@ -517,7 +706,16 @@ class Booster:
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         binned = data.get_binned(self.config)
         metrics = self._make_metrics(self.config)
-        self._gbdt.add_valid(binned, metrics, name)
+        def raw_provider():
+            raw = data.get_data()
+            if isinstance(raw, str) or raw is None:
+                return None  # binary-file datasets keep no raw rows
+            from_pandas = _data_from_pandas(
+                raw, pandas_categorical=self.pandas_categorical or []
+            )
+            return from_pandas[0] if from_pandas is not None else _to_2d_float(raw)
+
+        self._gbdt.add_valid(binned, metrics, name, raw_data=raw_provider)
         self._valid_names.append(name)
         self._valid_datasets.append(data)
         return self
@@ -551,7 +749,10 @@ class Booster:
     # -- evaluation ------------------------------------------------------
 
     def eval_train(self, feval=None) -> List:
-        return self._eval_set(self._gbdt._train_score_np(), "training", self._gbdt.training_metrics, feval, self._train_dataset)
+        return self._eval_set(
+            self._gbdt._train_score_np(), self._train_data_name,
+            self._gbdt.training_metrics, feval, self._train_dataset,
+        )
 
     def eval_valid(self, feval=None) -> List:
         out = []
@@ -580,6 +781,107 @@ class Booster:
                     mname, val, bigger = ret
                     results.append((name, mname, val, bigger))
         return results
+
+    def eval(self, data: Dataset, name: str, feval=None) -> List:
+        """Evaluate on an arbitrary Dataset (basic.py Booster.eval): reuses
+        the valid-set slot when ``data`` was added with add_valid, else adds
+        it first like the reference does."""
+        if data is self._train_dataset:
+            return self.eval_train(feval)
+        for i, ds in enumerate(self._valid_datasets):
+            if ds is data:
+                return self._eval_set(
+                    self._gbdt._valid_score_np(i), name,
+                    self._gbdt.valid_metrics[i], feval, ds,
+                )
+        self.add_valid(data, name)
+        i = len(self._valid_datasets) - 1
+        return self._eval_set(
+            self._gbdt._valid_score_np(i), name, self._gbdt.valid_metrics[i],
+            feval, data,
+        )
+
+    # -- attributes / bookkeeping (basic.py Booster.attr/set_attr) -------
+
+    def attr(self, key: str):
+        """Free-form string attribute, or None when unset."""
+        return self._attrs.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set (string) or delete (None) free-form attributes."""
+        for key, value in kwargs.items():
+            if value is None:
+                self._attrs.pop(key, None)
+            elif isinstance(value, str):
+                self._attrs[key] = value
+            else:
+                raise LightGBMError("Only string values are accepted")
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        """Rename the training set in eval output (default 'training')."""
+        self._train_data_name = name
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """Drop the training/validation Dataset references (basic.py
+        Booster.free_dataset) — the trained model remains usable for
+        predict/save; further update() calls need a train set again."""
+        self._train_dataset = None
+        self._valid_datasets = []
+        return self
+
+    def free_network(self) -> "Booster":
+        """Reference parity no-op: collectives live inside the jitted
+        programs (psum over the mesh), there is no standing network to tear
+        down (network.h:89 Network::Dispose)."""
+        self._network_initialized = False
+        return self
+
+    def set_network(self, machines=None, local_listen_port: int = 12400,
+                    listen_time_out: int = 120, num_machines: int = 1) -> "Booster":
+        """Reference parity shim (basic.py Booster.set_network): multi-host
+        topology comes from the JAX distributed runtime (jax.distributed /
+        the mesh), not from a machine list; recorded for introspection."""
+        self._network_initialized = num_machines > 1
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0, end_iteration: int = -1) -> "Booster":
+        """Shuffle tree order in [start, end) (basic.py Booster.shuffle_models
+        / GBDT::ShuffleModels — used to decorrelate for continued training)."""
+        self._gbdt.shuffle_models(start_iteration, end_iteration)
+        return self
+
+    def model_from_string(self, model_str: str, verbose: bool = True) -> "Booster":
+        """Replace this booster's model with one parsed from a model string."""
+        self._load(model_str, self.params)
+        if verbose:
+            log.info(
+                "Finished loading model, total used %d iterations"
+                % self._gbdt.current_iteration
+            )
+        return self
+
+    def get_split_value_histogram(self, feature, bins=None) -> np.ndarray:
+        """Histogram of split thresholds used for ``feature`` across the model
+        (basic.py Booster.get_split_value_histogram).
+
+        ``feature``: index or name. Returns (counts, bin_edges) like
+        numpy.histogram; ``bins`` defaults to numpy's 'auto'.
+        """
+        if isinstance(feature, str):
+            names = self.feature_name()
+            if feature not in names:
+                raise LightGBMError("Unknown feature name: %s" % feature)
+            feature = names.index(feature)
+        values = []
+        for tree in self._gbdt.trees():
+            for node in range(max(tree.num_leaves - 1, 0)):
+                if int(tree.split_feature[node]) == feature and not tree._is_categorical(node):
+                    values.append(float(tree.threshold[node]))
+        if bins is None:
+            bins = "auto"
+        return np.histogram(np.asarray(values, np.float64), bins=bins)
 
     # -- prediction ------------------------------------------------------
 

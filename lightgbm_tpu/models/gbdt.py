@@ -230,7 +230,19 @@ class GBDT:
                 queue.append((node["right"], right_leaf))
         return tuple(out)
 
-    def add_valid(self, valid_set: BinnedDataset, metrics: List[Metric], name: str) -> None:
+    def add_valid(
+        self,
+        valid_set: BinnedDataset,
+        metrics: List[Metric],
+        name: str,
+        raw_data=None,
+    ) -> None:
+        """Attach an eval set; already-trained trees are replayed into its
+        score like the reference's ScoreUpdater constructor does
+        (score_updater.hpp: adds every existing model on AddValidDataset).
+        ``raw_data`` (the unbinned rows, or a zero-arg callable returning
+        them) is only consulted when the model holds host-only trees
+        (loaded/merged/refit) that cannot be replayed from bins."""
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
         self.valid_sets.append(valid_set)
@@ -246,11 +258,42 @@ class GBDT:
             else:
                 arr = arr.reshape(K, valid_set.num_data)
             score = jnp.asarray(arr, jnp.float32)
+        bins_t = jnp.asarray(valid_set.bins.T)
+        if self._device_trees:
+            # host-only non-trivial trees (device arrays dropped: loaded /
+            # merged / refit models) can't replay from bins — they need raw
+            host_needed = any(
+                ta is None
+                and self.models[mi] is not None
+                and self.models[mi].num_leaves > 1
+                for mi, (ta, _) in enumerate(self._device_trees)
+            )
+            if host_needed:
+                if callable(raw_data):
+                    raw_data = raw_data()
+                if raw_data is None:
+                    log.fatal(
+                        "add_valid on a model with host-only trees needs the "
+                        "validation set's raw data (pass the unbinned rows, "
+                        "or add eval sets before continued training)"
+                    )
+                raw = self.predict_raw(np.asarray(raw_data, np.float64))
+                raw = raw.T if raw.ndim == 2 else raw[None, :]
+                score = score + jnp.asarray(raw, jnp.float32)
+            else:
+                for mi, (ta, cid) in enumerate(self._device_trees):
+                    if ta is not None:
+                        ptree = make_predict_tree(ta, self.feature_meta)
+                        score = score.at[cid].add(tree_predict_value(bins_t, ptree))
+                    else:
+                        tree = self.models[mi]
+                        if tree is not None and tree.num_leaves == 1 and tree.leaf_value[0] != 0.0:
+                            score = score.at[cid].add(np.float32(tree.leaf_value[0]))
         if not hasattr(self, "valid_scores"):
             self.valid_scores: List[jax.Array] = []
             self._valid_bins_t: List[jax.Array] = []
         self.valid_scores.append(score)
-        self._valid_bins_t.append(jnp.asarray(valid_set.bins.T))
+        self._valid_bins_t.append(bins_t)
 
     # ------------------------------------------------------------------
     def _boost_from_average(self, class_id: int) -> float:
@@ -749,6 +792,32 @@ class GBDT:
                 self.scores = self.scores.at[k].add(
                     jnp.asarray(tree.leaf_value[lp], jnp.float32)
                 )
+
+    def shuffle_models(self, start_iter: int = 0, end_iter: int = -1) -> None:
+        """Shuffle the iteration order of trained trees in [start, end)
+        (GBDT::ShuffleModels, gbdt.cpp). Whole iterations move together so
+        multiclass class alignment is preserved; predictions over the full
+        model are unchanged (scores are sums), while num_iteration-limited
+        prediction and continued training see a decorrelated prefix."""
+        self._materialize()
+        K = self.num_tree_per_iteration
+        n_iter = len(self.models) // K
+        if end_iter < 0 or end_iter > n_iter:
+            end_iter = n_iter
+        start_iter = max(0, start_iter)
+        if end_iter - start_iter <= 1:
+            return
+        perm = np.arange(start_iter, end_iter)
+        rng = np.random.RandomState(self.config.seed & 0x7FFFFFFF)
+        rng.shuffle(perm)
+        new_models = list(self.models)
+        new_dev = list(self._device_trees)
+        for dst, src in enumerate(perm, start=start_iter):
+            for k in range(K):
+                new_models[dst * K + k] = self.models[src * K + k]
+                new_dev[dst * K + k] = self._device_trees[src * K + k]
+        self.models = new_models
+        self._device_trees = new_dev
 
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:415-431)."""

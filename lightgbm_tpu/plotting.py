@@ -99,6 +99,70 @@ def plot_importance(
     return ax
 
 
+def plot_split_value_histogram(
+    booster,
+    feature,
+    bins=None,
+    ax=None,
+    width_coef: float = 0.8,
+    xlim: Optional[Tuple] = None,
+    ylim: Optional[Tuple] = None,
+    title: str = "Split value histogram for feature with @index/name@ @feature@",
+    xlabel: str = "Feature split value",
+    ylabel: str = "Count",
+    figsize: Optional[Tuple] = None,
+    grid: bool = True,
+    **kwargs,
+):
+    """Plot the histogram of split thresholds for one feature
+    (plotting.py plot_split_value_histogram)."""
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError:
+        raise ImportError(
+            "You must install matplotlib to plot split value histogram."
+        )
+
+    if isinstance(booster, Booster):
+        counts, bin_edges = booster.get_split_value_histogram(feature, bins=bins)
+    elif hasattr(booster, "booster_"):  # sklearn wrapper
+        counts, bin_edges = booster.booster_.get_split_value_histogram(feature, bins=bins)
+    else:
+        raise TypeError("booster must be Booster or LGBMModel.")
+    if counts.sum() == 0:
+        raise ValueError(
+            "Cannot plot split value histogram, "
+            "because feature {} was not used in splitting".format(feature)
+        )
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize)
+    centers = (bin_edges[:-1] + bin_edges[1:]) / 2.0
+    widths = np.diff(bin_edges) * width_coef
+    ax.bar(centers, counts, width=widths, align="center", **kwargs)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (0, max(counts) * 1.1)
+    ax.set_ylim(ylim)
+    if title is not None:
+        title = title.replace(
+            "@index/name@", "name" if isinstance(feature, str) else "index"
+        ).replace("@feature@", str(feature))
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
 def plot_metric(
     booster,
     metric: Optional[str] = None,

@@ -113,3 +113,33 @@ class TestPlotting:
         bst, _ = self._booster()
         ax = lgb.plot_tree(bst, tree_index=0)
         assert ax is not None
+
+
+def test_plot_split_value_histogram():
+    import matplotlib
+    matplotlib.use("Agg")
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+         "min_data_in_leaf": 5},
+        lgb.Dataset(X, label=y), num_boost_round=5,
+    )
+    ax = lgb.plot_split_value_histogram(bst, 0)
+    assert ax.get_title().startswith("Split value histogram")
+
+    unused = not any(
+        int(t.split_feature[n]) == 3
+        for t in bst._gbdt.trees()
+        for n in range(t.num_leaves - 1)
+    )
+    if unused:
+        import pytest
+
+        with pytest.raises(ValueError):
+            lgb.plot_split_value_histogram(bst, 3)
