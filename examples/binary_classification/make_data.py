@@ -1,0 +1,20 @@
+"""Generate binary.train / binary.test (label + 28 tab-separated features,
+the shape of the reference's Higgs-derived fixture)."""
+import numpy as np
+
+COEF = np.random.RandomState(7).randn(28) * (np.random.RandomState(8).rand(28) > 0.4)
+
+
+def write(path, n, seed):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 28).astype(np.float32)
+    y = (X @ COEF + rng.randn(n) > 0).astype(int)
+    with open(path, "w") as fh:
+        for i in range(n):
+            fh.write("%d\t%s\n" % (y[i], "\t".join("%.6f" % v for v in X[i])))
+
+
+if __name__ == "__main__":
+    write("binary.train", 7000, 0)
+    write("binary.test", 500, 1)
+    print("wrote binary.train (7000 rows), binary.test (500 rows)")

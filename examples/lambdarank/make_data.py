@@ -1,0 +1,30 @@
+"""Generate rank.train / rank.test (relevance + features) and .query
+sidecars (rows per query), the reference lambdarank fixture shape."""
+import numpy as np
+
+COEF = np.random.RandomState(5).randn(12)
+
+
+def write(path, n_queries, seed):
+    rng = np.random.RandomState(seed)
+    rows, qsizes = [], []
+    for _ in range(n_queries):
+        k = rng.randint(5, 25)
+        qsizes.append(k)
+        X = rng.randn(k, 12)
+        score = X @ COEF + 0.5 * rng.randn(k)
+        rel = np.clip(np.digitize(score, np.percentile(score, [60, 85, 95])), 0, 3)
+        for i in range(k):
+            rows.append((rel[i], X[i]))
+    with open(path, "w") as fh:
+        for rel, x in rows:
+            fh.write("%d\t%s\n" % (rel, "\t".join("%.6f" % v for v in x)))
+    with open(path + ".query", "w") as fh:
+        for k in qsizes:
+            fh.write("%d\n" % k)
+
+
+if __name__ == "__main__":
+    write("rank.train", 200, 0)
+    write("rank.test", 40, 1)
+    print("wrote rank.train(+.query), rank.test(+.query)")

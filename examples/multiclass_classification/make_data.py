@@ -1,0 +1,19 @@
+"""Generate multiclass.train / multiclass.test (5-class label + features)."""
+import numpy as np
+
+CENTERS = np.random.RandomState(11).randn(5, 8) * 2.0
+
+
+def write(path, n, seed):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 5, n)
+    X = CENTERS[y] + rng.randn(n, 8)
+    with open(path, "w") as fh:
+        for i in range(n):
+            fh.write("%d\t%s\n" % (y[i], "\t".join("%.6f" % v for v in X[i])))
+
+
+if __name__ == "__main__":
+    write("multiclass.train", 4000, 0)
+    write("multiclass.test", 400, 1)
+    print("wrote multiclass.train, multiclass.test")

@@ -1,0 +1,47 @@
+"""Python-API walkthrough (reference: examples/python-guide/simple_example.py):
+Dataset construction, training with a validation set and early stopping,
+prediction, eval history, model save/load round-trip."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(5000, 20)
+coef = rng.randn(20) * (rng.rand(20) > 0.3)
+y = (X @ coef + rng.randn(5000) > 0).astype(float)
+X_train, X_test = X[:4000], X[4000:]
+y_train, y_test = y[:4000], y[4000:]
+
+train_data = lgb.Dataset(X_train, label=y_train)
+test_data = lgb.Dataset(X_test, label=y_test, reference=train_data)
+
+params = {
+    "objective": "binary",
+    "metric": ["auc", "binary_logloss"],
+    "num_leaves": 31,
+    "learning_rate": 0.05,
+    "feature_fraction": 0.9,
+    "bagging_fraction": 0.8,
+    "bagging_freq": 5,
+    "verbosity": -1,
+}
+
+evals_result = {}
+bst = lgb.train(
+    params,
+    train_data,
+    num_boost_round=100,
+    valid_sets=[test_data],
+    valid_names=["test"],
+    early_stopping_rounds=10,
+    evals_result=evals_result,
+    verbose_eval=10,
+)
+
+pred = bst.predict(X_test, num_iteration=bst.best_iteration)
+print("test AUC history tail:", [round(v, 4) for v in evals_result["test"]["auc"][-3:]])
+
+bst.save_model("model.txt", num_iteration=bst.best_iteration)
+bst2 = lgb.Booster(model_file="model.txt")
+assert np.allclose(bst2.predict(X_test), pred)
+print("saved + reloaded model predicts identically")

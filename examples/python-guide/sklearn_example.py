@@ -1,0 +1,38 @@
+"""sklearn-estimator walkthrough (reference: examples/python-guide/
+sklearn_example.py): LGBMRegressor fit/predict, early stopping, feature
+importances, and GridSearchCV compatibility."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(3000, 10)
+y = X @ rng.randn(10) + 0.2 * rng.randn(3000)
+X_train, X_test = X[:2400], X[2400:]
+y_train, y_test = y[:2400], y[2400:]
+
+gbm = lgb.LGBMRegressor(num_leaves=31, learning_rate=0.05, n_estimators=60)
+gbm.fit(
+    X_train, y_train,
+    eval_set=[(X_test, y_test)],
+    eval_metric="l1",
+    early_stopping_rounds=5,
+    verbose=False,
+)
+pred = gbm.predict(X_test, num_iteration=gbm.best_iteration_)
+rmse = float(np.sqrt(np.mean((pred - y_test) ** 2)))
+print("rmse: %.4f (best_iteration=%s)" % (rmse, gbm.best_iteration_))
+print("top importances:", np.argsort(gbm.feature_importances_)[::-1][:3])
+
+try:
+    from sklearn.model_selection import GridSearchCV
+
+    grid = GridSearchCV(
+        lgb.LGBMRegressor(n_estimators=20),
+        {"num_leaves": [15, 31], "learning_rate": [0.05, 0.1]},
+        cv=2,
+    )
+    grid.fit(X_train[:500], y_train[:500])
+    print("best grid params:", grid.best_params_)
+except ImportError:
+    print("scikit-learn not installed; skipping GridSearchCV demo")

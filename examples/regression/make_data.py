@@ -1,0 +1,19 @@
+"""Generate regression.train / regression.test (target + 10 features)."""
+import numpy as np
+
+COEF = np.random.RandomState(3).randn(10)
+
+
+def write(path, n, seed):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 10)
+    y = X @ COEF + 0.3 * rng.randn(n)
+    with open(path, "w") as fh:
+        for i in range(n):
+            fh.write("%.6f\t%s\n" % (y[i], "\t".join("%.6f" % v for v in X[i])))
+
+
+if __name__ == "__main__":
+    write("regression.train", 5000, 0)
+    write("regression.test", 500, 1)
+    print("wrote regression.train, regression.test")

@@ -437,7 +437,10 @@ class Dataset:
             ref_data = self.reference.get_data()
             if ref_data is None:
                 return None
-            return ref_data[np.asarray(self.used_indices)]
+            idx = np.asarray(self.used_indices)
+            if hasattr(ref_data, "iloc"):  # pandas: positional ROW selection
+                return ref_data.iloc[idx]
+            return ref_data[idx]
         return self.data
 
     def get_feature_penalty(self):
@@ -510,7 +513,10 @@ class Dataset:
     def dump_text(self, filename: str) -> "Dataset":
         """Write the raw (unbinned) rows as text — debugging aid
         (basic.py:1557 Dataset.dump_text)."""
-        self.construct()
+        if self.used_indices is None:
+            # subsets carry data=None and slice rows via get_data(); plain
+            # datasets construct first so file-backed data is loaded
+            self.construct()
         data = self.get_data()
         if data is None or isinstance(data, str):
             # text-file datasets replace .data with the loaded matrix at
@@ -644,6 +650,7 @@ class Booster:
         self.best_score: Dict = {}
         self._valid_names: List[str] = []
         self._valid_datasets: List[Dataset] = []
+        self._valid_slots: List[int] = []  # GBDT valid-list index per dataset
         self.pandas_categorical = None
         self._attrs: Dict[str, str] = {}
         self._train_data_name = "training"
@@ -718,6 +725,7 @@ class Booster:
         self._gbdt.add_valid(binned, metrics, name, raw_data=raw_provider)
         self._valid_names.append(name)
         self._valid_datasets.append(data)
+        self._valid_slots.append(len(self._gbdt.valid_names) - 1)
         return self
 
     def update(self, train_set=None, fobj=None) -> bool:
@@ -785,17 +793,20 @@ class Booster:
     def eval(self, data: Dataset, name: str, feval=None) -> List:
         """Evaluate on an arbitrary Dataset (basic.py Booster.eval): reuses
         the valid-set slot when ``data`` was added with add_valid, else adds
-        it first like the reference does."""
+        it first like the reference does. ``_valid_slots`` maps each tracked
+        Dataset to its slot in the GBDT's valid lists — the two sides can
+        diverge after free_dataset()/model_from_string()."""
         if data is self._train_dataset:
             return self.eval_train(feval)
-        for i, ds in enumerate(self._valid_datasets):
+        for pos, ds in enumerate(self._valid_datasets):
             if ds is data:
+                i = self._valid_slots[pos]
                 return self._eval_set(
                     self._gbdt._valid_score_np(i), name,
                     self._gbdt.valid_metrics[i], feval, ds,
                 )
         self.add_valid(data, name)
-        i = len(self._valid_datasets) - 1
+        i = self._valid_slots[-1]
         return self._eval_set(
             self._gbdt._valid_score_np(i), name, self._gbdt.valid_metrics[i],
             feval, data,
@@ -824,11 +835,17 @@ class Booster:
         return self
 
     def free_dataset(self) -> "Booster":
-        """Drop the training/validation Dataset references (basic.py
-        Booster.free_dataset) — the trained model remains usable for
-        predict/save; further update() calls need a train set again."""
+        """Drop the python-side training/validation Dataset references
+        (basic.py Booster.free_dataset), letting their raw matrices be
+        collected. The trained model remains fully usable — predict, save,
+        and even update() keep working, since the GBDT core holds its own
+        device-resident binned data (the reference's C++ booster likewise
+        keeps its Dataset)."""
         self._train_dataset = None
+        self.train_set = None
         self._valid_datasets = []
+        self._valid_slots = []
+        self._valid_names = []
         return self
 
     def free_network(self) -> "Booster":
@@ -855,6 +872,12 @@ class Booster:
     def model_from_string(self, model_str: str, verbose: bool = True) -> "Booster":
         """Replace this booster's model with one parsed from a model string."""
         self._load(model_str, self.params)
+        # the fresh GBDT has no valid lists; drop stale python-side tracking
+        self._train_dataset = None
+        self.train_set = None
+        self._valid_datasets = []
+        self._valid_slots = []
+        self._valid_names = []
         if verbose:
             log.info(
                 "Finished loading model, total used %d iterations"

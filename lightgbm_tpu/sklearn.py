@@ -111,6 +111,30 @@ class LGBMModel:
 
     # -- sklearn plumbing -------------------------------------------------
 
+    _estimator_type: Optional[str] = None
+
+    def __sklearn_tags__(self):
+        """sklearn >= 1.6 tag protocol; built from BaseEstimator's defaults
+        so model_selection tools (GridSearchCV, cross_val_score) accept
+        these estimators without inheriting sklearn classes (the reference
+        inherits its optional _LGBMModelBase shim instead)."""
+        from sklearn.base import BaseEstimator
+
+        tags = BaseEstimator.__sklearn_tags__(self)
+        tags.estimator_type = self._estimator_type
+        tags.target_tags.required = True
+        if self._estimator_type == "classifier":
+            from sklearn.utils import ClassifierTags
+
+            tags.classifier_tags = ClassifierTags()
+        elif self._estimator_type == "regressor":
+            from sklearn.utils import RegressorTags
+
+            tags.regressor_tags = RegressorTags()
+        tags.input_tags.allow_nan = True
+        tags.input_tags.sparse = True
+        return tags
+
     def get_params(self, deep: bool = True) -> Dict:
         params = {
             k: getattr(self, k)
@@ -263,13 +287,38 @@ class LGBMModel:
 
 
 class LGBMRegressor(LGBMModel):
+    # sklearn estimator-type tag: lets model_selection tools pick the right
+    # default scorer/CV splitter (the reference inherits this from
+    # sklearn.base.RegressorMixin)
+    _estimator_type = "regressor"
+
     def fit(self, X, y, **kwargs):
         if self._objective is None:
             self._objective = "regression"
         return super().fit(X, y, **kwargs)
 
+    def score(self, X, y, sample_weight=None) -> float:
+        """Coefficient of determination R^2 (RegressorMixin.score)."""
+        y = np.asarray(y, np.float64)
+        pred = np.asarray(self.predict(X), np.float64)
+        w = np.ones_like(y) if sample_weight is None else np.asarray(sample_weight, np.float64)
+        ss_res = np.sum(w * (y - pred) ** 2)
+        ss_tot = np.sum(w * (y - np.average(y, weights=w)) ** 2)
+        return float(1.0 - ss_res / ss_tot) if ss_tot > 0 else 0.0
+
 
 class LGBMClassifier(LGBMModel):
+    _estimator_type = "classifier"
+
+    def score(self, X, y, sample_weight=None) -> float:
+        """Mean accuracy (ClassifierMixin.score)."""
+        y = np.asarray(y)
+        pred = self.predict(X)
+        hit = (pred == y).astype(np.float64)
+        if sample_weight is not None:
+            return float(np.average(hit, weights=np.asarray(sample_weight, np.float64)))
+        return float(hit.mean())
+
     def fit(self, X, y, **kwargs):
         y = np.asarray(y)
         self._classes = np.unique(y)

@@ -88,6 +88,20 @@ class TestDatasetSurface:
         sub = ds.subset(np.arange(0, 100))
         np.testing.assert_array_equal(np.asarray(sub.get_data()), X[:100])
 
+    def test_get_data_and_dump_text_on_dataframe_subset(self, tmp_path):
+        pd = pytest.importorskip("pandas")
+        X, y = _data()
+        df = pd.DataFrame(X, columns=["c%d" % i for i in range(X.shape[1])])
+        ds = lgb.Dataset(df, label=y)
+        sub = ds.subset(np.arange(5, 25))
+        got = sub.get_data()
+        np.testing.assert_array_equal(np.asarray(got), X[5:25])
+        out = str(tmp_path / "sub.txt")
+        sub.dump_text(out)
+        np.testing.assert_allclose(
+            np.loadtxt(out, delimiter=","), X[5:25], rtol=1e-15
+        )
+
     def test_monotone_and_penalty_accessors(self):
         X, y = _data(f=3)
         ds = lgb.Dataset(
@@ -188,6 +202,25 @@ class TestBoosterSurface:
         np.testing.assert_array_equal(by_name[0], counts)
         with pytest.raises(LightGBMError):
             bst.get_split_value_histogram("no_such_feature")
+
+    def test_eval_after_free_dataset_uses_fresh_slot(self):
+        """free_dataset clears booster-side tracking but not the GBDT's valid
+        lists; a later eval must not hand back a stale slot's metrics."""
+        X, y = _data()
+        train = lgb.Dataset(X, label=y)
+        bst = lgb.train(PARAMS, train, num_boost_round=3)
+        easy = lgb.Dataset(X[:150], label=y[:150], reference=train)
+        bst.eval(easy, "easy")
+        bst.free_dataset()
+        # a deliberately WRONG-labeled set: its logloss must be terrible,
+        # not the easy set's
+        anti = lgb.Dataset(X[:150], label=1 - y[:150], reference=train)
+        res = bst.eval(anti, "anti")
+        got = dict((r[1], r[2]) for r in res)["binary_logloss"]
+        ya = 1 - y[:150]
+        p = np.clip(bst.predict(X[:150]), 1e-15, 1 - 1e-15)
+        want = -np.mean(ya * np.log(p) + (1 - ya) * np.log1p(-p))
+        assert abs(got - want) < 1e-5, (got, want)
 
     def test_free_dataset_and_network_shims(self):
         X, y = _data()

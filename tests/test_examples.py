@@ -1,0 +1,56 @@
+"""The examples/ tree works end-to-end: make_data + CLI train + CLI predict
+for every task directory, and the python-guide scripts run (reference
+analogue: the CI runs examples/*/train.conf after building).
+"""
+import os
+import runpy
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+TASKS = [
+    ("binary_classification", 30),
+    ("regression", 30),
+    ("lambdarank", 30),
+    ("multiclass_classification", 20),
+]
+
+
+@pytest.mark.parametrize("task,rounds", TASKS)
+def test_cli_example(task, rounds, tmp_path, monkeypatch):
+    src = os.path.join(EXAMPLES, task)
+    for f in os.listdir(src):
+        shutil.copy(os.path.join(src, f), tmp_path)
+    monkeypatch.chdir(tmp_path)
+    runpy.run_path(os.path.join(tmp_path, "make_data.py"), run_name="__main__")
+
+    from lightgbm_tpu.cli import main
+
+    # fewer rounds than the shipped configs: these are smoke runs
+    main(["config=train.conf", "num_trees=%d" % rounds, "verbose=-1"])
+    assert os.path.exists(tmp_path / "LightGBM_model.txt")
+    main(["config=predict.conf"])
+    out = np.loadtxt(tmp_path / "LightGBM_predict_result.txt")
+    data_rows = sum(1 for _ in open(
+        tmp_path / [f for f in os.listdir(tmp_path) if f.endswith(".test")][0]
+    ))
+    assert out.shape[0] == data_rows
+
+
+@pytest.mark.parametrize("script", ["simple_example.py", "sklearn_example.py"])
+def test_python_guide(script, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    path = os.path.join(EXAMPLES, "python-guide", script)
+    r = subprocess.run(
+        [sys.executable, path],
+        capture_output=True, text=True, timeout=900,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO),
+        cwd=tmp_path,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
