@@ -435,7 +435,13 @@ class Dataset:
         """Raw data as passed in (post-subset slicing, basic.py:1437)."""
         if self.reference is not None and self.used_indices is not None:
             ref_data = self.reference.get_data()
-            if ref_data is None:
+            if isinstance(ref_data, str):
+                # file-backed reference not constructed yet: loading replaces
+                # its .data with the matrix (binary dataset files keep the
+                # path — no raw rows to slice)
+                self.reference.construct()
+                ref_data = self.reference.get_data()
+            if ref_data is None or isinstance(ref_data, str):
                 return None
             idx = np.asarray(self.used_indices)
             if hasattr(ref_data, "iloc"):  # pandas: positional ROW selection
@@ -763,12 +769,15 @@ class Booster:
         )
 
     def eval_valid(self, feval=None) -> List:
+        # slot -> Dataset through the explicit map (the python-side lists can
+        # be shorter than the GBDT's after free_dataset; see eval())
+        slot_ds = dict(zip(self._valid_slots, self._valid_datasets))
         out = []
         for i, name in enumerate(self._gbdt.valid_names):
-            ds = self._valid_datasets[i] if i < len(self._valid_datasets) else None
             out.extend(
                 self._eval_set(
-                    self._gbdt._valid_score_np(i), name, self._gbdt.valid_metrics[i], feval, ds
+                    self._gbdt._valid_score_np(i), name,
+                    self._gbdt.valid_metrics[i], feval, slot_ds.get(i),
                 )
             )
         return out

@@ -672,7 +672,14 @@ def grow_tree(
             )
         parent_hist = s.hist[best_leaf]
         large_hist = parent_hist - small_hist
-        hist = s.hist.at[small_idx].set(small_hist).at[large_idx].set(large_hist)
+        # ONE stacked scatter, not two chained .at[].set: XLA updates the
+        # [M, F, B, 3] carry in place for a single scatter but inserts a
+        # full-buffer copy per chained update (~2 x 22MB per split at
+        # M=255/F=28/B=256 — measured 40x slower on CPU, and HBM traffic
+        # that would cost ~14ms/iter on TPU)
+        hist = s.hist.at[jnp.stack([small_idx, large_idx])].set(
+            jnp.stack([small_hist, large_hist])
+        )
 
         # ---- next-round candidate refresh --------------------------------
         if cegb_on:

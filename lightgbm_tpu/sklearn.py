@@ -304,7 +304,10 @@ class LGBMRegressor(LGBMModel):
         w = np.ones_like(y) if sample_weight is None else np.asarray(sample_weight, np.float64)
         ss_res = np.sum(w * (y - pred) ** 2)
         ss_tot = np.sum(w * (y - np.average(y, weights=w)) ** 2)
-        return float(1.0 - ss_res / ss_tot) if ss_tot > 0 else 0.0
+        if ss_tot > 0:
+            return float(1.0 - ss_res / ss_tot)
+        # constant target: r2_score semantics — perfect fit scores 1.0
+        return 1.0 if ss_res == 0 else 0.0
 
 
 class LGBMClassifier(LGBMModel):
