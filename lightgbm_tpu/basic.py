@@ -435,13 +435,13 @@ class Dataset:
         """Raw data as passed in (post-subset slicing, basic.py:1437)."""
         if self.reference is not None and self.used_indices is not None:
             ref_data = self.reference.get_data()
-            if isinstance(ref_data, str):
-                # file-backed reference not constructed yet: loading replaces
-                # its .data with the matrix (binary dataset files keep the
-                # path — no raw rows to slice)
-                self.reference.construct()
-                ref_data = self.reference.get_data()
             if ref_data is None or isinstance(ref_data, str):
+                # a path string means the reference was never constructed (or
+                # is a binary dataset file, which keeps no raw rows). Don't
+                # construct here: a read accessor must not pin the reference's
+                # binning with its own params, nor pay a full load just to
+                # find there are no rows. Construct the reference first if
+                # its loaded rows are wanted.
                 return None
             idx = np.asarray(self.used_indices)
             if hasattr(ref_data, "iloc"):  # pandas: positional ROW selection

@@ -340,8 +340,15 @@ def grow_tree(
     lazy_arr = feature_meta.get("cegb_lazy")
 
     def split2(hist2, sg2, sh2, nd2, mn2, mx2):
-        """Best splits for the two children (unrolled: split_fn may contain
+        """Best splits for the two children. vmapped over the child axis for
+        the plain scan; custom split_fns stay unrolled (they may contain
         collectives, which don't vmap under shard_map)."""
+        if split_fn is find_best_split:
+            return jax.vmap(
+                lambda h, sg, sh, nd, mn, mx: find_best_split(
+                    h, sg, sh, nd, mn, mx, feature_meta, feature_mask, params
+                )
+            )(hist2, sg2, sh2, nd2, mn2, mx2)
         results = [
             split_fn(
                 hist2[k], sg2[k], sh2[k], nd2[k], mn2[k], mx2[k],
