@@ -1,0 +1,21 @@
+#!/bin/bash
+# Build the reference LightGBM CLI out-of-tree for cross-validation tests
+# (tests/test_reference_binary_xval.py). The reference CMakeLists pins
+# EXECUTABLE_OUTPUT_PATH to its own source dir, so the binary is moved out
+# and the source tree restored afterwards (/root/reference must stay
+# unmodified).
+#
+# Usage: helpers/build_reference_cli.sh [REFERENCE_DIR] [OUT_DIR]
+#   then: LGBM_REF_BINARY=$OUT_DIR/lightgbm python -m pytest tests/test_reference_binary_xval.py
+set -euo pipefail
+REF="${1:-/root/reference}"
+OUT="${2:-/tmp/lgbm_ref_build}"
+mkdir -p "$OUT"
+cd "$OUT"
+cmake "$REF" -DCMAKE_BUILD_TYPE=Release >/dev/null
+make -j"$(nproc)" lightgbm >/dev/null
+# the reference build drops the exe into the source tree; relocate it
+if [ -f "$REF/lightgbm" ]; then
+  mv "$REF/lightgbm" "$OUT/lightgbm"
+fi
+echo "reference CLI at $OUT/lightgbm"
