@@ -149,7 +149,15 @@ def histogram_pallas(
 
 
 def supported(num_bins: int, backend: Optional[str] = None) -> bool:
-    """True when the pallas kernel can serve this shape on this backend."""
+    """True when the pallas kernel can serve this shape on this backend.
+
+    ``LIGHTGBM_TPU_HIST_IMPL=xla|scatter`` disables the kernel globally —
+    the escape hatch bench.py pulls if Mosaic lowering fails on a real chip.
+    """
+    import os
+
+    if os.environ.get("LIGHTGBM_TPU_HIST_IMPL", "").lower() in ("xla", "scatter"):
+        return False
     # must match _hi_for's constraint: ceil(B/LO) * 3 rows <= 128
     if -(-num_bins // LO) * 3 > 128:
         return False

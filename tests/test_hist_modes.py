@@ -88,3 +88,15 @@ def test_bucketed_non_pow2_and_tiny():
         tm, lm, tb, lb = _grow_both(X, y, leaves=7)
         _assert_trees_equal(tm, tb)
         np.testing.assert_array_equal(np.asarray(lm), np.asarray(lb))
+
+
+def test_hist_impl_env_override(monkeypatch):
+    """LIGHTGBM_TPU_HIST_IMPL=xla disables the pallas kernel globally — the
+    escape hatch bench.py pulls when Mosaic lowering fails on a real chip."""
+    from lightgbm_tpu.ops import hist_pallas
+
+    monkeypatch.setenv("LIGHTGBM_TPU_HIST_IMPL", "xla")
+    assert not hist_pallas.supported(64, backend="tpu")
+    monkeypatch.delenv("LIGHTGBM_TPU_HIST_IMPL")
+    assert hist_pallas.supported(64, backend="tpu")
+    assert not hist_pallas.supported(64, backend="cpu")

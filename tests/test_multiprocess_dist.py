@@ -58,8 +58,6 @@ def _free_port() -> int:
 def _launch_world(worker, data, tmp_path, attempt):
     """One coordinated 2-process run; returns results or None on a
     coordinator bind failure (the _free_port close-then-rebind race)."""
-    import subprocess
-
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)  # no virtual devices: one real proc per rank
     port = _free_port()
@@ -84,7 +82,8 @@ def _launch_world(worker, data, tmp_path, attempt):
             errs[r].seek(0)
             err_text = errs[r].read()
             if p.returncode != 0:
-                if "bind" in err_text.lower() or "address" in err_text.lower():
+                low = err_text.lower()
+                if "address already in use" in low or "failed to bind" in low:
                     return None  # port race: caller retries on a fresh port
                 raise AssertionError(err_text[-2000:])
             line = next(l for l in out.splitlines() if l.startswith("RESULT "))
@@ -95,6 +94,8 @@ def _launch_world(worker, data, tmp_path, attempt):
             if p.poll() is None:
                 p.kill()
                 p.wait()
+        for fh in errs:
+            fh.close()
 
 
 def test_two_process_mapper_exchange(tmp_path):
