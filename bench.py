@@ -174,11 +174,14 @@ def _orchestrate() -> None:
     orchestrator at all. The child prints the JSON line; on child
     failure/timeout the orchestrator emits the failure line itself."""
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 420))
+    # anchored where main() armed the watchdog, NOT after the probe — a slow
+    # probe must shrink the worker budget, or the watchdog would os._exit
+    # mid-worker and leak the detached process
+    deadline = _WATCHDOG_T0 + float(os.environ.get("BENCH_TIMEOUT_S", 2400)) - 60.0
     platforms, platform = _choose_platform(probe_timeout)
     env = dict(os.environ, BENCH_WORKER="1", BENCH_WORKER_PLATFORM=platform)
     if platforms is not None:
         env["BENCH_FORCE_PLATFORMS"] = platforms
-    deadline = time.time() + float(os.environ.get("BENCH_TIMEOUT_S", 2400)) - 60.0
 
     def run_worker(extra_env):
         limit = max(deadline - time.time(), 30.0)
@@ -341,7 +344,12 @@ def _run() -> None:
     )
 
 
+_WATCHDOG_T0 = time.time()  # updated in main() when the watchdog arms
+
+
 def main() -> None:
+    global _WATCHDOG_T0
+    _WATCHDOG_T0 = time.time()
     _watchdog(float(os.environ.get("BENCH_TIMEOUT_S", 2400)))
     try:
         if os.environ.get("BENCH_WORKER"):

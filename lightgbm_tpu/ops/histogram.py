@@ -81,6 +81,15 @@ def leaf_histogram(
     Returns:
       ``[F, B, K]`` float32 histogram.
     """
+    if impl == "auto":
+        # LIGHTGBM_TPU_HIST_IMPL routes the implementation directly (the
+        # bench's Mosaic-failure escape hatch); read at trace time, like
+        # hist_pallas.supported's disable check
+        import os
+
+        env_impl = os.environ.get("LIGHTGBM_TPU_HIST_IMPL", "").lower()
+        if env_impl in ("xla", "scatter", "pallas"):
+            impl = env_impl
     if impl == "pallas" or (impl == "auto" and hist_pallas.supported(num_bins)):
         hist = hist_pallas.histogram_pallas(
             bins, values, num_bins, chunk=max(chunk, 512), dtype_name=hist_dtype

@@ -86,6 +86,42 @@ class TestReferenceModelLoad:
         ref = np.loadtxt(f"{GOLDEN}/categorical/pred.txt")
         np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-9, atol=1e-12)
 
+    def test_weighted_binary_model_predicts_identically(self):
+        """Model the reference trained WITH per-row weights (w.train.weight
+        sidecar) — weighted grad/hess flow through leaf values and must
+        reproduce through our parser."""
+        X, _ = _load_tsv(f"{EXAMPLES}/binary_classification/binary.test")
+        bst = lgb.Booster(model_file=f"{GOLDEN}/weighted_binary/model.txt")
+        ref = np.loadtxt(f"{GOLDEN}/weighted_binary/pred.txt")
+        np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-9, atol=1e-12)
+
+    def test_weighted_training_parity(self):
+        """Training here with the same weights reaches the reference model's
+        weighted logloss within tolerance."""
+        Xtr, ytr = _load_tsv(f"{EXAMPLES}/binary_classification/binary.train")
+        Xtr, ytr = Xtr[:3500], ytr[:3500]
+        w = np.loadtxt(f"{GOLDEN}/weighted_binary/w.train.weight")
+        params = {"objective": "binary", "num_leaves": 31, "max_bin": 255,
+                  "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1}
+        bst = lgb.train(params, lgb.Dataset(Xtr, label=ytr, weight=w),
+                        num_boost_round=25)
+        Xte, yte = _load_tsv(f"{EXAMPLES}/binary_classification/binary.test")
+        ref_pred = np.loadtxt(f"{GOLDEN}/weighted_binary/pred.txt")
+
+        def logloss(y, p):
+            p = np.clip(p, 1e-15, 1 - 1e-15)
+            return -np.mean(y * np.log(p) + (1 - y) * np.log1p(-p))
+
+        ours = logloss(yte, bst.predict(Xte))
+        refs = logloss(yte, ref_pred)
+        assert ours < refs + 0.03, (ours, refs)
+
+    def test_xentropy_model_predicts_identically(self):
+        X, _ = _load_tsv(f"{EXAMPLES}/binary_classification/binary.test")
+        bst = lgb.Booster(model_file=f"{GOLDEN}/xentropy/model.txt")
+        ref = np.loadtxt(f"{GOLDEN}/xentropy/pred.txt")
+        np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-9, atol=1e-12)
+
     def test_reference_model_reserializes(self):
         """Loaded reference model -> to-string -> reload -> same predictions."""
         X, _ = _load_tsv(f"{GOLDEN}/categorical/cat.test")
