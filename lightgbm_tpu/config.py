@@ -346,6 +346,10 @@ class Config:
     tpu_hist_chunk: int = 16384
     # TPU-only: use float64 histogram accumulation on host-check paths.
     tpu_use_dp: bool = False
+    # TPU-only: per-leaf histogram mode — "bucketed" (default: segment-
+    # permutation histograms whose cost tracks leaf size) or "masked"
+    # (full-N masked passes; the differential oracle, ops/grow.py).
+    tpu_hist_mode: str = "bucketed"
     # TPU-only: MXU operand dtype for the Pallas histogram kernel —
     # "float32" (exact, 3-pass MXU) or "bfloat16" (single pass, ~3x faster;
     # grad/hess operands round to bf16, accumulation stays f32 — the

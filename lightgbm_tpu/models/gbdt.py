@@ -459,6 +459,7 @@ class GBDT:
             params=self.split_params,
             chunk=cfg.tpu_hist_chunk,
             hist_dtype=cfg.tpu_hist_dtype,
+            hist_mode=cfg.tpu_hist_mode,
         )
         cegb_on = self.cegb_params.enabled
         if learner == "serial":

@@ -57,6 +57,7 @@ def grow_tree_data_parallel(
     num_group_bins=None,
     chunk: int = 4096,
     hist_dtype: str = "float32",
+    hist_mode: str = "bucketed",
     forced_splits=(),
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
@@ -95,6 +96,7 @@ def grow_tree_data_parallel(
             params=params,
             chunk=chunk,
             hist_dtype=hist_dtype,
+            hist_mode=hist_mode,
             axis_name="data",
             forced_splits=forced_splits,
             cegb=cegb,

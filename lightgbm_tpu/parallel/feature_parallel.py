@@ -49,6 +49,7 @@ def grow_tree_feature_parallel(
     num_group_bins=None,
     chunk: int = 4096,
     hist_dtype: str = "float32",
+    hist_mode: str = "bucketed",
     forced_splits=(),
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
@@ -99,6 +100,7 @@ def grow_tree_feature_parallel(
         params=params,
         chunk=chunk,
         hist_dtype=hist_dtype,
+        hist_mode=hist_mode,
         forced_splits=forced_splits,
         cegb=cegb,
         cegb_state=cegb_state,

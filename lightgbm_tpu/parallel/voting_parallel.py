@@ -85,6 +85,7 @@ def grow_tree_voting_parallel(
     top_k: int = 20,
     chunk: int = 4096,
     hist_dtype: str = "float32",
+    hist_mode: str = "bucketed",
     forced_splits=(),
     num_group_bins=None,
 ):
@@ -108,6 +109,7 @@ def grow_tree_voting_parallel(
             params=params,
             chunk=chunk,
             hist_dtype=hist_dtype,
+            hist_mode=hist_mode,
             axis_name="data",
             split_fn=split_fn,
             psum_hist=False,  # histograms stay local; split_fn psums elected slice
