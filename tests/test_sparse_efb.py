@@ -181,3 +181,34 @@ def test_masked_mode_matches_bucketed_on_bundled():
         np.asarray(tm.threshold_bin)[: nl - 1], np.asarray(tb.threshold_bin)[: nl - 1]
     )
     np.testing.assert_array_equal(np.asarray(lm), np.asarray(lb))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_fuzz_bundled_matches_dense(seed):
+    """Seeded random sparse shapes: with max_conflict_rate=0 the EFB-bundled
+    run must grow the same trees as the densified run (the group-space
+    histogram remap's exactness, ops/grow.py remap_hist)."""
+    rng = np.random.RandomState(100 + seed)
+    n = int(rng.randint(600, 1500))
+    f = int(rng.randint(30, 120))
+    density = float(rng.uniform(0.01, 0.08))
+    X, y = _random_sparse(n, f, density, seed=seed)
+    params = dict(
+        PARAMS,
+        num_leaves=int(rng.choice([7, 15, 31])),
+        max_bin=int(rng.choice([15, 63, 255])),
+        min_data_in_leaf=int(rng.choice([5, 20])),
+    )
+    rounds = 4
+    bst_sparse = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    Xd = X.toarray()
+    bst_dense = lgb.train(
+        params, lgb.Dataset(Xd, label=y, params={"enable_bundle": False}),
+        num_boost_round=rounds,
+    )
+    for ts, td in zip(bst_sparse._gbdt.trees(), bst_dense._gbdt.trees()):
+        np.testing.assert_array_equal(ts.split_feature, td.split_feature)
+        np.testing.assert_allclose(ts.threshold, td.threshold, rtol=1e-12)
+    np.testing.assert_allclose(
+        bst_sparse.predict(Xd), bst_dense.predict(Xd), rtol=1e-6, atol=1e-7
+    )
