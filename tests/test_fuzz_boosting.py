@@ -1,0 +1,82 @@
+"""Boosting-variant robustness fuzz: random (boosting, objective, params)
+combinations must train, predict finitely, and round-trip the text format —
+the breadth complement to test_fuzz_configs.py's grower-equivalence fuzz.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _case(seed):
+    rng = np.random.RandomState(1000 + seed)
+    n = int(rng.randint(200, 700))
+    f = int(rng.randint(2, 7))
+    X = rng.randn(n, f)
+    if rng.rand() < 0.4:
+        X[rng.rand(n, f) < 0.1] = np.nan
+    boosting = str(rng.choice(["gbdt", "dart", "goss", "rf"]))
+    objective = str(
+        rng.choice([
+            "binary", "regression", "multiclass", "lambdarank", "quantile",
+            "poisson", "tweedie", "huber", "mape", "xentropy", "fair", "gamma",
+        ])
+    )
+    params = {
+        "objective": objective, "boosting": boosting, "verbosity": -1,
+        "num_leaves": int(rng.choice([3, 7, 31])),
+        "min_data_in_leaf": int(rng.choice([1, 10])),
+        "max_bin": int(rng.choice([7, 63, 255])),
+    }
+    group = None
+    if objective == "multiclass":
+        params["num_class"] = 3
+        y = rng.randint(0, 3, n).astype(float)
+    elif objective == "lambdarank":
+        y = rng.randint(0, 4, n).astype(float)
+        sizes, left = [], n
+        while left > 0:
+            k = min(left, int(rng.randint(5, 30)))
+            sizes.append(k)
+            left -= k
+        group = np.asarray(sizes)
+    elif objective in ("poisson", "tweedie", "gamma"):
+        y = np.abs(rng.randn(n)) + 0.1
+    elif objective in ("binary", "xentropy"):
+        y = np.nan_to_num((X[:, 0] > 0).astype(float))
+    else:
+        y = np.nansum(X[:, :2], axis=1) + rng.randn(n) * 0.2
+    if boosting == "rf":
+        params["bagging_fraction"] = 0.7
+        params["bagging_freq"] = 1
+    elif boosting != "goss" and rng.rand() < 0.4:
+        # GOSS + bagging is a config conflict the framework rejects, like
+        # the reference (config.cpp CheckParamConflict)
+        params["bagging_fraction"] = 0.8
+        params["bagging_freq"] = 1
+    return X, y, group, params
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_variant_trains_predicts_roundtrips(seed):
+    X, y, group, params = _case(seed)
+    bst = lgb.train(params, lgb.Dataset(X, label=y, group=group), num_boost_round=3)
+    p = bst.predict(X)
+    assert np.isfinite(p).all(), (params, "non-finite predictions")
+    bst2 = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_array_equal(bst2.predict(X), p)
+    bst.predict(X[:20], pred_leaf=True)
+    bst.predict(X[:20], pred_contrib=True)
+
+
+def test_goss_rejects_bagging():
+    X = np.random.RandomState(0).randn(200, 3)
+    y = (X[:, 0] > 0).astype(float)
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    with pytest.raises(LightGBMError, match="bagging in GOSS"):
+        lgb.train(
+            {"objective": "binary", "boosting": "goss", "verbosity": -1,
+             "bagging_fraction": 0.8, "bagging_freq": 1},
+            lgb.Dataset(X, label=y), num_boost_round=2,
+        )
