@@ -74,6 +74,62 @@ def test_binary_model_roundtrips_through_reference(tmp_path):
     np.testing.assert_allclose(ours_of_ref, ref_own, rtol=0, atol=1e-13)
 
 
+def test_multiclass_model_roundtrips_through_reference(tmp_path):
+    """Softmax models interleave num_class trees per iteration in the text
+    format; the reference must reproduce our per-class probabilities."""
+    rng = np.random.RandomState(7)
+    N = 2000
+    y = rng.randint(0, 4, N)
+    centers = rng.randn(4, 6) * 2
+    X = centers[y] + rng.randn(N, 6)
+    data = tmp_path / "mc.train"
+    with open(data, "w") as fh:
+        for i in range(N):
+            fh.write("%d\t%s\n" % (y[i], "\t".join("%.6f" % v for v in X[i])))
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 4, "num_leaves": 15,
+         "max_bin": 63, "min_data_in_leaf": 20, "verbosity": -1},
+        lgb.Dataset(str(data)), num_boost_round=8,
+    )
+    bst.save_model(str(tmp_path / "ours.txt"))
+    ours = bst.predict(X)
+    _ref(str(tmp_path), "p.conf", task="predict", data="mc.train",
+         input_model="ours.txt", output_result="ref.txt")
+    ref = np.loadtxt(tmp_path / "ref.txt")
+    np.testing.assert_allclose(ref, ours, rtol=0, atol=1e-13)
+
+
+def test_lambdarank_model_roundtrips_through_reference(tmp_path):
+    rng = np.random.RandomState(7)
+    rows, qs = [], []
+    for _ in range(150):
+        k = rng.randint(5, 20)
+        qs.append(k)
+        Xq = rng.randn(k, 5)
+        rel = np.clip(np.digitize(Xq @ rng.randn(5), [-1, 0.5, 1.5]), 0, 3)
+        for i in range(k):
+            rows.append((rel[i], Xq[i]))
+    data = tmp_path / "rk.train"
+    with open(data, "w") as fh:
+        for rel, x in rows:
+            fh.write("%d\t%s\n" % (rel, "\t".join("%.6f" % v for v in x)))
+    with open(str(data) + ".query", "w") as fh:
+        for k in qs:
+            fh.write("%d\n" % k)
+    Xr = np.vstack([x for _, x in rows])
+    bst = lgb.train(
+        {"objective": "lambdarank", "num_leaves": 15, "max_bin": 63,
+         "min_data_in_leaf": 10, "verbosity": -1},
+        lgb.Dataset(str(data)), num_boost_round=8,
+    )
+    bst.save_model(str(tmp_path / "ours.txt"))
+    ours = bst.predict(Xr)
+    _ref(str(tmp_path), "p.conf", task="predict", data="rk.train",
+         input_model="ours.txt", output_result="ref.txt")
+    ref = np.loadtxt(tmp_path / "ref.txt")
+    np.testing.assert_allclose(ref, ours, rtol=0, atol=1e-13)
+
+
 def test_categorical_bitset_model_roundtrips_through_reference(tmp_path):
     rng = np.random.RandomState(3)
     N = 2500
