@@ -227,6 +227,16 @@ def _orchestrate() -> None:
 
 
 def _run() -> None:
+    try:
+        # XLA's recursive HLO passes can blow the default 8MB stack on the
+        # large grow_tree program (flaky SIGSEGV inside backend_compile)
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
+        if hard == resource.RLIM_INFINITY or hard >= 256 * 1024 * 1024:
+            resource.setrlimit(resource.RLIMIT_STACK, (256 * 1024 * 1024, hard))
+    except Exception:
+        pass
     platform = os.environ.get("BENCH_WORKER_PLATFORM", "unknown")
     platforms = os.environ.get("BENCH_FORCE_PLATFORMS")
     n_shards = 1

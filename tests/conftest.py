@@ -5,13 +5,42 @@ virtual CPU devices, per the project testing strategy (SURVEY.md §4: in-process
 multi-worker simulation the reference lacks). Platform monkey-wiring lives in
 lightgbm_tpu.utils.platform (shared with __graft_entry__ and bench.py).
 """
-from lightgbm_tpu.utils.platform import force_cpu_devices
+import resource
+
+# XLA's recursive HLO passes can blow the default 8MB stack on large programs
+# (observed as a flaky SIGSEGV inside backend_compile late in the suite, when
+# hundreds of grow_tree variants have been compiled); raise the soft limit
+# before the first compile.
+_soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+if _hard == resource.RLIM_INFINITY or _hard >= 256 * 1024 * 1024:
+    resource.setrlimit(
+        resource.RLIMIT_STACK, (256 * 1024 * 1024, _hard)
+    )
+
+from lightgbm_tpu.utils.platform import force_cpu_devices  # noqa: E402
 
 jax = force_cpu_devices(8)
 assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for the test mesh"
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Every compiled XLA executable keeps its JIT code pages mapped; a full-suite
+# run accumulates >60k memory maps and segfaults inside backend_compile when
+# it crosses the kernel's vm.max_map_count (default 65530). Dropping the
+# executable caches periodically bounds the map count at a modest recompile
+# cost. (Diagnosed by watching /proc/<pid>/maps grow to ~61k right before a
+# deterministic mid-suite SIGSEGV in jax's compiler.)
+_TESTS_PER_CACHE_CLEAR = 40
+_test_counter = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _bound_xla_map_count():
+    yield
+    _test_counter["n"] += 1
+    if _test_counter["n"] % _TESTS_PER_CACHE_CLEAR == 0:
+        jax.clear_caches()
 
 
 @pytest.fixture
