@@ -1,0 +1,70 @@
+"""Background TPU relay probe loop.
+
+The axon TPU tunnel (see BENCH_NOTES.md) has died mid-round twice.  This
+loop probes the backend every PERIOD seconds in a killed-process-group
+subprocess (a timeout-killed TPU client can wedge the tunnel, so the probe
+child must die with its whole group) and appends one JSON line per attempt
+to .tpu_probe.log.  It exits 0 the first time a probe completes a real
+matmul on the chip, so a supervisor waiting on this process learns the
+instant the TPU is usable.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+PERIOD = int(os.environ.get("TPU_PROBE_PERIOD", "600"))
+TIMEOUT = int(os.environ.get("TPU_PROBE_TIMEOUT", "120"))
+LOG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".tpu_probe.log")
+
+PROBE = """
+import jax, jax.numpy as jnp
+d = jax.devices()
+x = jnp.ones((256, 256), dtype=jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print("PROBE_OK", d[0].platform, len(d))
+"""
+
+
+def probe_once():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "axon"
+    p = subprocess.Popen(
+        [sys.executable, "-c", PROBE],
+        env=env,
+        start_new_session=True,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        out, _ = p.communicate(timeout=TIMEOUT)
+        ok = p.returncode == 0 and "PROBE_OK" in out
+        return ok, ("ok" if ok else f"rc={p.returncode}"), out[-500:]
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        p.wait()
+        return False, "timeout", ""
+
+
+def main():
+    while True:
+        ok, status, tail = probe_once()
+        with open(LOG, "a") as f:
+            f.write(json.dumps({
+                "t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                "status": status,
+                "tail": tail.strip(),
+            }) + "\n")
+        if ok:
+            return 0
+        time.sleep(PERIOD)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

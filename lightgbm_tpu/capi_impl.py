@@ -263,13 +263,13 @@ def booster_get_current_iteration(bid: int) -> int:
 def booster_get_eval_counts(bid: int) -> int:
     # c_api.h:528 LGBM_BoosterGetEvalCounts: number of metric values one
     # booster_get_eval call writes (callers size their buffer with this).
-    # The count is fixed at booster construction, so evaluate once and cache —
-    # callers (the R bridge) ask on every GetEval and a fresh eval_train()
-    # here would add an O(num_data) pass per round.
-    cb = _boosters[bid]
-    if getattr(cb, "eval_count", None) is None:
-        cb.eval_count = len(cb.booster.eval_train())
-    return cb.eval_count
+    # Derived from the metric list without evaluating — a booster loaded from
+    # a model file has no training data attached, and the reference returns 0
+    # there rather than erroring. Rank metrics emit one value per eval_at
+    # position (GetName() returns one name per position in the reference).
+    gbdt = getattr(_boosters[bid].booster, "_gbdt", None)
+    metrics = getattr(gbdt, "training_metrics", None) or []
+    return sum(len(getattr(m, "eval_at", None) or (1,)) for m in metrics)
 
 
 def booster_save_model(

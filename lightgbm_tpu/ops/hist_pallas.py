@@ -148,19 +148,23 @@ def histogram_pallas(
     return hist[:, :B, :]
 
 
-def supported(num_bins: int, backend: Optional[str] = None) -> bool:
+def supported(
+    num_bins: int, backend: Optional[str] = None, ignore_backend: bool = False
+) -> bool:
     """True when the pallas kernel can serve this shape on this backend.
 
-    ``LIGHTGBM_TPU_HIST_IMPL=xla|scatter`` disables the kernel globally —
-    the escape hatch bench.py pulls if Mosaic lowering fails on a real chip.
+    Pure shape+backend predicate — the ``LIGHTGBM_TPU_HIST_IMPL`` escape
+    hatch acts only in the routing layer (``histogram._ENV_IMPL``, frozen at
+    import), never here, so differential tests that force ``impl="pallas"``
+    really exercise the kernel. ``ignore_backend`` checks only the shape
+    constraints — the gate for a forced pallas, which may legitimately
+    target interpret mode off-TPU.
     """
-    import os
-
-    if os.environ.get("LIGHTGBM_TPU_HIST_IMPL", "").lower() in ("xla", "scatter"):
-        return False
     # must match _hi_for's constraint: ceil(B/LO) * 3 rows <= 128
     if -(-num_bins // LO) * 3 > 128:
         return False
+    if ignore_backend:
+        return True
     if backend is None:
         try:
             backend = jax.default_backend()

@@ -37,6 +37,19 @@ def _default_backend() -> str:
         return "cpu"
 
 
+# Resolved ONCE at import so routing is deterministic per process: leaf_histogram
+# is jitted with impl as a static arg, and an env var read at trace time would
+# silently keep stale routing for already-compiled shapes if it changed later.
+# Set LIGHTGBM_TPU_HIST_IMPL before importing lightgbm_tpu (bench.py's
+# Mosaic-failure escape hatch re-execs the worker process for exactly this
+# reason).
+import os as _os
+
+_ENV_IMPL = _os.environ.get("LIGHTGBM_TPU_HIST_IMPL", "").lower()
+if _ENV_IMPL not in ("xla", "scatter", "pallas"):
+    _ENV_IMPL = ""
+
+
 def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:
     """Bound the transient one-hot tensor to ~64MB of f32."""
     budget = 64 * 1024 * 1024 // 4
@@ -81,15 +94,20 @@ def leaf_histogram(
     Returns:
       ``[F, B, K]`` float32 histogram.
     """
-    if impl == "auto":
-        # LIGHTGBM_TPU_HIST_IMPL routes the implementation directly (the
-        # bench's Mosaic-failure escape hatch); read at trace time, like
-        # hist_pallas.supported's disable check
-        import os
+    if impl == "auto" and _ENV_IMPL:
+        impl = _ENV_IMPL
+    if impl == "pallas" and not hist_pallas.supported(num_bins, ignore_backend=True):
+        # A forced 'pallas' must still satisfy the kernel's shape constraints
+        # (num_bins bound from the VMEM block rules) or it would mis-lower
+        # instead of falling back.
+        import warnings
 
-        env_impl = os.environ.get("LIGHTGBM_TPU_HIST_IMPL", "").lower()
-        if env_impl in ("xla", "scatter", "pallas"):
-            impl = env_impl
+        warnings.warn(
+            "impl='pallas' requested (explicitly or via LIGHTGBM_TPU_HIST_IMPL) "
+            "but the pallas kernel does not support num_bins=%d; falling back "
+            "to the XLA one-hot implementation" % (num_bins,)
+        )
+        impl = "xla"
     if impl == "pallas" or (impl == "auto" and hist_pallas.supported(num_bins)):
         hist = hist_pallas.histogram_pallas(
             bins, values, num_bins, chunk=max(chunk, 512), dtype_name=hist_dtype

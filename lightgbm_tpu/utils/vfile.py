@@ -51,6 +51,10 @@ def vexists(path: str) -> bool:
     try:
         import fsspec
     except ImportError:
+        log.warning(
+            "Cannot check existence of remote path %r: fsspec is not "
+            "installed; treating as absent" % (path,)
+        )
         return False
     try:
         fs, rel = fsspec.core.url_to_fs(path)

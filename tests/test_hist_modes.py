@@ -90,13 +90,37 @@ def test_bucketed_non_pow2_and_tiny():
         np.testing.assert_array_equal(np.asarray(lm), np.asarray(lb))
 
 
-def test_hist_impl_env_override(monkeypatch):
-    """LIGHTGBM_TPU_HIST_IMPL=xla disables the pallas kernel globally — the
-    escape hatch bench.py pulls when Mosaic lowering fails on a real chip."""
+def test_hist_impl_env_override():
+    """LIGHTGBM_TPU_HIST_IMPL is frozen at import (histogram._ENV_IMPL) so
+    routing is deterministic per process — the escape hatch bench.py pulls
+    when Mosaic lowering fails re-execs the worker, so set-before-import is
+    the contract. supported() itself is a pure shape+backend predicate."""
+    import subprocess
+    import sys
+
     from lightgbm_tpu.ops import hist_pallas
 
-    monkeypatch.setenv("LIGHTGBM_TPU_HIST_IMPL", "xla")
-    assert not hist_pallas.supported(64, backend="tpu")
-    monkeypatch.delenv("LIGHTGBM_TPU_HIST_IMPL")
+    # env acts only through the frozen routing constant, never supported()
     assert hist_pallas.supported(64, backend="tpu")
     assert not hist_pallas.supported(64, backend="cpu")
+
+    code = (
+        "from lightgbm_tpu.ops import histogram\n"
+        "assert histogram._ENV_IMPL == 'xla', histogram._ENV_IMPL\n"
+        "import numpy as np, jax.numpy as jnp\n"
+        "bins = jnp.zeros((2, 512), jnp.int32)\n"
+        "vals = jnp.ones((512, 3), jnp.float32)\n"
+        "h = histogram.leaf_histogram(bins, vals, 16)\n"
+        "assert np.asarray(h)[0, 0, 2] == 512\n"
+        "print('ENV_ROUTED_OK')\n"
+    )
+    import os
+
+    env = dict(os.environ)
+    env["LIGHTGBM_TPU_HIST_IMPL"] = "xla"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True, text=True
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "ENV_ROUTED_OK" in out.stdout
