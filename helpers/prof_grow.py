@@ -1,0 +1,76 @@
+"""Per-split latency profiling harness at the bench shape.
+
+Usage: JAX_PLATFORMS=cpu python helpers/prof_grow.py [rows] [leaves] [iters]
+Prints compile time, steady-state iters/s, and (with LIGHTGBM_TPU_PROFILE
+set) writes a jax profiler trace.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_higgs_like(n, f, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    w = rng.randn(f) / np.sqrt(f)
+    logits = X @ w + 0.5 * np.sin(X[:, 0] * 2.0) + 0.25 * X[:, 1] * X[:, 2]
+    y = (logits + rng.randn(n) * 0.5 > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    rows = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    leaves = int(sys.argv[2]) if len(sys.argv) > 2 else 255
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+
+    import jax
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(rows, 28)
+    params = {
+        "objective": "binary",
+        "num_leaves": leaves,
+        "max_bin": 255,
+        "learning_rate": 0.1,
+        "verbosity": -1,
+    }
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    booster = lgb.Booster(params=params, train_set=ds)
+    print("bin: %.1fs" % (time.time() - t0), flush=True)
+
+    t0 = time.time()
+    booster.update()
+    jax.block_until_ready(booster._gbdt.scores)
+    print("first iter (compile): %.1fs" % (time.time() - t0), flush=True)
+    t0 = time.time()
+    booster.update()
+    jax.block_until_ready(booster._gbdt.scores)
+    print("second iter: %.2fs" % (time.time() - t0), flush=True)
+
+    trace_dir = os.environ.get("LIGHTGBM_TPU_PROFILE")
+    if trace_dir:
+        with jax.profiler.trace(trace_dir):
+            for _ in range(3):
+                booster.update()
+            jax.block_until_ready(booster._gbdt.scores)
+        print("trace written to", trace_dir, flush=True)
+
+    t0 = time.time()
+    for _ in range(iters):
+        booster.update()
+    jax.block_until_ready(booster._gbdt.scores)
+    dt = time.time() - t0
+    print(
+        "steady: %d iters in %.2fs -> %.3f iters/s (%.1f ms/iter, %.0f us/split)"
+        % (iters, dt, iters / dt, 1000 * dt / iters, 1e6 * dt / iters / max(leaves - 1, 1)),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -60,7 +60,10 @@ def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_bins", "chunk", "axis_name", "impl", "hist_dtype")
+    jax.jit,
+    static_argnames=(
+        "num_bins", "chunk", "axis_name", "impl", "hist_dtype", "feature_sharded",
+    ),
 )
 def leaf_histogram(
     bins: jax.Array,
@@ -70,6 +73,7 @@ def leaf_histogram(
     axis_name: Optional[str] = None,
     impl: str = "auto",
     hist_dtype: str = "float32",
+    feature_sharded: bool = False,
 ) -> jax.Array:
     """Histogram of per-row values over binned features.
 
@@ -119,31 +123,46 @@ def leaf_histogram(
         # CPU: a scatter-add is the dense_bin.hpp:71 loop XLA can actually run
         # well — F*N adds instead of the one-hot contraction's 2*F*N*B flops
         # (B× waste). TPU keeps the MXU paths: scatter lowers poorly there.
-        # Chunked over rows like the one-hot path so the [F, C, K] update
-        # transient stays within the same ~64MB budget at any N.
         F, N = bins.shape
         K = values.shape[1]
-        C = (64 * 1024 * 1024 // 4) // max(F * (K + 1), 1)
-        C = max(256, min((C // 256) * 256, N))
-        if N % C != 0:
-            pad = (-N) % C
-            bins = jnp.pad(bins, ((0, 0), (0, pad)))
-            values = jnp.pad(values, ((0, pad), (0, 0)))
-            N += pad
-        n_chunks = N // C
-        offs = (jnp.arange(F, dtype=jnp.int32) * num_bins)[:, None]
-        bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
-        vals_c = values.reshape(n_chunks, C, K)
+        if not feature_sharded:
+            # One scatter per feature via lax.scan: a flat [F*N, K] scatter
+            # forces XLA to materialize the broadcast update tensor (F copies
+            # of values — 33MB at the 100k bench shape), while the per-feature
+            # form scatters the shared [N, K] values into an L2-resident
+            # [B, K] accumulator (2-9x faster measured at N=16k..100k).
+            def body(carry, b_f):
+                return carry, jnp.zeros((num_bins, K), jnp.float32).at[
+                    b_f.astype(jnp.int32)
+                ].add(values)
 
-        def body(acc, inputs):
-            b, v = inputs  # [F, C], [C, K]
-            idx = (b.astype(jnp.int32) + offs).reshape(-1)
-            upd = jnp.broadcast_to(v[None], (F, C, K)).reshape(F * C, K)
-            return acc.at[idx].add(upd), None
+            _, hist = jax.lax.scan(body, 0, bins)
+        else:
+            # Feature-sharded bins (the GSPMD feature-parallel learner): a
+            # scan over the feature axis would force an all-gather of the bin
+            # matrix, so chunk over rows instead and keep features vectorized
+            # — each shard scatters only its own features.
+            C = (64 * 1024 * 1024 // 4) // max(F * (K + 1), 1)
+            C = max(256, min((C // 256) * 256, N))
+            if N % C != 0:
+                pad = (-N) % C
+                bins = jnp.pad(bins, ((0, 0), (0, pad)))
+                values = jnp.pad(values, ((0, pad), (0, 0)))
+                N += pad
+            n_chunks = N // C
+            offs = (jnp.arange(F, dtype=jnp.int32) * num_bins)[:, None]
+            bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
+            vals_c = values.reshape(n_chunks, C, K)
 
-        init = jnp.zeros((F * num_bins, K), jnp.float32)
-        hist, _ = jax.lax.scan(body, init, (bins_c, vals_c))
-        hist = hist.reshape(F, num_bins, K)
+            def body(acc, inputs):
+                b, v = inputs  # [F, C], [C, K]
+                idx = (b.astype(jnp.int32) + offs).reshape(-1)
+                upd = jnp.broadcast_to(v[None], (F, C, K)).reshape(F * C, K)
+                return acc.at[idx].add(upd), None
+
+            init = jnp.zeros((F * num_bins, K), jnp.float32)
+            hist, _ = jax.lax.scan(body, init, (bins_c, vals_c))
+            hist = hist.reshape(F, num_bins, K)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
         return hist

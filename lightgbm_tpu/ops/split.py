@@ -157,9 +157,16 @@ def _scan_candidates(
     max_constraint: jax.Array,
     feature_meta: Dict[str, jax.Array],  # num_bin/missing_type/default_bin/monotone [F]
     params: SplitParams,
+    two_way: bool = True,
 ) -> _ScanOut:
     """Per-feature threshold scan; the shared core of find_best_split and the
-    voting-parallel local stage (voting_parallel_tree_learner.cpp:337)."""
+    voting-parallel local stage (voting_parallel_tree_learner.cpp:337).
+
+    ``two_way=False`` is a trace-time guarantee that every feature is
+    single-scan (missing_type None or num_bin<=2), so the dir=+1 pass — whose
+    candidates would all be masked invalid anyway — is skipped entirely.
+    Results are identical to ``two_way=True`` whenever the guarantee holds
+    (differentially tested in tests/test_micro_exact.py)."""
     F, B, _ = hist.shape
     p = params
     num_bin = feature_meta["num_bin"].astype(jnp.int32)  # [F]
@@ -184,7 +191,12 @@ def _scan_candidates(
     excl |= use_na[:, None] & (bins == nan_bin)
     contrib = hist * (~excl)[:, :, None].astype(hist.dtype)  # [F, B, 3]
 
-    prefix = jnp.cumsum(contrib, axis=1)  # inclusive prefix over bins
+    # inclusive prefix over bins. MUST stay a sequential scan: a reassociated
+    # prefix (associative_scan — ~2x faster on CPU) perturbs f32 candidate
+    # gains by ~1ulp, which flips argmax tie-breaks between equally-good
+    # splits and breaks the dense-vs-EFB-bundled tree equivalence that
+    # tests/test_sparse_efb.py enforces.
+    prefix = jnp.cumsum(contrib, axis=1)
     total = prefix[:, -1, :]  # [F, 3] sums over included bins
 
     thresholds = jnp.arange(B, dtype=jnp.int32)[None, :]  # threshold t -> left bins <= t
@@ -219,11 +231,14 @@ def _scan_candidates(
     lh_pos_raw = prefix[:, :, 1]
     lc_pos = prefix[:, :, 2]
     lh_pos, rg_pos, rh_pos, rc_pos = side_stats(lg_pos, lh_pos_raw, lc_pos)
-    valid_pos = thresholds <= (num_bin[:, None] - 2)
-    valid_pos &= ~(skip_def[:, None] & (thresholds == default_bin[:, None]))
-    # dir=+1 runs only for the missing-handling scans
-    valid_pos &= (~single_scan)[:, None]
-    gains_pos = gains_for(lg_pos, lh_pos, rg_pos, rh_pos, lc_pos, rc_pos, valid_pos)
+    if two_way:
+        valid_pos = thresholds <= (num_bin[:, None] - 2)
+        valid_pos &= ~(skip_def[:, None] & (thresholds == default_bin[:, None]))
+        # dir=+1 runs only for the missing-handling scans
+        valid_pos &= (~single_scan)[:, None]
+        gains_pos = gains_for(lg_pos, lh_pos, rg_pos, rh_pos, lc_pos, rc_pos, valid_pos)
+    else:
+        gains_pos = None  # every candidate would be masked invalid
 
     # ---- dir = -1 (right-to-left; default_left = True) -------------------
     rg_neg_raw = total[:, None, 0] - prefix[:, :, 0]
@@ -386,13 +401,17 @@ def _scan_candidates(
     t_neg_rev = jnp.argmax(gains_neg[:, ::-1], axis=1)
     t_neg = B - 1 - t_neg_rev
     g_neg = jnp.take_along_axis(gains_neg, t_neg[:, None], axis=1)[:, 0]
-    # dir=+1 prefers the smallest threshold; must strictly beat dir=-1.
-    t_pos = jnp.argmax(gains_pos, axis=1)
-    g_pos = jnp.take_along_axis(gains_pos, t_pos[:, None], axis=1)[:, 0]
-
-    use_pos = g_pos > g_neg
-    g_best = jnp.where(use_pos, g_pos, g_neg)
-    t_best = jnp.where(use_pos, t_pos, t_neg)
+    if two_way:
+        # dir=+1 prefers the smallest threshold; must strictly beat dir=-1.
+        t_pos = jnp.argmax(gains_pos, axis=1)
+        g_pos = jnp.take_along_axis(gains_pos, t_pos[:, None], axis=1)[:, 0]
+        use_pos = g_pos > g_neg
+        g_best = jnp.where(use_pos, g_pos, g_neg)
+        t_best = jnp.where(use_pos, t_pos, t_neg)
+    else:
+        use_pos = jnp.zeros((F,), bool)
+        g_best = g_neg
+        t_best = t_neg
     dl_best = ~use_pos  # default_left = (dir == -1)
     # 2-bin NaN features keep default_left=False (feature_histogram.hpp:108-111)
     two_bin_nan = (missing == MISSING_NAN) & ~multi_bin
@@ -515,17 +534,18 @@ def per_feature_best_gain(
     feature_meta: Dict[str, jax.Array],
     feature_mask: jax.Array,
     params: SplitParams,
+    two_way: bool = True,
 ) -> jax.Array:
     """[F] best gain per feature (-inf where none) — the voting-parallel
     local-voting stage (LightSplitInfo gains, voting_parallel_tree_learner.cpp:337)."""
     sc = _scan_candidates(
         hist, sum_grad, sum_hess, num_data, min_constraint, max_constraint,
-        feature_meta, params,
+        feature_meta, params, two_way=two_way,
     )
     return jnp.where(feature_mask, sc.g_best, K_MIN_SCORE)
 
 
-@functools.partial(jax.jit, static_argnames=("params",))
+@functools.partial(jax.jit, static_argnames=("params", "two_way"))
 def find_best_split(
     hist: jax.Array,  # [F, B, 3] (sum_grad, sum_hess, count)
     sum_grad: jax.Array,  # leaf totals (scalars)
@@ -537,13 +557,14 @@ def find_best_split(
     feature_mask: jax.Array,  # [F] bool: feature_fraction sample & usable
     params: SplitParams,
     penalty: Any = None,  # optional [F] CEGB gain penalty per feature
+    two_way: bool = True,
 ) -> SplitResult:
     """Best split for one leaf across all features (FindBestThresholdNumerical)."""
     p = params
     sum_hess_eff = sum_hess + 2 * K_EPSILON  # feature_histogram.hpp:87
     sc = _scan_candidates(
         hist, sum_grad, sum_hess, num_data, min_constraint, max_constraint,
-        feature_meta, params,
+        feature_meta, params, two_way=two_way,
     )
     (g_best, t_best, dl_best, use_pos, is_cat) = (
         sc.g_best, sc.t_best, sc.dl_best, sc.use_pos, sc.is_cat,

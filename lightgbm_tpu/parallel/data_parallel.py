@@ -61,6 +61,7 @@ def grow_tree_data_parallel(
     forced_splits=(),
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
+    two_way: bool = True,
 ):
     """Explicit shard_map data-parallel growth; returns (TreeArrays, leaf_id).
 
@@ -97,6 +98,7 @@ def grow_tree_data_parallel(
             chunk=chunk,
             hist_dtype=hist_dtype,
             hist_mode=hist_mode,
+            two_way=two_way,
             axis_name="data",
             forced_splits=forced_splits,
             cegb=cegb,

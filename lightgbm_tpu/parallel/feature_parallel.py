@@ -53,6 +53,7 @@ def grow_tree_feature_parallel(
     forced_splits=(),
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
+    two_way: bool = True,
 ):
     """Feature-sharded growth; returns (TreeArrays, leaf_id), both replicated."""
     fcol = NamedSharding(mesh, P("feature", None))
@@ -101,6 +102,8 @@ def grow_tree_feature_parallel(
         chunk=chunk,
         hist_dtype=hist_dtype,
         hist_mode=hist_mode,
+        two_way=two_way,
+        feature_sharded=True,
         forced_splits=forced_splits,
         cegb=cegb,
         cegb_state=cegb_state,

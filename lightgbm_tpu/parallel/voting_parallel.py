@@ -27,7 +27,7 @@ from .data_parallel import shard_map
 
 
 @functools.lru_cache(maxsize=None)
-def _voting_split_fn(top_k: int, axis_name: str):
+def _voting_split_fn(top_k: int, axis_name: str, two_way: bool = True):
     """Build the voting split finder once per (top_k, axis) — keeps grow_tree's
     static split_fn identity stable across trees (no per-tree recompiles)."""
 
@@ -47,7 +47,7 @@ def _voting_split_fn(top_k: int, axis_name: str):
         local_n = jnp.sum(hist_local[0, :, 2])
         local_gain = per_feature_best_gain(
             hist_local, local_g, local_h, local_n, min_c, max_c,
-            feature_meta, feature_mask, params,
+            feature_meta, feature_mask, params, two_way=two_way,
         )
         # local top-k vote -> global vote count per feature (GlobalVoting :170)
         _, top_idx = jax.lax.top_k(local_gain, k)
@@ -61,7 +61,7 @@ def _voting_split_fn(top_k: int, axis_name: str):
         meta_sel = {key: v[elected] for key, v in feature_meta.items()}
         res = find_best_split(
             hist_sel, sum_g, sum_h, num_data, min_c, max_c,
-            meta_sel, feature_mask[elected], params,
+            meta_sel, feature_mask[elected], params, two_way=two_way,
         )
         # map the elected-space feature index back to full feature space
         real_f = jnp.where(res.feature >= 0, elected[jnp.maximum(res.feature, 0)], -1)
@@ -88,11 +88,12 @@ def grow_tree_voting_parallel(
     hist_mode: str = "bucketed",
     forced_splits=(),
     num_group_bins=None,
+    two_way: bool = True,
 ):
     """Voting-parallel growth; returns (TreeArrays replicated, leaf_id sharded)."""
     meta_keys = sorted(feature_meta.keys())
     meta_vals = tuple(feature_meta[k] for k in meta_keys)
-    split_fn = _voting_split_fn(top_k, "data")
+    split_fn = _voting_split_fn(top_k, "data", two_way)
 
     def local(bins_l, grad_l, hess_l, bag_l, fmask, *meta_flat):
         meta = dict(zip(meta_keys, meta_flat))
@@ -110,6 +111,7 @@ def grow_tree_voting_parallel(
             chunk=chunk,
             hist_dtype=hist_dtype,
             hist_mode=hist_mode,
+            two_way=two_way,
             axis_name="data",
             split_fn=split_fn,
             psum_hist=False,  # histograms stay local; split_fn psums elected slice

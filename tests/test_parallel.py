@@ -166,6 +166,10 @@ class TestFeatureParallel:
         kw = dict(
             num_leaves=15, max_depth=-1, num_bins=ds.max_num_bin,
             params=PARAMS, chunk=256,
+            # the feature-parallel learner's contract: feature-sharded bins
+            # must use the row-chunked histogram scatter (a feature-axis scan
+            # would force GSPMD to all-gather the bin matrix)
+            feature_sharded=True,
         )
         mesh = feature_mesh(jax.devices())
         fcol = NamedSharding(mesh, P("feature", None))
