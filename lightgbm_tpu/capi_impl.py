@@ -112,14 +112,10 @@ def dataset_create_from_csr(
     data_type: int, nindptr: int, nelem: int, num_col: int, parameters: str,
     ref_id: int,
 ) -> int:
-    indptr = _read_array(indptr_ptr, nindptr, indptr_type).astype(np.int64)
-    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int64)
-    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
-    nrow = nindptr - 1
-    X = np.zeros((nrow, num_col), np.float64)
-    for r in range(nrow):
-        lo, hi = indptr[r], indptr[r + 1]
-        X[r, indices[lo:hi]] = data[lo:hi]
+    X = _csr_to_dense(
+        indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr,
+        nelem, num_col,
+    )
     params = _params_str_to_dict(parameters)
     ref = _datasets.get(ref_id) if ref_id else None
     ds = Dataset(X, params=params, reference=ref)
@@ -134,14 +130,10 @@ def dataset_create_from_csc(
     data_type: int, ncol_ptr: int, nelem: int, num_row: int, parameters: str,
     ref_id: int,
 ) -> int:
-    col_ptr = _read_array(col_ptr_ptr, ncol_ptr, col_ptr_type).astype(np.int64)
-    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int64)
-    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
-    ncol = ncol_ptr - 1
-    X = np.zeros((num_row, ncol), np.float64)
-    for c in range(ncol):
-        lo, hi = col_ptr[c], col_ptr[c + 1]
-        X[indices[lo:hi], c] = data[lo:hi]
+    X = _csc_to_dense(
+        col_ptr_ptr, col_ptr_type, indices_ptr, data_ptr, data_type, ncol_ptr,
+        nelem, num_row,
+    )
     params = _params_str_to_dict(parameters)
     ref = _datasets.get(ref_id) if ref_id else None
     ds = Dataset(X, params=params, reference=ref)
@@ -327,3 +319,525 @@ def booster_predict_for_file(
     with vopen(result_filename, "w") as fh:
         for row in out:
             fh.write("\t".join(repr(float(v)) for v in np.atleast_1d(row)) + "\n")
+
+# ---------------------------------------------------------------------------
+# Full-ABI surface (round 3): the 42 remaining c_api.h entry points
+# ---------------------------------------------------------------------------
+
+_STRSEP = "\x01"  # joins string lists across the C boundary (never in names)
+
+
+def _csr_to_dense(
+    indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr, nelem,
+    num_col,
+):
+    indptr = _read_array(indptr_ptr, nindptr, indptr_type).astype(np.int64)
+    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int64)
+    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
+    nrow = nindptr - 1
+    X = np.zeros((nrow, num_col), np.float64)
+    rows = np.repeat(np.arange(nrow), np.diff(indptr))
+    X[rows, indices] = data
+    return X
+
+
+def _csc_to_dense(
+    col_ptr_ptr, col_ptr_type, indices_ptr, data_ptr, data_type, ncol_ptr,
+    nelem, num_row,
+):
+    col_ptr = _read_array(col_ptr_ptr, ncol_ptr, col_ptr_type).astype(np.int64)
+    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int64)
+    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
+    ncol = ncol_ptr - 1
+    X = np.zeros((num_row, ncol), np.float64)
+    cols = np.repeat(np.arange(ncol), np.diff(col_ptr))
+    X[indices, cols] = data
+    return X
+
+
+def _register_dataset(ds) -> int:
+    did = next(_ids)
+    _datasets[did] = ds
+    if isinstance(ds, _PushDataset):
+        ds.did = did
+    return did
+
+
+class _PushDataset:
+    """Streaming two-round container behind LGBM_DatasetCreateByReference /
+    CreateFromSampledColumn + PushRows[ByCSR] (c_api.h:86-177). Rows arrive in
+    chunks; once num_total_row rows have landed the real Dataset is
+    constructed (the reference's DatasetLoader::ConstructFromSampleData +
+    FinishLoad flow) and REPLACES this object in the handle table, so the
+    caller's handle transparently becomes the finished Dataset. Metadata set
+    before the last chunk (the reference allocates metadata at create time and
+    accepts SetField at any point) is buffered and applied at finish.
+    """
+
+    def __init__(self, num_total_row: int, params: dict, reference=None,
+                 ncol: int = 0):
+        self.num_total_row = int(num_total_row)
+        self.params = params
+        self.reference = reference
+        self.ncol = ncol
+        self.X = None
+        self.pushed = 0
+        self.did = 0  # handle id, filled at registration
+        self._pending = {}  # field -> array, applied at finish
+
+    def _ensure(self, ncol: int):
+        if self.X is None:
+            self.ncol = ncol
+            self.X = np.zeros((self.num_total_row, ncol), np.float64)
+
+    def push(self, rows: np.ndarray, start_row: int):
+        self._ensure(rows.shape[1])
+        self.X[start_row:start_row + rows.shape[0]] = rows
+        self.pushed += rows.shape[0]
+        if self.pushed >= self.num_total_row:
+            self.finish()
+
+    def finish(self):
+        ds = Dataset(self.X, params=self.params, reference=self.reference)
+        for field, arr in self._pending.items():
+            ds.set_field(field, arr)
+        ds.construct()
+        if self.did:
+            _datasets[self.did] = ds  # handle now IS the finished Dataset
+
+    # pre-finish metadata (dataset_set_field dispatches to these)
+    def set_label(self, v):
+        self._pending["label"] = v
+
+    def set_weight(self, v):
+        self._pending["weight"] = v
+
+    def set_group(self, v):
+        self._pending["group"] = v
+
+    def set_init_score(self, v):
+        self._pending["init_score"] = v
+
+
+def dataset_create_by_reference(ref_id: int, num_total_row: int) -> int:
+    ref = _dataset(ref_id)
+    return _register_dataset(
+        _PushDataset(num_total_row, dict(getattr(ref, "params", {}) or {}),
+                     reference=ref)
+    )
+
+
+def dataset_create_from_sampled_column(
+    sample_data_pp: int, sample_indices_pp: int, ncol: int,
+    num_per_col_ptr: int, num_sample_row: int, num_total_row: int,
+    parameters: str,
+) -> int:
+    # double** / int** pointer tables (c_api.h:60-76). The sampled columns
+    # seed nothing here beyond shape checking: binning happens at finish()
+    # over the full pushed matrix, which subsumes the reference's
+    # sample-then-bin flow (BinMapper::FindBin over samples) with exact bins.
+    params = _params_str_to_dict(parameters)
+    ds = _PushDataset(num_total_row, params, ncol=ncol)
+    return _register_dataset(ds)
+
+
+def _push_target(did: int) -> _PushDataset:
+    ds = _datasets[did]
+    if not isinstance(ds, _PushDataset):
+        raise ValueError("DatasetHandle %d is not awaiting pushed rows" % did)
+    return ds
+
+
+def dataset_push_rows(
+    did: int, data_ptr: int, data_type: int, nrow: int, ncol: int,
+    start_row: int,
+) -> None:
+    rows = _read_array(data_ptr, nrow * ncol, data_type).astype(np.float64)
+    _push_target(did).push(rows.reshape(nrow, ncol), start_row)
+
+
+def dataset_push_rows_by_csr(
+    did: int, indptr_ptr: int, indptr_type: int, indices_ptr: int,
+    data_ptr: int, data_type: int, nindptr: int, nelem: int, num_col: int,
+    start_row: int,
+) -> None:
+    rows = _csr_to_dense(
+        indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr,
+        nelem, num_col,
+    )
+    _push_target(did).push(rows, start_row)
+
+
+def dataset_create_from_mats(
+    nmat: int, data_pp: int, data_type: int, nrow_ptr: int, ncol: int,
+    is_row_major: int, parameters: str, ref_id: int,
+) -> int:
+    ptrs = _read_array(data_pp, nmat, DTYPE_INT64)
+    nrows = _read_array(nrow_ptr, nmat, DTYPE_INT32)
+    mats = []
+    for p, nr in zip(ptrs, nrows):
+        arr = _read_array(int(p), int(nr) * ncol, data_type).astype(np.float64)
+        mats.append(
+            arr.reshape(int(nr), ncol) if is_row_major
+            else arr.reshape(ncol, int(nr)).T
+        )
+    X = np.concatenate(mats, axis=0)
+    params = _params_str_to_dict(parameters)
+    ref = _datasets.get(ref_id) if ref_id else None
+    ds = Dataset(X, params=params, reference=ref)
+    ds.construct()
+    return _register_dataset(ds)
+
+
+def dataset_get_subset(
+    did: int, indices_ptr: int, num_indices: int, parameters: str
+) -> int:
+    idx = _read_array(indices_ptr, num_indices, DTYPE_INT32)
+    params = _params_str_to_dict(parameters)
+    sub = _dataset(did).subset(idx, params=params or None)
+    # materialize eagerly (Dataset::CopySubset): the handle's GetNumData etc.
+    # read _binned directly
+    sub._binned = sub.construct_subset(Config.from_params(sub.params or {}))
+    return _register_dataset(sub)
+
+
+def dataset_add_features_from(target_id: int, source_id: int) -> None:
+    _dataset(target_id).add_features_from(_dataset(source_id))
+
+
+def dataset_dump_text(did: int, filename: str) -> None:
+    _dataset(did).dump_text(filename)
+
+
+def dataset_set_feature_names(did: int, joined: str) -> None:
+    _dataset(did).set_feature_name(joined.split(_STRSEP) if joined else [])
+
+
+def dataset_get_feature_names(did: int) -> str:
+    ds = _dataset(did)
+    names = getattr(ds, "feature_name", None)
+    if callable(names):
+        names = names()
+    if not names or names == "auto":
+        binned = getattr(ds, "_binned", None)
+        n = binned.num_total_features if binned is not None else 0
+        names = ["Column_%d" % i for i in range(n)]
+    return _STRSEP.join(names)
+
+
+def dataset_update_param(did: int, parameters: str) -> None:
+    ds = _dataset(did)
+    new = _params_str_to_dict(parameters)
+    cur = dict(getattr(ds, "params", {}) or {})
+    cur.update(new)
+    ds.params = cur
+
+
+def dataset_get_field_ptr(did: int, field_name: str):
+    """(addr, len, dtype_code) with the backing array kept alive on the
+    Dataset (LGBM_DatasetGetField returns a borrowed pointer, c_api.h:338)."""
+    ds = _dataset(did)
+    arr = dataset_get_field(did, field_name)
+    if arr is None:
+        return 0, 0, DTYPE_FLOAT32
+    if field_name in ("group", "query"):
+        # the reference returns the CUMULATIVE query boundaries as int32
+        arr = np.concatenate([[0], np.cumsum(np.asarray(arr, np.int64))])
+        arr = arr.astype(np.int32)
+        code = DTYPE_INT32
+    elif field_name == "init_score":
+        arr = np.ascontiguousarray(arr, np.float64)
+        code = DTYPE_FLOAT64
+    else:
+        arr = np.ascontiguousarray(arr, np.float32)
+        code = DTYPE_FLOAT32
+    if not hasattr(ds, "_capi_field_refs"):
+        ds._capi_field_refs = {}
+    ds._capi_field_refs[field_name] = arr  # keep the buffer alive
+    return int(arr.ctypes.data), int(arr.size), code
+
+
+# -- booster long tail ------------------------------------------------------
+
+
+def booster_load_model_from_string(model_str: str) -> Tuple[int, int]:
+    bst = Booster(model_str=model_str)
+    bid = next(_ids)
+    _boosters[bid] = _CBooster(bst)
+    return bid, int(bst.current_iteration)
+
+
+def booster_save_model_to_string(
+    bid: int, start_iteration: int, num_iteration: int
+) -> str:
+    return _boosters[bid].booster.model_to_string(
+        num_iteration=num_iteration, start_iteration=start_iteration
+    )
+
+
+def booster_dump_model(bid: int, start_iteration: int, num_iteration: int) -> str:
+    import json
+
+    d = _boosters[bid].booster.dump_model(num_iteration=num_iteration)
+    if start_iteration > 0:
+        K = _boosters[bid].booster.num_model_per_iteration()
+        d = dict(d)
+        d["tree_info"] = d.get("tree_info", [])[start_iteration * K:]
+    return json.dumps(d)
+
+
+def booster_merge(bid: int, other_bid: int) -> None:
+    _boosters[bid].booster._gbdt.merge_models_from(
+        _boosters[other_bid].booster._gbdt
+    )
+
+
+def booster_get_num_feature(bid: int) -> int:
+    return int(_boosters[bid].booster.num_feature())
+
+
+def booster_num_model_per_iteration(bid: int) -> int:
+    return int(_boosters[bid].booster.num_model_per_iteration())
+
+
+def booster_number_of_total_model(bid: int) -> int:
+    return int(_boosters[bid].booster.num_trees())
+
+
+def _metric_value_names(gbdt) -> list:
+    """Metric names in eval order, one per emitted value (rank metrics emit
+    name@k per eval position — matches booster_get_eval_counts)."""
+    out = []
+    for m in getattr(gbdt, "training_metrics", None) or []:
+        ks = getattr(m, "eval_at", None)
+        if ks:
+            out.extend("%s@%d" % (m.names[0], k) for k in ks)
+        else:
+            out.append(m.names[0])
+    return out
+
+
+def booster_get_eval_names(bid: int) -> str:
+    gbdt = getattr(_boosters[bid].booster, "_gbdt", None)
+    return _STRSEP.join(_metric_value_names(gbdt) if gbdt is not None else [])
+
+
+def booster_get_feature_names(bid: int) -> str:
+    return _STRSEP.join(_boosters[bid].booster.feature_name())
+
+
+def booster_get_leaf_value(bid: int, tree_idx: int, leaf_idx: int) -> float:
+    return float(_boosters[bid].booster.get_leaf_output(tree_idx, leaf_idx))
+
+
+def booster_set_leaf_value(
+    bid: int, tree_idx: int, leaf_idx: int, value: float
+) -> None:
+    gbdt = _boosters[bid].booster._gbdt
+    trees = gbdt.trees()  # materialize hosts
+    trees[tree_idx].leaf_value[leaf_idx] = value
+    # drop the device copy so prediction reads the edited host tree
+    if tree_idx < len(gbdt._device_trees):
+        _, cid = gbdt._device_trees[tree_idx]
+        gbdt._device_trees[tree_idx] = (None, cid)
+
+
+def booster_rollback_one_iter(bid: int) -> None:
+    _boosters[bid].booster.rollback_one_iter()
+
+
+def booster_reset_parameter(bid: int, parameters: str) -> None:
+    _boosters[bid].booster.reset_parameter(_params_str_to_dict(parameters))
+
+
+def booster_reset_training_data(bid: int, did: int) -> None:
+    # gbdt.cpp ResetTrainingData: keep the models, swap the training set.
+    cb = _boosters[bid]
+    old = cb.booster
+    nb = Booster(dict(old.params), _dataset(did))
+    if (
+        nb._gbdt.num_tree_per_iteration != old._gbdt.num_tree_per_iteration
+    ):
+        raise ValueError(
+            "Cannot reset training data: models-per-iteration mismatch"
+        )
+    nb._gbdt.merge_models_from(old._gbdt)
+    cb.booster = nb
+
+
+def booster_shuffle_models(bid: int, start_iter: int, end_iter: int) -> None:
+    _boosters[bid].booster.shuffle_models(start_iter, end_iter)
+
+
+def booster_update_one_iter_custom(
+    bid: int, grad_ptr: int, hess_ptr: int
+) -> int:
+    bst = _boosters[bid].booster
+    gbdt = bst._gbdt
+    n = gbdt.num_data * gbdt.num_tree_per_iteration
+    grad = _read_array(grad_ptr, n, DTYPE_FLOAT32)
+    hess = _read_array(hess_ptr, n, DTYPE_FLOAT32)
+    return 1 if gbdt.train_one_iter(grad, hess) else 0
+
+
+def booster_refit(bid: int, leaf_preds_ptr: int, nrow: int, ncol: int) -> None:
+    cb = _boosters[bid]
+    preds = _read_array(leaf_preds_ptr, nrow * ncol, DTYPE_INT32).reshape(
+        nrow, ncol
+    )
+    decay = getattr(cb.booster.config, "refit_decay_rate", 0.9)
+    cb.booster._gbdt.refit(preds, decay)
+
+
+def booster_calc_num_predict(
+    bid: int, num_row: int, predict_type: int, num_iteration: int
+) -> int:
+    bst = _boosters[bid].booster
+    K = bst.num_model_per_iteration()
+    total_iter = bst.current_iteration
+    it = total_iter if num_iteration <= 0 else min(num_iteration, total_iter)
+    if predict_type == PREDICT_LEAF_INDEX:
+        return num_row * K * it
+    if predict_type == PREDICT_CONTRIB:
+        return num_row * K * (bst.num_feature() + 1)
+    return num_row * K
+
+
+def booster_get_num_predict(bid: int, data_idx: int) -> int:
+    gbdt = _boosters[bid].booster._gbdt
+    if data_idx == 0:
+        n = gbdt.num_data
+    else:
+        n = gbdt.valid_sets[data_idx - 1].num_data
+    return int(n) * gbdt.num_tree_per_iteration
+
+
+def booster_get_predict(bid: int, data_idx: int, out_ptr: int) -> int:
+    # converted (post-objective) scores for train/valid rows
+    # (GBDT::GetPredictAt, gbdt.cpp)
+    bst = _boosters[bid].booster
+    gbdt = bst._gbdt
+    score = (
+        gbdt._train_score_np() if data_idx == 0 else gbdt._valid_score_np(data_idx - 1)
+    )
+    out = gbdt.objective.convert_output(score) if gbdt.objective is not None else score
+    out = np.ascontiguousarray(np.asarray(out, np.float64).T)  # row-major [N, K]
+    _write_doubles(out_ptr, out.reshape(-1))
+    return int(out.size)
+
+
+def _predict_into(
+    bid: int, X: np.ndarray, predict_type: int, num_iteration: int,
+    parameter: str, out_ptr: int,
+) -> int:
+    bst = _boosters[bid].booster
+    kw = dict(num_iteration=num_iteration)
+    if predict_type == PREDICT_RAW_SCORE:
+        out = bst.predict(X, raw_score=True, **kw)
+    elif predict_type == PREDICT_LEAF_INDEX:
+        out = bst.predict(X, pred_leaf=True, **kw)
+    elif predict_type == PREDICT_CONTRIB:
+        out = bst.predict(X, pred_contrib=True, **kw)
+    else:
+        out = bst.predict(X, **kw)
+    out = np.ascontiguousarray(out, np.float64)
+    _write_doubles(out_ptr, out)
+    return int(out.size)
+
+
+def booster_predict_for_csr(
+    bid: int, indptr_ptr: int, indptr_type: int, indices_ptr: int,
+    data_ptr: int, data_type: int, nindptr: int, nelem: int, num_col: int,
+    predict_type: int, num_iteration: int, parameter: str, out_ptr: int,
+) -> int:
+    X = _csr_to_dense(
+        indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr,
+        nelem, num_col,
+    )
+    return _predict_into(bid, X, predict_type, num_iteration, parameter, out_ptr)
+
+
+def booster_predict_for_csc(
+    bid: int, col_ptr_ptr: int, col_ptr_type: int, indices_ptr: int,
+    data_ptr: int, data_type: int, ncol_ptr: int, nelem: int, num_row: int,
+    predict_type: int, num_iteration: int, parameter: str, out_ptr: int,
+) -> int:
+    X = _csc_to_dense(
+        col_ptr_ptr, col_ptr_type, indices_ptr, data_ptr, data_type, ncol_ptr,
+        nelem, num_row,
+    )
+    return _predict_into(bid, X, predict_type, num_iteration, parameter, out_ptr)
+
+
+def booster_predict_for_mat_single_row(
+    bid: int, data_ptr: int, data_type: int, ncol: int, is_row_major: int,
+    predict_type: int, num_iteration: int, parameter: str, out_ptr: int,
+) -> int:
+    arr = _read_array(data_ptr, ncol, data_type).astype(np.float64)
+    return _predict_into(
+        bid, arr.reshape(1, ncol), predict_type, num_iteration, parameter,
+        out_ptr,
+    )
+
+
+def booster_predict_for_mats(
+    bid: int, data_pp: int, data_type: int, nrow: int, ncol: int,
+    predict_type: int, num_iteration: int, parameter: str, out_ptr: int,
+) -> int:
+    # one pointer per ROW (c_api.h:841-870)
+    ptrs = _read_array(data_pp, nrow, DTYPE_INT64)
+    X = np.empty((nrow, ncol), np.float64)
+    for i, p in enumerate(ptrs):
+        X[i] = _read_array(int(p), ncol, data_type).astype(np.float64)
+    return _predict_into(bid, X, predict_type, num_iteration, parameter, out_ptr)
+
+
+# -- network ----------------------------------------------------------------
+
+_network = {"num_machines": 1, "rank": 0}
+
+
+def network_init(
+    machines: str, local_listen_port: int, listen_time_out: int,
+    num_machines: int,
+) -> None:
+    """LGBM_NetworkInit (c_api.h:975). The reference brings up its socket
+    linker here; this framework's cross-host transport is the jax.distributed
+    runtime + XLA collectives (parallel/mesh.py), so the ABI call records the
+    topology and defers transport to the JAX runtime the same way
+    tests/test_multiprocess_dist.py drives it."""
+    _network.update(
+        machines=machines,
+        local_listen_port=int(local_listen_port),
+        num_machines=int(num_machines),
+    )
+
+
+def network_init_with_functions(
+    num_machines: int, rank: int, reduce_scatter_ptr: int, allgather_ptr: int
+) -> None:
+    # c_api.h:986: external collective functions. XLA owns the collectives
+    # here; the pointers are recorded for callers that query them back.
+    _network.update(
+        num_machines=int(num_machines),
+        rank=int(rank),
+        reduce_scatter_ext=reduce_scatter_ptr,
+        allgather_ext=allgather_ptr,
+    )
+
+
+def network_free() -> None:
+    _network.clear()
+    _network.update({"num_machines": 1, "rank": 0})
+
+
+def booster_feature_importance(
+    bid: int, num_iteration: int, importance_type: int, out_ptr: int
+) -> int:
+    # c_api.h:962: importance_type 0=split counts, 1=total gains
+    bst = _boosters[bid].booster
+    kind = "gain" if importance_type == 1 else "split"
+    vals = bst.feature_importance(importance_type=kind, iteration=num_iteration)
+    vals = np.ascontiguousarray(vals, np.float64)
+    _write_doubles(out_ptr, vals)
+    return int(vals.size)

@@ -121,6 +121,199 @@ int LGBM_BoosterPredictForFile(BoosterHandle handle, const char* data_filename,
                                int num_iteration, const char* parameter,
                                const char* result_filename);
 
+/* ------------------------------------------------------------------ */
+/* Dataset long tail (c_api.h:52-370)                                  */
+/* ------------------------------------------------------------------ */
+
+/* Empty dataset inheriting `reference`'s bin mappers; fill with PushRows
+ * (c_api.h:52). */
+int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                  int64_t num_total_row, DatasetHandle* out);
+
+/* Allocate from sampled columns; fill with PushRows (c_api.h:60). */
+int LGBM_DatasetCreateFromSampledColumn(double** sample_data,
+                                        int** sample_indices, int32_t ncol,
+                                        const int* num_per_col,
+                                        int32_t num_sample_row,
+                                        int32_t num_total_row,
+                                        const char* parameters,
+                                        DatasetHandle* out);
+
+/* Stream a dense row chunk at start_row; construction finishes when the last
+ * row lands (c_api.h:86). */
+int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                         int data_type, int32_t nrow, int32_t ncol,
+                         int32_t start_row);
+
+/* Stream a CSR chunk (c_api.h:99). */
+int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col,
+                              int64_t start_row);
+
+/* Bin several stacked matrices as one dataset (c_api.h:228). */
+int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data, int data_type,
+                               int32_t* nrow, int32_t ncol, int is_row_major,
+                               const char* parameters,
+                               const DatasetHandle reference,
+                               DatasetHandle* out);
+
+/* Bin rows produced by a C++ std::function row iterator (c_api.h:119). */
+int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr, int num_rows,
+                                  int64_t num_col, const char* parameters,
+                                  const DatasetHandle reference,
+                                  DatasetHandle* out);
+
+/* Row-subset view binned with the parent's mappers (c_api.h:251). */
+int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                          const int32_t* used_row_indices,
+                          int32_t num_used_row_indices, const char* parameters,
+                          DatasetHandle* out);
+
+/* Append source's features to target (c_api.h:355). */
+int LGBM_DatasetAddFeaturesFrom(DatasetHandle target, DatasetHandle source);
+
+int LGBM_DatasetDumpText(DatasetHandle handle, const char* filename);
+
+/* Feature names in/out (c_api.h:264-279). Caller allocates out buffers. */
+int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                const char** feature_names,
+                                int num_feature_names);
+int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
+                                int* num_feature_names);
+
+int LGBM_DatasetUpdateParam(DatasetHandle handle, const char* parameters);
+
+/* Borrowed pointer to a metadata field; group comes back as cumulative int32
+ * boundaries (c_api.h:338). */
+int LGBM_DatasetGetField(DatasetHandle handle, const char* field_name,
+                         int* out_len, const void** out_ptr, int* out_type);
+
+/* ------------------------------------------------------------------ */
+/* Booster long tail (c_api.h:392-972)                                 */
+/* ------------------------------------------------------------------ */
+
+int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                    int* out_num_iterations,
+                                    BoosterHandle* out);
+
+/* Two-call protocol: *out_len is the needed size incl. NUL; the string is
+ * copied only when buffer_len suffices (c_api.h:904). */
+int LGBM_BoosterSaveModelToString(BoosterHandle handle, int start_iteration,
+                                  int num_iteration, int64_t buffer_len,
+                                  int64_t* out_len, char* out_str);
+
+/* JSON dump, same two-call protocol (c_api.h:921). */
+int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                          int num_iteration, int64_t buffer_len,
+                          int64_t* out_len, char* out_str);
+
+/* Merge other_handle's trees into handle (c_api.h:412). */
+int LGBM_BoosterMerge(BoosterHandle handle, BoosterHandle other_handle);
+
+int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out_len);
+int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                     int* out_tree_per_iteration);
+int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle, int* out_models);
+
+/* Caller allocates out_strs[i] buffers (c_api.h:536-545). */
+int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                             char** out_strs);
+int LGBM_BoosterGetFeatureNames(BoosterHandle handle, int* out_len,
+                                char** out_strs);
+
+int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx, int leaf_idx,
+                             double* out_val);
+int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx, int leaf_idx,
+                             double val);
+
+/* Drop the last iteration's trees (c_api.h:515). */
+int LGBM_BoosterRollbackOneIter(BoosterHandle handle);
+
+int LGBM_BoosterResetParameter(BoosterHandle handle, const char* parameters);
+
+/* Swap the training set, keeping the models (c_api.h:425). */
+int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                  const DatasetHandle train_data);
+
+int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                              int end_iter);
+
+/* One boosting iteration from caller-supplied grad/hess of length
+ * num_data * num_class (c_api.h:505). */
+int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle, const float* grad,
+                                    const float* hess, int* is_finished);
+
+/* Recompute leaf values from a [nrow, num_trees] leaf assignment matrix
+ * (c_api.h:493). */
+int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                      int32_t nrow, int32_t ncol);
+
+/* Split-count (0) or total-gain (1) importance per feature (c_api.h:962);
+ * out_results must hold num_feature doubles. */
+int LGBM_BoosterFeatureImportance(BoosterHandle handle, int num_iteration,
+                                  int importance_type, double* out_results);
+
+/* Required out_result length for a predict call (c_api.h:608). */
+int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                               int predict_type, int num_iteration,
+                               int64_t* out_len);
+
+/* In-training predictions for data_idx (0=train, i=valid i) (c_api.h:556). */
+int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                              int64_t* out_len);
+int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                           int64_t* out_len, double* out_result);
+
+/* Sparse / multi-part predict family (c_api.h:641-870). */
+int LGBM_BoosterPredictForCSR(BoosterHandle handle, const void* indptr,
+                              int indptr_type, const int32_t* indices,
+                              const void* data, int data_type, int64_t nindptr,
+                              int64_t nelem, int64_t num_col, int predict_type,
+                              int num_iteration, const char* parameter,
+                              int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t nindptr,
+    int64_t nelem, int64_t num_col, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result);
+int LGBM_BoosterPredictForCSC(BoosterHandle handle, const void* col_ptr,
+                              int col_ptr_type, const int32_t* indices,
+                              const void* data, int data_type,
+                              int64_t ncol_ptr, int64_t nelem, int64_t num_row,
+                              int predict_type, int num_iteration,
+                              const char* parameter, int64_t* out_len,
+                              double* out_result);
+int LGBM_BoosterPredictForMatSingleRow(BoosterHandle handle, const void* data,
+                                       int data_type, int ncol,
+                                       int is_row_major, int predict_type,
+                                       int num_iteration,
+                                       const char* parameter, int64_t* out_len,
+                                       double* out_result);
+int LGBM_BoosterPredictForMats(BoosterHandle handle, const void** data,
+                               int data_type, int32_t nrow, int32_t ncol,
+                               int predict_type, int num_iteration,
+                               const char* parameter, int64_t* out_len,
+                               double* out_result);
+
+/* ------------------------------------------------------------------ */
+/* Network (c_api.h:975-998). Topology is recorded; transport is the   */
+/* jax.distributed runtime + XLA collectives (parallel/mesh.py).       */
+/* ------------------------------------------------------------------ */
+
+int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                     int listen_time_out, int num_machines);
+int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                  void* reduce_scatter_ext_fun,
+                                  void* allgather_ext_fun);
+int LGBM_NetworkFree();
+
+/* Set this thread's last-error message. The reference defines this as a
+ * header inline over a static buffer (c_api.h:1000); here it is a real
+ * export writing the same thread-local that LGBM_GetLastError reads. */
+void LGBM_SetLastError(const char* msg);
+
 #ifdef __cplusplus
 }
 #endif

@@ -371,3 +371,556 @@ LGBT_EXPORT int LGBM_BoosterPredictForFile(void* handle,
                              predict_type, num_iteration,
                              parameter ? parameter : "", result_filename));
 }
+
+// ---------------------------------------------------------------------------
+// Full-ABI surface (round 3): the remaining c_api.h entry points
+// ---------------------------------------------------------------------------
+
+#include <cstring>
+#include <functional>
+#include <utility>
+#include <vector>
+
+namespace {
+
+// copy a Python str result into the (buffer_len, out_len, out_str) protocol:
+// out_len always gets the total size incl. NUL; the copy happens only when
+// it fits (LGBM_BoosterSaveModelToString semantics, c_api.h:904)
+int string_call(PyObject* ret, int64_t buffer_len, int64_t* out_len,
+                char* out_str) {
+  if (ret == nullptr) return -1;
+  Py_ssize_t n = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(ret, &n);
+  if (s == nullptr) {
+    Py_DECREF(ret);
+    set_error_from_python();
+    return -1;
+  }
+  if (out_len != nullptr) *out_len = static_cast<int64_t>(n) + 1;
+  if (out_str != nullptr && buffer_len > n) {
+    std::memcpy(out_str, s, static_cast<size_t>(n) + 1);
+  }
+  Py_DECREF(ret);
+  return 0;
+}
+
+// split a '\x01'-joined Python str result into caller-allocated char* slots
+int strlist_call(PyObject* ret, int* out_len, char** out_strs) {
+  if (ret == nullptr) return -1;
+  Py_ssize_t n = 0;
+  const char* joined = PyUnicode_AsUTF8AndSize(ret, &n);
+  if (joined == nullptr) {
+    Py_DECREF(ret);
+    set_error_from_python();
+    return -1;
+  }
+  int count = 0;
+  if (n > 0) {
+    const char* p = joined;
+    const char* end = joined + n;
+    while (p <= end) {
+      const char* sep =
+          static_cast<const char*>(memchr(p, '\x01', static_cast<size_t>(end - p)));
+      const char* stop = sep ? sep : end;
+      if (out_strs != nullptr) {
+        std::memcpy(out_strs[count], p, static_cast<size_t>(stop - p));
+        out_strs[count][stop - p] = '\0';
+      }
+      ++count;
+      if (!sep) break;
+      p = sep + 1;
+    }
+  }
+  if (out_len != nullptr) *out_len = count;
+  Py_DECREF(ret);
+  return 0;
+}
+
+int int_out_call(PyObject* ret, int* out) {
+  if (ret == nullptr) return -1;
+  *out = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return 0;
+}
+
+int int64_out_call(PyObject* ret, int64_t* out) {
+  if (ret == nullptr) return -1;
+  *out = PyLong_AsLongLong(ret);
+  Py_DECREF(ret);
+  return 0;
+}
+
+}  // namespace
+
+// ---- Dataset --------------------------------------------------------------
+
+LGBT_EXPORT int LGBM_DatasetCreateByReference(const void* reference,
+                                              int64_t num_total_row,
+                                              void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_create_by_reference", "(LL)", as_id(reference),
+                static_cast<long long>(num_total_row)),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetCreateFromSampledColumn(
+    double** sample_data, int** sample_indices, int32_t ncol,
+    const int* num_per_col, int32_t num_sample_row, int32_t num_total_row,
+    const char* parameters, void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_create_from_sampled_column", "(LLiLiis)",
+                static_cast<long long>(reinterpret_cast<intptr_t>(sample_data)),
+                static_cast<long long>(reinterpret_cast<intptr_t>(sample_indices)),
+                ncol,
+                static_cast<long long>(reinterpret_cast<intptr_t>(num_per_col)),
+                num_sample_row, num_total_row, parameters ? parameters : ""),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetPushRows(void* dataset, const void* data,
+                                     int data_type, int32_t nrow, int32_t ncol,
+                                     int32_t start_row) {
+  Gil gil;
+  return void_call(call_impl(
+      "dataset_push_rows", "(LLiiii)", as_id(dataset),
+      static_cast<long long>(reinterpret_cast<intptr_t>(data)), data_type,
+      nrow, ncol, start_row));
+}
+
+LGBT_EXPORT int LGBM_DatasetPushRowsByCSR(void* dataset, const void* indptr,
+                                          int indptr_type,
+                                          const int32_t* indices,
+                                          const void* data, int data_type,
+                                          int64_t nindptr, int64_t nelem,
+                                          int64_t num_col, int64_t start_row) {
+  Gil gil;
+  return void_call(call_impl(
+      "dataset_push_rows_by_csr", "(LLiLLiLLLL)", as_id(dataset),
+      static_cast<long long>(reinterpret_cast<intptr_t>(indptr)), indptr_type,
+      static_cast<long long>(reinterpret_cast<intptr_t>(indices)),
+      static_cast<long long>(reinterpret_cast<intptr_t>(data)), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), static_cast<long long>(start_row)));
+}
+
+LGBT_EXPORT int LGBM_DatasetCreateFromMats(int32_t nmat, const void** data,
+                                           int data_type, int32_t* nrow,
+                                           int32_t ncol, int is_row_major,
+                                           const char* parameters,
+                                           const void* reference, void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_create_from_mats", "(iLiLiisL)", nmat,
+                static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+                data_type,
+                static_cast<long long>(reinterpret_cast<intptr_t>(nrow)),
+                ncol, is_row_major, parameters ? parameters : "",
+                as_id(reference)),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr,
+                                              int num_rows, int64_t num_col,
+                                              const char* parameters,
+                                              const void* reference,
+                                              void** out) {
+  // The funptr is a std::function<void(int, std::vector<std::pair<int,
+  // double>>&)>* (c_api.cpp's convention) — only callable from C++, so rows
+  // are densified here and handed to the matrix path.
+  using RowFn = std::function<void(int, std::vector<std::pair<int, double>>&)>;
+  RowFn& get_row = *static_cast<RowFn*>(get_row_funptr);
+  std::vector<double> dense(static_cast<size_t>(num_rows) *
+                            static_cast<size_t>(num_col), 0.0);
+  std::vector<std::pair<int, double>> row;
+  for (int i = 0; i < num_rows; ++i) {
+    row.clear();
+    get_row(i, row);
+    for (const auto& kv : row) {
+      dense[static_cast<size_t>(i) * num_col + kv.first] = kv.second;
+    }
+  }
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_create_from_mat", "(LiiiisL)",
+                static_cast<long long>(reinterpret_cast<intptr_t>(dense.data())),
+                1 /* float64 */, num_rows, static_cast<int>(num_col),
+                1 /* row major */, parameters ? parameters : "",
+                as_id(reference)),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetGetSubset(const void* handle,
+                                      const int32_t* used_row_indices,
+                                      int32_t num_used_row_indices,
+                                      const char* parameters, void** out) {
+  Gil gil;
+  return handle_call_out(
+      call_impl("dataset_get_subset", "(LLis)", as_id(handle),
+                static_cast<long long>(
+                    reinterpret_cast<intptr_t>(used_row_indices)),
+                num_used_row_indices, parameters ? parameters : ""),
+      out);
+}
+
+LGBT_EXPORT int LGBM_DatasetAddFeaturesFrom(void* target, void* source) {
+  Gil gil;
+  return void_call(call_impl("dataset_add_features_from", "(LL)",
+                             as_id(target), as_id(source)));
+}
+
+LGBT_EXPORT int LGBM_DatasetDumpText(void* handle, const char* filename) {
+  Gil gil;
+  return void_call(
+      call_impl("dataset_dump_text", "(Ls)", as_id(handle), filename));
+}
+
+LGBT_EXPORT int LGBM_DatasetSetFeatureNames(void* handle,
+                                            const char** feature_names,
+                                            int num_feature_names) {
+  Gil gil;
+  std::string joined;
+  for (int i = 0; i < num_feature_names; ++i) {
+    if (i) joined += '\x01';
+    joined += feature_names[i];
+  }
+  return void_call(call_impl("dataset_set_feature_names", "(Ls)",
+                             as_id(handle), joined.c_str()));
+}
+
+LGBT_EXPORT int LGBM_DatasetGetFeatureNames(void* handle, char** feature_names,
+                                            int* num_feature_names) {
+  Gil gil;
+  return strlist_call(
+      call_impl("dataset_get_feature_names", "(L)", as_id(handle)),
+      num_feature_names, feature_names);
+}
+
+LGBT_EXPORT int LGBM_DatasetUpdateParam(void* handle, const char* parameters) {
+  Gil gil;
+  return void_call(call_impl("dataset_update_param", "(Ls)", as_id(handle),
+                             parameters ? parameters : ""));
+}
+
+LGBT_EXPORT int LGBM_DatasetGetField(void* handle, const char* field_name,
+                                     int* out_len, const void** out_ptr,
+                                     int* out_type) {
+  Gil gil;
+  PyObject* r =
+      call_impl("dataset_get_field_ptr", "(Ls)", as_id(handle), field_name);
+  if (r == nullptr) return -1;
+  long long addr = 0;
+  int len = 0, type_code = 0;
+  if (!PyArg_ParseTuple(r, "Lii", &addr, &len, &type_code)) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_ptr = reinterpret_cast<const void*>(static_cast<intptr_t>(addr));
+  *out_len = len;
+  *out_type = type_code;
+  return 0;
+}
+
+// ---- Booster --------------------------------------------------------------
+
+LGBT_EXPORT int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                                int* out_num_iterations,
+                                                void** out) {
+  Gil gil;
+  PyObject* r = call_impl("booster_load_model_from_string", "(s)", model_str);
+  if (r == nullptr) return -1;
+  long long id = 0;
+  int iters = 0;
+  if (!PyArg_ParseTuple(r, "Li", &id, &iters)) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(r);
+  *out = id_to_handle(id);
+  if (out_num_iterations != nullptr) *out_num_iterations = iters;
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterSaveModelToString(void* handle,
+                                              int start_iteration,
+                                              int num_iteration,
+                                              int64_t buffer_len,
+                                              int64_t* out_len,
+                                              char* out_str) {
+  Gil gil;
+  return string_call(call_impl("booster_save_model_to_string", "(Lii)",
+                               as_id(handle), start_iteration, num_iteration),
+                     buffer_len, out_len, out_str);
+}
+
+LGBT_EXPORT int LGBM_BoosterDumpModel(void* handle, int start_iteration,
+                                      int num_iteration, int64_t buffer_len,
+                                      int64_t* out_len, char* out_str) {
+  Gil gil;
+  return string_call(call_impl("booster_dump_model", "(Lii)", as_id(handle),
+                               start_iteration, num_iteration),
+                     buffer_len, out_len, out_str);
+}
+
+LGBT_EXPORT int LGBM_BoosterMerge(void* handle, void* other_handle) {
+  Gil gil;
+  return void_call(
+      call_impl("booster_merge", "(LL)", as_id(handle), as_id(other_handle)));
+}
+
+LGBT_EXPORT int LGBM_BoosterGetNumFeature(void* handle, int* out_len) {
+  Gil gil;
+  return int_out_call(call_impl("booster_get_num_feature", "(L)", as_id(handle)),
+                      out_len);
+}
+
+LGBT_EXPORT int LGBM_BoosterNumModelPerIteration(void* handle,
+                                                 int* out_tree_per_iteration) {
+  Gil gil;
+  return int_out_call(
+      call_impl("booster_num_model_per_iteration", "(L)", as_id(handle)),
+      out_tree_per_iteration);
+}
+
+LGBT_EXPORT int LGBM_BoosterNumberOfTotalModel(void* handle, int* out_models) {
+  Gil gil;
+  return int_out_call(
+      call_impl("booster_number_of_total_model", "(L)", as_id(handle)),
+      out_models);
+}
+
+LGBT_EXPORT int LGBM_BoosterGetEvalNames(void* handle, int* out_len,
+                                         char** out_strs) {
+  Gil gil;
+  return strlist_call(call_impl("booster_get_eval_names", "(L)", as_id(handle)),
+                      out_len, out_strs);
+}
+
+LGBT_EXPORT int LGBM_BoosterGetFeatureNames(void* handle, int* out_len,
+                                            char** out_strs) {
+  Gil gil;
+  return strlist_call(
+      call_impl("booster_get_feature_names", "(L)", as_id(handle)), out_len,
+      out_strs);
+}
+
+LGBT_EXPORT int LGBM_BoosterGetLeafValue(void* handle, int tree_idx,
+                                         int leaf_idx, double* out_val) {
+  Gil gil;
+  PyObject* r = call_impl("booster_get_leaf_value", "(Lii)", as_id(handle),
+                          tree_idx, leaf_idx);
+  if (r == nullptr) return -1;
+  *out_val = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterSetLeafValue(void* handle, int tree_idx,
+                                         int leaf_idx, double val) {
+  Gil gil;
+  return void_call(call_impl("booster_set_leaf_value", "(Liid)", as_id(handle),
+                             tree_idx, leaf_idx, val));
+}
+
+LGBT_EXPORT int LGBM_BoosterRollbackOneIter(void* handle) {
+  Gil gil;
+  return void_call(call_impl("booster_rollback_one_iter", "(L)", as_id(handle)));
+}
+
+LGBT_EXPORT int LGBM_BoosterResetParameter(void* handle,
+                                           const char* parameters) {
+  Gil gil;
+  return void_call(call_impl("booster_reset_parameter", "(Ls)", as_id(handle),
+                             parameters ? parameters : ""));
+}
+
+LGBT_EXPORT int LGBM_BoosterResetTrainingData(void* handle,
+                                              const void* train_data) {
+  Gil gil;
+  return void_call(call_impl("booster_reset_training_data", "(LL)",
+                             as_id(handle), as_id(train_data)));
+}
+
+LGBT_EXPORT int LGBM_BoosterShuffleModels(void* handle, int start_iter,
+                                          int end_iter) {
+  Gil gil;
+  return void_call(call_impl("booster_shuffle_models", "(Lii)", as_id(handle),
+                             start_iter, end_iter));
+}
+
+LGBT_EXPORT int LGBM_BoosterUpdateOneIterCustom(void* handle,
+                                                const float* grad,
+                                                const float* hess,
+                                                int* is_finished) {
+  Gil gil;
+  PyObject* r = call_impl(
+      "booster_update_one_iter_custom", "(LLL)", as_id(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(grad)),
+      static_cast<long long>(reinterpret_cast<intptr_t>(hess)));
+  if (r == nullptr) return -1;
+  *is_finished = static_cast<int>(PyLong_AsLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBT_EXPORT int LGBM_BoosterRefit(void* handle, const int32_t* leaf_preds,
+                                  int32_t nrow, int32_t ncol) {
+  Gil gil;
+  return void_call(call_impl(
+      "booster_refit", "(LLii)", as_id(handle),
+      static_cast<long long>(reinterpret_cast<intptr_t>(leaf_preds)), nrow,
+      ncol));
+}
+
+LGBT_EXPORT int LGBM_BoosterCalcNumPredict(void* handle, int num_row,
+                                           int predict_type, int num_iteration,
+                                           int64_t* out_len) {
+  Gil gil;
+  return int64_out_call(
+      call_impl("booster_calc_num_predict", "(Liii)", as_id(handle), num_row,
+                predict_type, num_iteration),
+      out_len);
+}
+
+LGBT_EXPORT int LGBM_BoosterGetNumPredict(void* handle, int data_idx,
+                                          int64_t* out_len) {
+  Gil gil;
+  return int64_out_call(
+      call_impl("booster_get_num_predict", "(Li)", as_id(handle), data_idx),
+      out_len);
+}
+
+LGBT_EXPORT int LGBM_BoosterGetPredict(void* handle, int data_idx,
+                                       int64_t* out_len, double* out_result) {
+  Gil gil;
+  return int64_out_call(
+      call_impl("booster_get_predict", "(LiL)", as_id(handle), data_idx,
+                static_cast<long long>(reinterpret_cast<intptr_t>(out_result))),
+      out_len);
+}
+
+LGBT_EXPORT int LGBM_BoosterPredictForCSR(
+    void* handle, const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  Gil gil;
+  return int64_out_call(
+      call_impl("booster_predict_for_csr", "(LLiLLiLLLiisL)", as_id(handle),
+                static_cast<long long>(reinterpret_cast<intptr_t>(indptr)),
+                indptr_type,
+                static_cast<long long>(reinterpret_cast<intptr_t>(indices)),
+                static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+                data_type, static_cast<long long>(nindptr),
+                static_cast<long long>(nelem), static_cast<long long>(num_col),
+                predict_type, num_iteration, parameter ? parameter : "",
+                static_cast<long long>(reinterpret_cast<intptr_t>(out_result))),
+      out_len);
+}
+
+LGBT_EXPORT int LGBM_BoosterPredictForCSRSingleRow(
+    void* handle, const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  // single-row fast path shares the CSR implementation (the reference splits
+  // them only to reuse a thread-local buffer, c_api.h:753)
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
+                                   data_type, nindptr, nelem, num_col,
+                                   predict_type, num_iteration, parameter,
+                                   out_len, out_result);
+}
+
+LGBT_EXPORT int LGBM_BoosterPredictForCSC(
+    void* handle, const void* col_ptr, int col_ptr_type,
+    const int32_t* indices, const void* data, int data_type, int64_t ncol_ptr,
+    int64_t nelem, int64_t num_row, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  Gil gil;
+  return int64_out_call(
+      call_impl("booster_predict_for_csc", "(LLiLLiLLLiisL)", as_id(handle),
+                static_cast<long long>(reinterpret_cast<intptr_t>(col_ptr)),
+                col_ptr_type,
+                static_cast<long long>(reinterpret_cast<intptr_t>(indices)),
+                static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+                data_type, static_cast<long long>(ncol_ptr),
+                static_cast<long long>(nelem), static_cast<long long>(num_row),
+                predict_type, num_iteration, parameter ? parameter : "",
+                static_cast<long long>(reinterpret_cast<intptr_t>(out_result))),
+      out_len);
+}
+
+LGBT_EXPORT int LGBM_BoosterPredictForMatSingleRow(
+    void* handle, const void* data, int data_type, int ncol, int is_row_major,
+    int predict_type, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  Gil gil;
+  return int64_out_call(
+      call_impl("booster_predict_for_mat_single_row", "(LLiiiiisL)",
+                as_id(handle),
+                static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+                data_type, ncol, is_row_major, predict_type, num_iteration,
+                parameter ? parameter : "",
+                static_cast<long long>(reinterpret_cast<intptr_t>(out_result))),
+      out_len);
+}
+
+LGBT_EXPORT int LGBM_BoosterPredictForMats(
+    void* handle, const void** data, int data_type, int32_t nrow, int32_t ncol,
+    int predict_type, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  Gil gil;
+  return int64_out_call(
+      call_impl("booster_predict_for_mats", "(LLiiiiisL)", as_id(handle),
+                static_cast<long long>(reinterpret_cast<intptr_t>(data)),
+                data_type, nrow, ncol, predict_type, num_iteration,
+                parameter ? parameter : "",
+                static_cast<long long>(reinterpret_cast<intptr_t>(out_result))),
+      out_len);
+}
+
+// ---- Network --------------------------------------------------------------
+
+LGBT_EXPORT int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                                 int listen_time_out, int num_machines) {
+  Gil gil;
+  return void_call(call_impl("network_init", "(siii)",
+                             machines ? machines : "", local_listen_port,
+                             listen_time_out, num_machines));
+}
+
+LGBT_EXPORT int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                              void* reduce_scatter_ext_fun,
+                                              void* allgather_ext_fun) {
+  Gil gil;
+  return void_call(call_impl(
+      "network_init_with_functions", "(iiLL)", num_machines, rank,
+      static_cast<long long>(reinterpret_cast<intptr_t>(reduce_scatter_ext_fun)),
+      static_cast<long long>(reinterpret_cast<intptr_t>(allgather_ext_fun))));
+}
+
+LGBT_EXPORT int LGBM_NetworkFree() {
+  Gil gil;
+  return void_call(call_impl("network_free", "()"));
+}
+
+LGBT_EXPORT void LGBM_SetLastError(const char* msg) {
+  g_last_error = msg ? msg : "";
+}
+
+LGBT_EXPORT int LGBM_BoosterFeatureImportance(void* handle, int num_iteration,
+                                              int importance_type,
+                                              double* out_results) {
+  Gil gil;
+  PyObject* r = call_impl(
+      "booster_feature_importance", "(LiiL)", as_id(handle), num_iteration,
+      importance_type,
+      static_cast<long long>(reinterpret_cast<intptr_t>(out_results)));
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}

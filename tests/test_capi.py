@@ -266,3 +266,357 @@ def test_get_last_error_reports():
     assert rc == -1
     msg = LIB.LGBM_GetLastError().decode()
     assert "missing" in msg or "No such" in msg or "not" in msg.lower()
+
+
+# ---------------------------------------------------------------------------
+# Round 3: export parity + the full ABI long tail
+# ---------------------------------------------------------------------------
+
+REF_HEADER = "/root/reference/include/LightGBM/c_api.h"
+OUR_HEADER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "lightgbm_tpu", "native", "lgbt_c_api.h",
+)
+
+
+def test_export_parity_with_reference_header():
+    """Every LGBM_* symbol in the reference header resolves in our .so and is
+    declared in our shipped header (VERDICT round-2 item 4's done-check)."""
+    import re
+
+    if not os.path.exists(REF_HEADER):
+        pytest.skip("reference header not mounted")
+    ref_syms = set(re.findall(r"\bLGBM_[A-Za-z0-9_]+", open(REF_HEADER).read()))
+    our_decls = set(re.findall(r"\bLGBM_[A-Za-z0-9_]+", open(OUR_HEADER).read()))
+    missing_decl = sorted(ref_syms - our_decls)
+    assert not missing_decl, "header missing: %s" % missing_decl
+    for sym in sorted(ref_syms):
+        getattr(LIB, sym)  # raises AttributeError if not exported
+
+
+def _train_small(n=400, f=5, params="objective=binary metric=auc verbosity=-1",
+                 iters=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = _from_mat(X, y, "max_bin=63")
+    bst = ctypes.c_void_p()
+    _check(LIB.LGBM_BoosterCreate(ds, c_str(params), ctypes.byref(bst)))
+    fin = ctypes.c_int()
+    for _ in range(iters):
+        _check(LIB.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    return X, y, ds, bst
+
+
+def test_model_string_roundtrip_and_dump():
+    X, y, ds, bst = _train_small()
+    # two-call SaveModelToString protocol
+    need = ctypes.c_int64()
+    _check(LIB.LGBM_BoosterSaveModelToString(bst, 0, -1, 0, ctypes.byref(need), None))
+    assert need.value > 100
+    buf = ctypes.create_string_buffer(need.value)
+    _check(LIB.LGBM_BoosterSaveModelToString(bst, 0, -1, need.value, ctypes.byref(need), buf))
+    model_str = buf.value.decode()
+    assert model_str.startswith("tree")
+
+    out_iters = ctypes.c_int()
+    bst2 = ctypes.c_void_p()
+    _check(LIB.LGBM_BoosterLoadModelFromString(c_str(model_str), ctypes.byref(out_iters), ctypes.byref(bst2)))
+    assert out_iters.value == 5
+
+    # identical predictions from the loaded model
+    out_len = ctypes.c_int64()
+    p1 = np.zeros(len(X), np.float64)
+    p2 = np.zeros(len(X), np.float64)
+    flat = np.ascontiguousarray(X, np.float64)
+    for h, p in ((bst, p1), (bst2, p2)):
+        _check(LIB.LGBM_BoosterPredictForMat(
+            h, flat.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            X.shape[0], X.shape[1], 1, C_API_PREDICT_NORMAL, -1, c_str(""),
+            ctypes.byref(out_len), p.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        ))
+    np.testing.assert_array_equal(p1, p2)
+
+    # JSON dump
+    _check(LIB.LGBM_BoosterDumpModel(bst, 0, -1, 0, ctypes.byref(need), None))
+    buf = ctypes.create_string_buffer(need.value)
+    _check(LIB.LGBM_BoosterDumpModel(bst, 0, -1, need.value, ctypes.byref(need), buf))
+    import json
+
+    d = json.loads(buf.value.decode())
+    assert d["num_tree_per_iteration"] == 1 and len(d["tree_info"]) == 5
+    _check(LIB.LGBM_BoosterFree(bst2))
+
+
+def test_booster_counts_names_and_leaf_access():
+    X, y, ds, bst = _train_small()
+    n = ctypes.c_int()
+    _check(LIB.LGBM_BoosterGetNumFeature(bst, ctypes.byref(n)))
+    assert n.value == X.shape[1]
+    _check(LIB.LGBM_BoosterNumModelPerIteration(bst, ctypes.byref(n)))
+    assert n.value == 1
+    _check(LIB.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(n)))
+    assert n.value == 5
+
+    # eval names match eval counts
+    cnt = ctypes.c_int()
+    _check(LIB.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(cnt)))
+    bufs = [ctypes.create_string_buffer(64) for _ in range(max(cnt.value, 1))]
+    arr = (ctypes.c_char_p * len(bufs))(*[ctypes.addressof(b) for b in bufs])
+    _check(LIB.LGBM_BoosterGetEvalNames(bst, ctypes.byref(n), ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p))))
+    assert n.value == cnt.value == 1
+    assert bufs[0].value.decode() == "auc"
+
+    # feature names
+    bufs = [ctypes.create_string_buffer(64) for _ in range(X.shape[1])]
+    arr = (ctypes.c_char_p * len(bufs))(*[ctypes.addressof(b) for b in bufs])
+    _check(LIB.LGBM_BoosterGetFeatureNames(bst, ctypes.byref(n), ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p))))
+    assert n.value == X.shape[1]
+    assert bufs[0].value.decode() == "Column_0"
+
+    # leaf get/set round-trip changes predictions
+    v = ctypes.c_double()
+    _check(LIB.LGBM_BoosterGetLeafValue(bst, 0, 0, ctypes.byref(v)))
+    _check(LIB.LGBM_BoosterSetLeafValue(bst, 0, 0, ctypes.c_double(v.value + 1.0)))
+    v2 = ctypes.c_double()
+    _check(LIB.LGBM_BoosterGetLeafValue(bst, 0, 0, ctypes.byref(v2)))
+    assert abs(v2.value - (v.value + 1.0)) < 1e-12
+
+
+def test_rollback_merge_shuffle_reset():
+    X, y, ds, bst = _train_small()
+    n = ctypes.c_int()
+    _check(LIB.LGBM_BoosterRollbackOneIter(bst))
+    _check(LIB.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(n)))
+    assert n.value == 4
+
+    # merge: other's trees land on top
+    X2, y2, ds2, bst2 = _train_small(seed=7, iters=2)
+    _check(LIB.LGBM_BoosterMerge(bst, bst2))
+    _check(LIB.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(n)))
+    assert n.value == 6
+
+    _check(LIB.LGBM_BoosterShuffleModels(bst, 0, -1))
+    _check(LIB.LGBM_BoosterResetParameter(bst, c_str("learning_rate=0.2")))
+
+    # reset training data keeps the models
+    rng = np.random.RandomState(11)
+    X3 = rng.randn(300, X.shape[1])
+    y3 = (X3[:, 0] > 0).astype(np.float32)
+    ds3 = _from_mat(X3, y3, "max_bin=63")
+    _check(LIB.LGBM_BoosterResetTrainingData(bst, ds3))
+    _check(LIB.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(n)))
+    assert n.value == 6
+    fin = ctypes.c_int()
+    _check(LIB.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+    _check(LIB.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(n)))
+    assert n.value == 7
+
+
+def test_update_one_iter_custom_matches_builtin_binary():
+    """UpdateOneIterCustom with hand-computed binary logloss grad/hess runs
+    and trains (c_api.h:505; reference test_.py test_booster)."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(500, 4)
+    y = (X[:, 0] + 0.3 * rng.randn(500) > 0).astype(np.float32)
+    ds = _from_mat(X, y, "max_bin=63")
+    bst = ctypes.c_void_p()
+    _check(LIB.LGBM_BoosterCreate(ds, c_str("objective=none verbosity=-1 boost_from_average=false"), ctypes.byref(bst)))
+    out_len = ctypes.c_int64()
+    flat = np.ascontiguousarray(X, np.float64)
+    score = np.zeros(len(X), np.float64)
+    fin = ctypes.c_int()
+    for _ in range(8):
+        p = 1.0 / (1.0 + np.exp(-score))
+        grad = (p - y).astype(np.float32)
+        hess = (p * (1 - p)).astype(np.float32) + 1e-6
+        _check(LIB.LGBM_BoosterUpdateOneIterCustom(
+            bst, grad.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            hess.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.byref(fin)))
+        score = np.zeros(len(X), np.float64)
+        _check(LIB.LGBM_BoosterPredictForMat(
+            bst, flat.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            X.shape[0], X.shape[1], 1, 1, -1, c_str(""),
+            ctypes.byref(out_len), score.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    auc = _simple_auc(y, score)
+    assert auc > 0.9, auc
+
+
+def _simple_auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s)); ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0
+    n1, n0 = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n1 * (n1 + 1) / 2) / (n1 * n0)
+
+
+def test_sparse_predict_family_matches_dense():
+    X, y, ds, bst = _train_small(n=300, f=6)
+    flat = np.ascontiguousarray(X, np.float64)
+    out_len = ctypes.c_int64()
+    dense = np.zeros(len(X), np.float64)
+    _check(LIB.LGBM_BoosterPredictForMat(
+        bst, flat.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+        X.shape[0], X.shape[1], 1, C_API_PREDICT_NORMAL, -1, c_str(""),
+        ctypes.byref(out_len), dense.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+
+    # CSR
+    from scipy import sparse as sps  # scipy ships with the image (sklearn dep)
+
+    csr = sps.csr_matrix(X)
+    out = np.zeros(len(X), np.float64)
+    _check(LIB.LGBM_BoosterPredictForCSR(
+        bst, csr.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        C_API_DTYPE_INT32,
+        csr.indices.astype(np.int32).ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        csr.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+        C_API_DTYPE_FLOAT64, ctypes.c_int64(len(csr.indptr)), ctypes.c_int64(csr.nnz), ctypes.c_int64(X.shape[1]),
+        C_API_PREDICT_NORMAL, -1, c_str(""), ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(out, dense, rtol=1e-12)
+
+    # CSC
+    csc = sps.csc_matrix(X)
+    out = np.zeros(len(X), np.float64)
+    _check(LIB.LGBM_BoosterPredictForCSC(
+        bst, csc.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        C_API_DTYPE_INT32,
+        csc.indices.astype(np.int32).ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        csc.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+        C_API_DTYPE_FLOAT64, ctypes.c_int64(len(csc.indptr)), ctypes.c_int64(csc.nnz), ctypes.c_int64(X.shape[0]),
+        C_API_PREDICT_NORMAL, -1, c_str(""), ctypes.byref(out_len),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(out, dense, rtol=1e-12)
+
+    # single row (mat + CSR)
+    row = np.ascontiguousarray(X[7], np.float64)
+    out1 = np.zeros(1, np.float64)
+    _check(LIB.LGBM_BoosterPredictForMatSingleRow(
+        bst, row.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+        X.shape[1], 1, C_API_PREDICT_NORMAL, -1, c_str(""),
+        ctypes.byref(out_len), out1.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert abs(out1[0] - dense[7]) < 1e-12
+    r = sps.csr_matrix(X[7:8])
+    out1 = np.zeros(1, np.float64)
+    _check(LIB.LGBM_BoosterPredictForCSRSingleRow(
+        bst, r.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+        C_API_DTYPE_INT32,
+        r.indices.astype(np.int32).ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        r.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+        C_API_DTYPE_FLOAT64, ctypes.c_int64(len(r.indptr)), ctypes.c_int64(r.nnz), ctypes.c_int64(X.shape[1]),
+        C_API_PREDICT_NORMAL, -1, c_str(""), ctypes.byref(out_len),
+        out1.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert abs(out1[0] - dense[7]) < 1e-12
+
+    # Mats: one pointer per row
+    rows = [np.ascontiguousarray(X[i], np.float64) for i in range(5)]
+    ptrs = (ctypes.c_void_p * 5)(*[r.ctypes.data_as(ctypes.c_void_p).value for r in rows])
+    out5 = np.zeros(5, np.float64)
+    _check(LIB.LGBM_BoosterPredictForMats(
+        bst, ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+        C_API_DTYPE_FLOAT64, 5, X.shape[1], C_API_PREDICT_NORMAL, -1,
+        c_str(""), ctypes.byref(out_len),
+        out5.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    np.testing.assert_allclose(out5, dense[:5], rtol=1e-12)
+
+    # CalcNumPredict / GetNumPredict / GetPredict
+    need = ctypes.c_int64()
+    _check(LIB.LGBM_BoosterCalcNumPredict(bst, 10, C_API_PREDICT_NORMAL, -1, ctypes.byref(need)))
+    assert need.value == 10
+    _check(LIB.LGBM_BoosterGetNumPredict(bst, 0, ctypes.byref(need)))
+    assert need.value == len(X)
+    outp = np.zeros(len(X), np.float64)
+    _check(LIB.LGBM_BoosterGetPredict(bst, 0, ctypes.byref(need),
+                                      outp.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    assert need.value == len(X) and 0 <= outp.min() and outp.max() <= 1
+
+
+def test_refit_via_abi():
+    X, y, ds, bst = _train_small(n=300, f=4, iters=3)
+    out_len = ctypes.c_int64()
+    n_trees = 3
+    leaves = np.zeros(len(X) * n_trees, np.float64)
+    flat = np.ascontiguousarray(X, np.float64)
+    _check(LIB.LGBM_BoosterPredictForMat(
+        bst, flat.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+        X.shape[0], X.shape[1], 1, 2, -1, c_str(""),  # predict_type=2 leaf
+        ctypes.byref(out_len), leaves.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+    lp = leaves.reshape(len(X), n_trees).astype(np.int32)
+    _check(LIB.LGBM_BoosterRefit(
+        bst, lp.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(X), n_trees))
+    n = ctypes.c_int()
+    _check(LIB.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(n)))
+    assert n.value == 3
+
+
+def test_dataset_long_tail(tmp_path):
+    rng = np.random.RandomState(2)
+    X = rng.randn(200, 4)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = _from_mat(X, y, "max_bin=31")
+
+    # feature names round trip
+    names = [b"f_one", b"f_two", b"f_three", b"f_four"]
+    arr_in = (ctypes.c_char_p * 4)(*names)
+    _check(LIB.LGBM_DatasetSetFeatureNames(ds, arr_in, 4))
+    bufs = [ctypes.create_string_buffer(64) for _ in range(4)]
+    arr = (ctypes.c_char_p * 4)(*[ctypes.addressof(b) for b in bufs])
+    n = ctypes.c_int()
+    _check(LIB.LGBM_DatasetGetFeatureNames(ds, ctypes.cast(arr, ctypes.POINTER(ctypes.c_char_p)), ctypes.byref(n)))
+    assert n.value == 4 and bufs[1].value == b"f_two"
+
+    # GetField: label comes back as float32
+    ptr = ctypes.c_void_p(); ftype = ctypes.c_int()
+    _check(LIB.LGBM_DatasetGetField(ds, c_str("label"), ctypes.byref(n), ctypes.byref(ptr), ctypes.byref(ftype)))
+    assert n.value == 200 and ftype.value == C_API_DTYPE_FLOAT32
+    lab = np.ctypeslib.as_array(ctypes.cast(ptr, ctypes.POINTER(ctypes.c_float)), shape=(200,))
+    np.testing.assert_array_equal(lab, y)
+
+    # subset
+    idx = np.arange(0, 100, dtype=np.int32)
+    sub = ctypes.c_void_p()
+    _check(LIB.LGBM_DatasetGetSubset(ds, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), 100, c_str(""), ctypes.byref(sub)))
+    _check(LIB.LGBM_DatasetGetNumData(sub, ctypes.byref(n)))
+    assert n.value == 100
+
+    # dump text
+    _check(LIB.LGBM_DatasetDumpText(ds, c_str(str(tmp_path / "dump.txt"))))
+    assert (tmp_path / "dump.txt").exists()
+
+    # update param
+    _check(LIB.LGBM_DatasetUpdateParam(ds, c_str("max_bin=31")))
+
+    # push-rows flow: by-reference container filled in two chunks
+    tgt = ctypes.c_void_p()
+    _check(LIB.LGBM_DatasetCreateByReference(ds, ctypes.c_int64(200), ctypes.byref(tgt)))
+    flat = np.ascontiguousarray(X, np.float64)
+    half = flat[:120]
+    _check(LIB.LGBM_DatasetPushRows(tgt, half.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64, 120, 4, 0))
+    rest = np.ascontiguousarray(flat[120:])
+    _check(LIB.LGBM_DatasetPushRows(tgt, rest.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64, 80, 4, 120))
+    _check(LIB.LGBM_DatasetGetNumData(tgt, ctypes.byref(n)))
+    assert n.value == 200
+
+    # CreateFromMats: two stacked halves give the same dataset shape
+    m1 = np.ascontiguousarray(flat[:90]); m2 = np.ascontiguousarray(flat[90:])
+    ptrs = (ctypes.c_void_p * 2)(m1.ctypes.data_as(ctypes.c_void_p).value, m2.ctypes.data_as(ctypes.c_void_p).value)
+    nrows = np.asarray([90, 110], np.int32)
+    mats = ctypes.c_void_p()
+    _check(LIB.LGBM_DatasetCreateFromMats(
+        2, ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)), C_API_DTYPE_FLOAT64,
+        nrows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), 4, 1, c_str("max_bin=31"),
+        None, ctypes.byref(mats)))
+    _check(LIB.LGBM_DatasetGetNumData(mats, ctypes.byref(n)))
+    assert n.value == 200
+
+    for h in (sub, tgt, mats, ds):
+        _check(LIB.LGBM_DatasetFree(h))
+
+
+def test_network_abi():
+    _check(LIB.LGBM_NetworkInit(c_str("127.0.0.1:12400"), 12400, 120, 1))
+    _check(LIB.LGBM_NetworkInitWithFunctions(1, 0, None, None))
+    _check(LIB.LGBM_NetworkFree())
+    LIB.LGBM_SetLastError(c_str("injected"))
+    assert LIB.LGBM_GetLastError().decode() == "injected"
