@@ -480,21 +480,26 @@ class GBDT:
             two_way=self._two_way,
         )
         cegb_on = self.cegb_params.enabled
+        # resolve the pool cap up front: warns once when a parallel learner
+        # ignores a configured histogram_pool_size
+        slots = self._hist_pool_slots()
         if learner == "serial":
-            # donated scratch for the [M, F, B, 3] histogram carry: grow_tree
+            # donated scratch for the [P|M, F, B, 3] histogram carry: grow_tree
             # reuses and returns it (aliased), skipping a full-buffer zeros
             # write per tree
             M = cfg.num_leaves
             F = self.feature_meta["num_bin"].shape[0]
+            rows = slots if slots is not None else M
             buf = getattr(self, "_hist_buf", None)
-            if buf is None or buf.shape != (M, F, self.num_bins, 3):
-                buf = jnp.zeros((M, F, self.num_bins, 3), jnp.float32)
+            if buf is None or buf.shape != (rows, F, self.num_bins, 3):
+                buf = jnp.zeros((rows, F, self.num_bins, 3), jnp.float32)
             self._hist_buf = None  # consumed by donation below
             out = grow_tree(
                 self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
                 self.feature_meta, forced_splits=self._forced_splits,
                 cegb=self.cegb_params, cegb_state=self._cegb_state,
-                hist_buf=buf, bins_nf=self.bins_dev_nf, **common,
+                hist_buf=buf, bins_nf=self.bins_dev_nf,
+                hist_pool_slots=slots, **common,
             )
             out, self._hist_buf = out[:-1], out[-1]
             if cegb_on:
@@ -541,6 +546,27 @@ class GBDT:
                 tree, leaf_id = out
         # drop shard-padding rows so score updates stay [N]-shaped
         return tree, leaf_id[: self.num_data]
+
+    def _hist_pool_slots(self):
+        """histogram_pool_size (MB) -> LRU slot count, or None for unlimited
+        (SerialTreeLearner ctor, serial_tree_learner.cpp:56-69)."""
+        cfg = self.config
+        if cfg.histogram_pool_size <= 0 or self.cegb_params.enabled:
+            return None
+        if self._learner_kind() != "serial":
+            if not getattr(self, "_warned_pool_parallel", False):
+                self._warned_pool_parallel = True
+                log.warning(
+                    "histogram_pool_size is only honored by tree_learner="
+                    "serial for now; the %s learner keeps the full histogram "
+                    "carry resident" % self._learner_kind()
+                )
+            return None
+        F = self.feature_meta["num_bin"].shape[0]
+        per_leaf = F * self.num_bins * 3 * 4  # f32 (sum_grad, sum_hess, count)
+        slots = int(cfg.histogram_pool_size * 1024 * 1024 / max(per_leaf, 1))
+        slots = max(2 + len(self._forced_splits), slots)
+        return slots if slots < cfg.num_leaves else None
 
     def _cegb_state_sharded(self, mesh):
         """Row-shard the lazy used_in_data to match the sharded bins."""

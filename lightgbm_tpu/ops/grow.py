@@ -85,7 +85,7 @@ class GrowState(NamedTuple):
     leaf_num_data: jax.Array
     min_con: jax.Array  # [M] monotone windows
     max_con: jax.Array
-    hist: jax.Array  # [M, F, B, 3]
+    hist: jax.Array  # [M, F, B, 3] ([P, F, B, 3] when the pool is capped)
     feature_used: jax.Array  # [F] bool (CEGB coupled bookkeeping)
     unused_cnt: jax.Array  # [M, F] rows-not-yet-charged counts (CEGB lazy)
     used_in_data: jax.Array  # [F, N] bool when lazy CEGB else [1, 1] dummy
@@ -93,6 +93,10 @@ class GrowState(NamedTuple):
     order: jax.Array  # [N] int32 row permutation grouped by leaf ([1] dummy)
     leaf_begin: jax.Array  # [M] int32 segment starts ([1] dummy)
     leaf_phys: jax.Array  # [M] int32 physical rows per leaf ([1] dummy)
+    # HistogramPool LRU state (feature_histogram.hpp:654); [1] dummies unpooled
+    slot_of: jax.Array  # [M] int32: leaf -> pool slot, -1 = evicted
+    slot_leaf: jax.Array  # [P] int32: slot -> leaf, -1 = free
+    slot_age: jax.Array  # [P] int32 LRU stamps (0 = never used)
 
 
 def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat, member):
@@ -124,6 +128,7 @@ MIN_BUCKET_LOG2 = 8  # smallest gathered-segment bucket (256 rows)
         "num_leaves", "max_depth", "num_bins", "params", "num_group_bins",
         "chunk", "axis_name", "split_fn", "psum_hist", "forced_splits", "cegb",
         "hist_mode", "hist_dtype", "two_way", "feature_sharded",
+        "hist_pool_slots", "use_subtract",
     ),
     donate_argnames=("hist_buf",),
 )
@@ -152,6 +157,8 @@ def grow_tree(
     feature_sharded: bool = False,
     hist_buf: Optional[jax.Array] = None,
     bins_nf: Optional[jax.Array] = None,
+    hist_pool_slots: Optional[int] = None,
+    use_subtract: bool = True,
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [N]).
 
@@ -172,6 +179,13 @@ def grow_tree(
     feature axis (the feature-parallel learner) — selects the row-chunked
     histogram scatter; the default per-feature scan formulation would force
     an all-gather of the bin matrix.
+    ``hist_pool_slots``: cap the histogram carry to this many LRU slots
+    (HistogramPool, feature_histogram.hpp:654). A split whose parent has been
+    evicted runs the reference's use_subtract=false branch: both children are
+    summed directly from data (serial_tree_learner.cpp:455-473). None or
+    >= num_leaves keeps the full [M, F, B, 3] carry.
+    ``use_subtract=False`` disables the smaller-child subtraction trick
+    everywhere — the differential oracle for the pool's miss path.
     ``bins_nf``: optional transposed copy of ``bins`` ([N, F]); when given,
     the bucketed segment gathers read it instead of ``bins`` — row gathers
     are contiguous there, ~3x faster on CPU caches. TPU callers leave it
@@ -214,6 +228,26 @@ def grow_tree(
         )
     # lazy CEGB charges per (row, feature) and needs full-row leaf masks
     bucketed = hist_mode == "bucketed" and not cegb.has_lazy and M > 1
+
+    # HistogramPool cap (feature_histogram.hpp:654): with fewer slots than
+    # leaves, the [*, F, B, 3] carry holds P LRU slots; an evicted parent
+    # disables the subtraction trick for that split and both children are
+    # constructed directly (use_subtract = parent_leaf_histogram_array_ !=
+    # nullptr, serial_tree_learner.cpp:455).
+    pooled = hist_pool_slots is not None and hist_pool_slots < M
+    P = int(hist_pool_slots) if pooled else M
+    if pooled and P < 2:
+        raise ValueError("histogram pool needs at least 2 slots, got %d" % P)
+    if pooled and cegb_on:
+        raise NotImplementedError(
+            "histogram_pool_size with CEGB is unsupported: the CEGB rescan "
+            "re-ranks every leaf from its resident histogram"
+        )
+    if pooled and forced_splits and P < len(forced_splits) + 2:
+        raise ValueError(
+            "histogram pool too small for the forced-splits preamble: "
+            "need >= %d slots" % (len(forced_splits) + 2)
+        )
 
     num_bin_arr = feature_meta["num_bin"].astype(jnp.int32)
     missing_arr = feature_meta["missing_type"].astype(jnp.int32)
@@ -519,7 +553,15 @@ def grow_tree(
     if hist_buf is not None:
         hist0 = hist_buf.at[0].set(root_hist)
     else:
-        hist0 = jnp.zeros((M, F, B, 3), f32).at[0].set(root_hist)
+        hist0 = jnp.zeros((P, F, B, 3), f32).at[0].set(root_hist)
+    if pooled:
+        slot_of0 = jnp.full((M,), -1, jnp.int32).at[0].set(0)
+        slot_leaf0 = jnp.full((P,), -1, jnp.int32).at[0].set(0)
+        slot_age0 = jnp.zeros((P,), jnp.int32).at[0].set(1)
+    else:
+        slot_of0 = jnp.zeros((1,), jnp.int32)
+        slot_leaf0 = jnp.zeros((1,), jnp.int32)
+        slot_age0 = jnp.zeros((1,), jnp.int32)
 
     if cegb_on:
         root_best = rescan_all(
@@ -560,6 +602,9 @@ def grow_tree(
             if bucketed
             else jnp.zeros((1,), jnp.int32)
         ),
+        slot_of=slot_of0,
+        slot_leaf=slot_leaf0,
+        slot_age=slot_age0,
     )
 
     def apply_split(s: GrowState, best_leaf, rec: SplitResult) -> GrowState:
@@ -724,16 +769,99 @@ def grow_tree(
                 jnp.where(left_smaller, rec.left_sum_hess, rec.right_sum_hess),
                 jnp.where(left_smaller, rec.left_count, rec.right_count),
             )
-        parent_hist = s.hist[best_leaf]
-        large_hist = parent_hist - small_hist
-        # ONE stacked scatter, not two chained .at[].set: XLA updates the
-        # [M, F, B, 3] carry in place for a single scatter but inserts a
-        # full-buffer copy per chained update (~2 x 22MB per split at
-        # M=255/F=28/B=256 — measured 40x slower on CPU, and HBM traffic
-        # that would cost ~14ms/iter on TPU)
-        hist = s.hist.at[jnp.stack([small_idx, large_idx])].set(
-            jnp.stack([small_hist, large_hist])
-        )
+        def large_direct():
+            """Both-children path: the larger child summed from data — the
+            reference's use_subtract=false branch (ConstructHistograms,
+            serial_tree_learner.cpp:473)."""
+            if bucketed:
+                lg_begin = jnp.where(left_smaller, pbegin + left_phys, pbegin)
+                lg_cnt = jnp.where(left_smaller, right_phys, left_phys)
+                h = segment_histogram(order, lg_begin, lg_cnt)
+                if hist_axis is not None:
+                    h = jax.lax.psum(h, hist_axis)
+            else:
+                lmask = (leaf_id == large_idx).astype(f32)
+                h = leaf_histogram(
+                    bins, masked_values(lmask), B_hist, chunk=chunk,
+                    axis_name=hist_axis, hist_dtype=hist_dtype,
+                    feature_sharded=feature_sharded,
+                )
+            if bundled:
+                h = remap_hist(
+                    h,
+                    jnp.where(left_smaller, rec.right_sum_grad, rec.left_sum_grad),
+                    jnp.where(left_smaller, rec.right_sum_hess, rec.left_sum_hess),
+                    jnp.where(left_smaller, rec.right_count, rec.left_count),
+                )
+            return h
+
+        if pooled:
+            # HistogramPool::Get: the predicate is identical on every shard
+            # (slot state is a pure function of the replicated split sequence),
+            # so the collective inside the miss branch executes uniformly.
+            pslot = s.slot_of[best_leaf]
+            cached = (pslot >= 0) if use_subtract else jnp.asarray(False)
+            parent_hist = s.hist[jnp.maximum(pslot, 0)]
+            large_hist = jax.lax.cond(
+                cached, lambda: parent_hist - small_hist, large_direct
+            )
+            # slots: the larger child inherits the parent's slot on a hit
+            # (the reference's in-place Subtract); otherwise evict the LRU.
+            ages = s.slot_age
+            slots_iota = jnp.arange(P, dtype=jnp.int32)
+            lru0 = jnp.argmin(ages).astype(jnp.int32)
+            large_slot = jnp.where(cached, pslot, lru0)
+            big = jnp.int32(2**30)
+            small_slot = jnp.argmin(
+                ages + (slots_iota == large_slot) * big
+            ).astype(jnp.int32)
+            # invalidate evicted occupants, then map the children
+            occ = jnp.stack([s.slot_leaf[large_slot], s.slot_leaf[small_slot]])
+            leaves_iota = jnp.arange(M, dtype=jnp.int32)
+            slot_of = jnp.where(
+                (leaves_iota == occ[0]) | (leaves_iota == occ[1]), -1, s.slot_of
+            )
+            slot_of = (
+                slot_of.at[small_idx].set(small_slot).at[large_idx].set(large_slot)
+            )
+            slot_pair = jnp.stack([small_slot, large_slot])
+            # clear any OTHER slot still mapping to a child (the parent's old
+            # slot when a resident parent took the miss path, e.g. the
+            # use_subtract=False oracle): a stale entry would later evict as
+            # `occ` and wrongly clear the live child's slot_of
+            slot_leaf = jnp.where(
+                (s.slot_leaf == small_idx) | (s.slot_leaf == large_idx),
+                -1,
+                s.slot_leaf,
+            )
+            slot_leaf = slot_leaf.at[slot_pair].set(
+                jnp.stack([small_idx, large_idx])
+            )
+            stamp = s.it + 2  # > the root's stamp of 1; free slots stay 0
+            slot_age = ages.at[slot_pair].set(jnp.stack([stamp, stamp]))
+            hist = s.hist.at[slot_pair].set(jnp.stack([small_hist, large_hist]))
+            child_rows = jnp.stack(
+                [
+                    jnp.where(left_smaller, small_slot, large_slot),
+                    jnp.where(left_smaller, large_slot, small_slot),
+                ]
+            )
+        else:
+            parent_hist = s.hist[best_leaf]
+            if use_subtract:
+                large_hist = parent_hist - small_hist
+            else:
+                large_hist = large_direct()
+            slot_of, slot_leaf, slot_age = s.slot_of, s.slot_leaf, s.slot_age
+            # ONE stacked scatter, not two chained .at[].set: XLA updates the
+            # [M, F, B, 3] carry in place for a single scatter but inserts a
+            # full-buffer copy per chained update (~2 x 22MB per split at
+            # M=255/F=28/B=256 — measured 40x slower on CPU, and HBM traffic
+            # that would cost ~14ms/iter on TPU)
+            hist = s.hist.at[jnp.stack([small_idx, large_idx])].set(
+                jnp.stack([small_hist, large_hist])
+            )
+            child_rows = None  # hist rows ARE leaf rows; set below
 
         # ---- next-round candidate refresh --------------------------------
         if cegb_on:
@@ -742,7 +870,9 @@ def grow_tree(
             )
         else:
             child_idx = jnp.stack([best_leaf, new_leaf])
-            ch_hist = hist[child_idx]
+            if child_rows is None:
+                child_rows = child_idx  # unpooled: hist rows are leaf rows
+            ch_hist = hist[child_rows]  # leaf rows unpooled, slot rows pooled
             ch_sg = lsg[child_idx]
             ch_sh = lsh[child_idx]
             ch_nd = lnd[child_idx]
@@ -781,6 +911,9 @@ def grow_tree(
             order=order,
             leaf_begin=leaf_begin,
             leaf_phys=leaf_phys,
+            slot_of=slot_of,
+            slot_leaf=slot_leaf,
+            slot_age=slot_age,
         )
 
     # ---- forced splits preamble (ForceSplits) ---------------------------
@@ -788,7 +921,14 @@ def grow_tree(
     if forced_splits:
         aborted = jnp.asarray(False)
         for (leaf_i, feat_i, thr_i) in forced_splits[: M - 1]:
-            hist_slice = state.hist[leaf_i, feat_i]
+            if pooled:
+                # P >= len(forced_splits)+2 is enforced above, so preamble
+                # leaves are never evicted before their forced split applies
+                hist_slice = state.hist[
+                    jnp.maximum(state.slot_of[leaf_i], 0), feat_i
+                ]
+            else:
+                hist_slice = state.hist[leaf_i, feat_i]
             if axis_name is not None and not psum_hist:
                 # voting-parallel keeps shard-local histograms; a forced split
                 # needs the global column (the elected-slice psum's little sibling)
