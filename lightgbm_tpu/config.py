@@ -440,6 +440,20 @@ class Config:
 
     def _check_conflicts(self) -> None:
         """Mirror Config::CheckParamConflict (src/io/config.cpp:201)."""
+        # tree_learner value aliases (GetTreeLearnerType, config.cpp:110):
+        # "data_parallel" == "data" etc.; normalize once here so every
+        # downstream dispatch matches the canonical short names
+        _learner_alias = {
+            "serial_tree_learner": "serial",
+            "data_parallel": "data", "data_parallel_tree_learner": "data",
+            "feature_parallel": "feature",
+            "feature_parallel_tree_learner": "feature",
+            "voting_parallel": "voting",
+            "voting_parallel_tree_learner": "voting",
+        }
+        self.tree_learner = _learner_alias.get(self.tree_learner, self.tree_learner)
+        if self.tree_learner not in ("serial", "data", "feature", "voting"):
+            log.fatal("Unknown tree learner type %s" % self.tree_learner)
         if self.num_machines > 1:
             self.is_parallel = True
         if self.tree_learner in ("data", "feature", "voting"):

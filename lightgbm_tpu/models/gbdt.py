@@ -119,11 +119,6 @@ class GBDT:
         self.num_group_bins = (
             int(train_set.max_group_bins) if train_set.is_bundled else None
         )
-        if train_set.is_bundled and cfg.tree_learner in ("voting", "voting_parallel"):
-            log.fatal(
-                "tree_learner=voting is not supported with EFB-bundled data "
-                "(shard-local histograms cannot recover default-bin rows)"
-            )
         self.split_params = SplitParams(
             lambda_l1=cfg.lambda_l1,
             lambda_l2=cfg.lambda_l2,
@@ -551,7 +546,16 @@ class GBDT:
         """histogram_pool_size (MB) -> LRU slot count, or None for unlimited
         (SerialTreeLearner ctor, serial_tree_learner.cpp:56-69)."""
         cfg = self.config
-        if cfg.histogram_pool_size <= 0 or self.cegb_params.enabled:
+        if cfg.histogram_pool_size <= 0:
+            return None
+        if self.cegb_params.enabled:
+            if not getattr(self, "_warned_pool_cegb", False):
+                self._warned_pool_cegb = True
+                log.warning(
+                    "histogram_pool_size is ignored with CEGB penalties: the "
+                    "CEGB rescan re-ranks every leaf from its resident "
+                    "histogram, so the full carry stays allocated"
+                )
             return None
         if self._learner_kind() != "serial":
             if not getattr(self, "_warned_pool_parallel", False):

@@ -269,8 +269,13 @@ def grow_tree(
         def remap_hist(group_hist, sum_g, sum_h, sum_n):
             """[G, B_hist, 3] group histogram -> [F, B, 3] feature histogram.
 
-            Must run AFTER any cross-shard psum: the default-bin row is
-            (global) leaf totals minus the feature's non-default rows."""
+            The default-bin row is leaf totals minus the feature's
+            non-default rows. The remap is affine-linear in (hist, totals),
+            so it commutes with cross-shard psum: remapping each shard with
+            its SHARD-LOCAL totals and summing equals remapping the global
+            histogram with global totals — the voting-parallel learner's
+            shard-local mode relies on this (its elected-feature psum then
+            runs in feature space)."""
             fh = group_hist[gid_arr[:, None], efb_gidx]  # [F, B, 3]
             fh = fh * efb_valid[:, :, None].astype(fh.dtype)
             totals = jnp.stack(
@@ -278,6 +283,13 @@ def grow_tree(
             )
             rest = totals[None, :] - jnp.sum(fh, axis=1)  # [F, 3]
             return fh.at[f_iota, default_bin_arr].set(rest)
+
+        def remap_hist_local(group_hist):
+            """Shard-local remap: totals recovered from the group histogram
+            itself — every row lands in exactly one bin of every group, so
+            any group's bins sum to the (local) leaf totals."""
+            t = jnp.sum(group_hist[0], axis=0)  # [3]
+            return remap_hist(group_hist, t[0], t[1], t[2])
 
         def decode_col(group_col, f):
             """Group-encoded column -> feature f's sub-bins (efb.decode_subbin)."""
@@ -484,12 +496,12 @@ def grow_tree(
         root_n = jax.lax.psum(root_n, axis_name)
     if bundled:
         if axis_name is not None and not psum_hist:
-            raise NotImplementedError(
-                "EFB-bundled datasets require globally combined histograms "
-                "(the default-bin remap needs global leaf totals); the "
-                "voting-parallel shard-local mode is unsupported"
-            )
-        root_hist = remap_hist(root_hist, root_g, root_h, root_n)
+            # voting-parallel shard-local mode: remap with LOCAL totals (the
+            # linearity argument on remap_hist); the split_fn's elected psum
+            # then combines feature-space histograms exactly
+            root_hist = remap_hist_local(root_hist)
+        else:
+            root_hist = remap_hist(root_hist, root_g, root_h, root_n)
 
     no_con_min = jnp.full((M,), -jnp.inf, f32)
     no_con_max = jnp.full((M,), jnp.inf, f32)
@@ -763,12 +775,16 @@ def grow_tree(
                 feature_sharded=feature_sharded,
             )
         if bundled:
-            small_hist = remap_hist(
-                small_hist,
-                jnp.where(left_smaller, rec.left_sum_grad, rec.right_sum_grad),
-                jnp.where(left_smaller, rec.left_sum_hess, rec.right_sum_hess),
-                jnp.where(left_smaller, rec.left_count, rec.right_count),
-            )
+            if hist_axis is None and axis_name is not None:
+                # shard-local histograms: local remap (rec sums are global)
+                small_hist = remap_hist_local(small_hist)
+            else:
+                small_hist = remap_hist(
+                    small_hist,
+                    jnp.where(left_smaller, rec.left_sum_grad, rec.right_sum_grad),
+                    jnp.where(left_smaller, rec.left_sum_hess, rec.right_sum_hess),
+                    jnp.where(left_smaller, rec.left_count, rec.right_count),
+                )
         def large_direct():
             """Both-children path: the larger child summed from data — the
             reference's use_subtract=false branch (ConstructHistograms,
@@ -787,12 +803,15 @@ def grow_tree(
                     feature_sharded=feature_sharded,
                 )
             if bundled:
-                h = remap_hist(
-                    h,
-                    jnp.where(left_smaller, rec.right_sum_grad, rec.left_sum_grad),
-                    jnp.where(left_smaller, rec.right_sum_hess, rec.left_sum_hess),
-                    jnp.where(left_smaller, rec.right_count, rec.left_count),
-                )
+                if hist_axis is None and axis_name is not None:
+                    h = remap_hist_local(h)
+                else:
+                    h = remap_hist(
+                        h,
+                        jnp.where(left_smaller, rec.right_sum_grad, rec.left_sum_grad),
+                        jnp.where(left_smaller, rec.right_sum_hess, rec.left_sum_hess),
+                        jnp.where(left_smaller, rec.right_count, rec.left_count),
+                    )
             return h
 
         if pooled:

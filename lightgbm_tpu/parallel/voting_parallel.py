@@ -38,10 +38,11 @@ def _voting_split_fn(top_k: int, axis_name: str, two_way: bool = True):
         # local leaf sums from the local histogram: INVARIANT — every row of a
         # leaf lands in exactly one bin of every feature's histogram, so any
         # feature's bins sum to the leaf totals (feature 0 here, the
-        # smaller_leaf_splits_ local sums). True for dense per-feature
-        # histograms; an EFB group histogram would break it (a feature's
-        # non-default rows only), but grow_tree already rejects bundled +
-        # shard-local histograms before this traces (ops/grow.py:400-406).
+        # smaller_leaf_splits_ local sums). Holds for dense per-feature
+        # histograms AND for EFB-bundled data: grow_tree remaps shard-local
+        # group histograms into feature space with local totals before they
+        # reach this split_fn (remap_hist_local, ops/grow.py), which restores
+        # the every-row-in-one-bin property via the default-bin row.
         local_g = jnp.sum(hist_local[0, :, 0])
         local_h = jnp.sum(hist_local[0, :, 1])
         local_n = jnp.sum(hist_local[0, :, 2])
