@@ -19,6 +19,10 @@ TASKS = [
     ("regression", 30),
     ("lambdarank", 30),
     ("multiclass_classification", 20),
+    # the fifth BASELINE.json workload: tree_learner=feature over the
+    # conftest's virtual 8-device mesh (the reference's socket keys are
+    # accepted and ignored; transport is the mesh)
+    ("parallel_learning", 10),
 ]
 
 
@@ -27,6 +31,13 @@ def test_cli_example(task, rounds, tmp_path, monkeypatch):
     src = os.path.join(EXAMPLES, task)
     for f in os.listdir(src):
         shutil.copy(os.path.join(src, f), tmp_path)
+    if task == "parallel_learning":
+        # reuses the binary-classification fixture (as the reference's
+        # parallel example reuses binary.train); one generator, not a copy
+        shutil.copy(
+            os.path.join(EXAMPLES, "binary_classification", "make_data.py"),
+            tmp_path,
+        )
     monkeypatch.chdir(tmp_path)
     runpy.run_path(os.path.join(tmp_path, "make_data.py"), run_name="__main__")
 
