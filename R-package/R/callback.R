@@ -1,0 +1,85 @@
+# Training callbacks for lgb.train / lgb.cv.
+# API counterpart of the reference R-package/R/callback.R: callbacks are
+# functions of an env carrying (booster, iteration, eval results); lgb.train
+# invokes them after each round. The same cb.* constructors the reference
+# exports are provided here.
+
+# The environment handed to every callback each round.
+CB_ENV <- function(bst, iter, evals) {
+  env <- new.env(parent = emptyenv())
+  env$model <- bst
+  env$iteration <- iter
+  env$eval_list <- evals
+  env$met_early_stop <- FALSE
+  env
+}
+
+#' Print evaluation results every period rounds
+#' @param period print frequency in rounds
+#' @export
+cb.print.evaluation <- function(period = 1L) {
+  callback <- function(env) {
+    if (period > 0L && (env$iteration %% period) == 0L) {
+      parts <- vapply(names(env$eval_list), function(k) {
+        sprintf("%s: %g", k, env$eval_list[[k]])
+      }, character(1L))
+      message(sprintf("[%d] %s", env$iteration, paste(parts, collapse = "  ")))
+    }
+  }
+  attr(callback, "name") <- "cb.print.evaluation"
+  callback
+}
+
+#' Record evaluation results into booster$record_evals
+#' @export
+cb.record.evaluation <- function() {
+  callback <- function(env) {
+    for (k in names(env$eval_list)) {
+      env$model$record_evals[["cb"]][[k]] <-
+        c(env$model$record_evals[["cb"]][[k]], env$eval_list[[k]])
+    }
+  }
+  attr(callback, "name") <- "cb.record.evaluation"
+  callback
+}
+
+#' Early-stopping callback
+#'
+#' @param stopping_rounds rounds without improvement before stopping
+#' @param maximize TRUE when the tracked metric improves upward (auc, ndcg,
+#'   map — lgb.train's built-in early stopping flips these automatically;
+#'   the callback needs it stated)
+#' @param verbose announce the stop
+#' @export
+cb.early.stop <- function(stopping_rounds, maximize = FALSE, verbose = TRUE) {
+  best <- new.env(parent = emptyenv())
+  best$score <- Inf
+  best$iter <- 0L
+  best$stale <- 0L
+  callback <- function(env) {
+    if (length(env$eval_list) == 0L) {
+      return(invisible(NULL))
+    }
+    score <- env$eval_list[[1L]]
+    if (maximize) {
+      score <- -score
+    }
+    if (score < best$score - 1e-12) {
+      best$score <- score
+      best$iter <- env$iteration
+      best$stale <- 0L
+    } else {
+      best$stale <- best$stale + 1L
+      if (best$stale >= stopping_rounds) {
+        env$met_early_stop <- TRUE
+        env$model$best_iter <- best$iter
+        if (verbose) {
+          message(sprintf("early stop at round %d (best %d)",
+                          env$iteration, best$iter))
+        }
+      }
+    }
+  }
+  attr(callback, "name") <- "cb.early.stop"
+  callback
+}

@@ -92,6 +92,40 @@ lgb.load <- function(filename) {
   bst
 }
 
+#' Serialize a booster to the reference-format model text
+#' @param booster lgb.Booster
+#' @param num_iteration iterations to include (-1 = all)
+#' @export
+lgb.model.to.string <- function(booster, num_iteration = -1L) {
+  .Call(LGBT_R_BoosterSaveModelToString,
+        lgb.check.handle(booster$handle, "Booster"), 0L,
+        as.integer(num_iteration))
+}
+
+#' JSON dump of the model structure
+#' @param booster lgb.Booster
+#' @param num_iteration iterations to include (-1 = all)
+#' @export
+lgb.dump <- function(booster, num_iteration = -1L) {
+  .Call(LGBT_R_BoosterDumpModel,
+        lgb.check.handle(booster$handle, "Booster"), 0L,
+        as.integer(num_iteration))
+}
+
+#' Rebuild a booster from model text (lgb.model.to.string's inverse)
+#' @param model_str reference-format model text
+#' @export
+lgb.load.from.string <- function(model_str) {
+  bst <- new.env(parent = emptyenv())
+  bst$handle <- .Call(LGBT_R_BoosterLoadModelFromString, model_str)
+  bst$params <- list()
+  bst$valid_names <- character(0L)
+  bst$record_evals <- list()
+  bst$best_iter <- -1L
+  class(bst) <- "lgb.Booster"
+  bst
+}
+
 #' Extract a recorded evaluation series from a trained model
 #' @param booster lgb.Booster returned by \code{lgb.train}
 #' @param data_name validation set name

@@ -1,0 +1,38 @@
+# lgb.Predictor — the prediction engine behind predict.lgb.Booster.
+# API counterpart of the reference R-package/R/lgb.Predictor.R (an internal
+# class wrapping LGBM_BoosterPredictFor*): holds the booster handle plus the
+# prediction configuration and dispatches matrix / dgCMatrix / file inputs.
+
+lgb.Predictor <- function(booster_handle, params = list()) {
+  pred <- new.env(parent = emptyenv())
+  pred$handle <- booster_handle
+  pred$params <- params
+  class(pred) <- "lgb.Predictor"
+  pred
+}
+
+lgb.Predictor.current.iter <- function(predictor) {
+  .Call(LGBT_R_BoosterGetCurrentIteration,
+        lgb.check.handle(predictor$handle, "Booster"))
+}
+
+# core dispatch: ptype 0=normal 1=raw 2=leaf 3=contrib (c_api.h:35-39)
+lgb.Predictor.predict <- function(predictor, data, ptype = 0L,
+                                  num_iteration = -1L) {
+  h <- lgb.check.handle(predictor$handle, "Booster")
+  if (is.character(data) && length(data) == 1L) {
+    # file input -> file output (LGBM_BoosterPredictForFile)
+    out_file <- tempfile(fileext = ".pred")
+    .Call(LGBT_R_BoosterPredictForFile, h, data, FALSE, as.integer(ptype),
+          as.integer(num_iteration), lgb.params2str(predictor$params),
+          out_file)
+    return(as.matrix(utils::read.table(out_file)))
+  }
+  m <- lgb.to.matrix(data)
+  if (is(m, "dgCMatrix")) {
+    m <- as.matrix(m) # the bridge's dense predict path
+  }
+  .Call(LGBT_R_BoosterPredictForMat, h, m, nrow(m), ncol(m),
+        as.integer(ptype), as.integer(num_iteration),
+        lgb.params2str(predictor$params))
+}

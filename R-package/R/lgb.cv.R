@@ -13,14 +13,39 @@
 #' @param stratified stratify folds by label (classification)
 #' @param early_stopping_rounds per-fold early stopping (NULL disables)
 #' @param verbose verbosity forwarded to lgb.train
-#' @return list with per-fold boosters and the fold-mean eval history
+#' @param folds optional list of per-fold validation index vectors (overrides
+#'   nfold/stratified, like the reference's folds= argument)
+#' @return list with per-fold boosters, the fold-mean eval history, and the
+#'   per-round fold standard deviations (the reference's eval_err)
 #' @export
 lgb.cv <- function(params = list(), data, label, nrounds = 100L, nfold = 5L,
                    stratified = TRUE, early_stopping_rounds = NULL,
-                   verbose = 0L) {
-  stopifnot(nfold >= 2L, length(label) == nrow(lgb.to.matrix(data)))
+                   verbose = 0L, folds = NULL) {
+  stopifnot(length(label) == nrow(lgb.to.matrix(data)))
   n <- length(label)
-  if (stratified && length(unique(label)) <= 32L) {
+  if (!is.null(folds)) {
+    # caller-provided validation indices (group-aware CV etc.); must be a
+    # disjoint, in-range partition of the rows
+    nfold <- length(folds)
+    stopifnot(nfold >= 2L)
+    idx_all <- unlist(folds)
+    if (any(idx_all < 1L) || any(idx_all > n)) {
+      stop("lightgbm.tpu: folds indices must be in [1, nrow(data)]")
+    }
+    if (anyDuplicated(idx_all)) {
+      stop("lightgbm.tpu: folds must be disjoint (a row appears in more ",
+           "than one validation fold)")
+    }
+    if (length(idx_all) < n) {
+      stop("lightgbm.tpu: folds must cover every row exactly once")
+    }
+    fold_id <- integer(n)
+    for (k in seq_len(nfold)) {
+      fold_id[folds[[k]]] <- k
+    }
+    folds <- fold_id
+  } else if (stratified && length(unique(label)) <= 32L) {
+    stopifnot(nfold >= 2L)
     # per-class round-robin assignment keeps class balance in every fold
     folds <- integer(n)
     for (cls in unique(label)) {
@@ -28,6 +53,7 @@ lgb.cv <- function(params = list(), data, label, nrounds = 100L, nfold = 5L,
       folds[idx] <- rep_len(seq_len(nfold), length(idx))
     }
   } else {
+    stopifnot(nfold >= 2L)
     folds <- rep_len(seq_len(nfold), n)[sample.int(n)]
   }
 
@@ -47,14 +73,19 @@ lgb.cv <- function(params = list(), data, label, nrounds = 100L, nfold = 5L,
     histories[[k]] <- bst$record_evals$valid
   }
 
-  # fold-mean series per metric key, truncated to the shortest fold
+  # fold-mean + fold-sd series per metric key, truncated to the shortest fold
   keys <- names(histories[[1L]])
   evals <- list()
+  errs <- list()
   for (key in keys) {
     series <- lapply(histories, function(h) unlist(h[[key]]))
     len <- min(vapply(series, length, integer(1L)))
-    mat <- vapply(series, function(s) s[seq_len(len)], numeric(len))
-    evals[[key]] <- rowMeans(matrix(mat, nrow = len))
+    mat <- matrix(
+      vapply(series, function(s) s[seq_len(len)], numeric(len)), nrow = len
+    )
+    evals[[key]] <- rowMeans(mat)
+    errs[[key]] <- apply(mat, 1L, stats::sd)
   }
-  list(boosters = boosters, record_evals = list(valid = evals))
+  list(boosters = boosters,
+       record_evals = list(valid = evals, valid_err = errs))
 }

@@ -1,0 +1,36 @@
+# lgb.importance — per-feature Gain / Cover / Frequency shares.
+# API counterpart of the reference R-package/R/lgb.importance.R: aggregates
+# lgb.model.dt.tree's split rows, exactly like the reference aggregates its
+# tree table (Gain = summed split gain, Cover = summed internal_count,
+# Frequency = split count; each normalized to sum to 1 when percentage).
+
+#' Feature importance table
+#'
+#' @param model lgb.Booster
+#' @param percentage normalize each column to fractions summing to 1
+#' @return data.frame(Feature, Gain, Cover, Frequency) sorted by Gain
+#' @export
+lgb.importance <- function(model, percentage = TRUE) {
+  dt <- lgb.model.dt.tree(model)
+  splits <- dt[dt$node_type == "split", , drop = FALSE]
+  if (nrow(splits) == 0L) {
+    return(data.frame(Feature = character(0L), Gain = numeric(0L),
+                      Cover = numeric(0L), Frequency = numeric(0L)))
+  }
+  gain <- tapply(splits$split_gain, splits$split_feature, sum)
+  cover <- tapply(splits$internal_count, splits$split_feature, sum)
+  freq <- tapply(rep(1.0, nrow(splits)), splits$split_feature, sum)
+  out <- data.frame(
+    Feature = names(gain),
+    Gain = as.numeric(gain),
+    Cover = as.numeric(cover[names(gain)]),
+    Frequency = as.numeric(freq[names(gain)]),
+    stringsAsFactors = FALSE
+  )
+  if (percentage) {
+    out$Gain <- out$Gain / sum(out$Gain)
+    out$Cover <- out$Cover / sum(out$Cover)
+    out$Frequency <- out$Frequency / sum(out$Frequency)
+  }
+  out[order(-out$Gain), , drop = FALSE]
+}

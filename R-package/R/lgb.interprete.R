@@ -1,0 +1,39 @@
+# lgb.interprete — per-prediction feature contribution breakdown.
+# API counterpart of the reference R-package/R/lgb.interprete.R. The
+# reference walks each tree's decision path summing value deltas; here the
+# contributions come from the SHAP predictor (predcontrib — the same
+# pred_contrib path the Python package exposes), which decomposes each raw
+# prediction into per-feature contributions plus the expected value, so the
+# output table has the identical (Feature, Contribution) shape and the same
+# sum-to-raw-score property.
+
+#' Per-row feature contributions
+#'
+#' @param model lgb.Booster
+#' @param data feature matrix the rows are taken from
+#' @param idxset integer row indices (1-based) to interpret
+#' @return list of data.frame(Feature, Contribution), one per requested row,
+#'   each sorted by absolute contribution
+#' @export
+lgb.interprete <- function(model, data, idxset) {
+  m <- lgb.to.matrix(data)
+  feature_names <- .Call(LGBT_R_BoosterGetFeatureNames,
+                         lgb.check.handle(model$handle, "Booster"))
+  contrib <- predict.lgb.Booster(model, m[idxset, , drop = FALSE],
+                                 predcontrib = TRUE)
+  ncols <- length(feature_names) + 1L # + expected-value column
+  if (!is.matrix(contrib)) {
+    # single-row case: predict returns the flat vector
+    contrib <- matrix(contrib, ncol = ncols, byrow = TRUE)
+  }
+  stopifnot(ncol(contrib) == ncols)
+  out <- vector("list", length(idxset))
+  for (i in seq_along(idxset)) {
+    row <- contrib[i, seq_along(feature_names)]
+    tbl <- data.frame(Feature = c(feature_names, "BIAS"),
+                      Contribution = c(row, contrib[i, ncols]),
+                      stringsAsFactors = FALSE)
+    out[[i]] <- tbl[order(-abs(tbl$Contribution)), , drop = FALSE]
+  }
+  out
+}

@@ -1,0 +1,83 @@
+# lgb.model.dt.tree — flatten a trained model into a per-node table.
+# API counterpart of the reference R-package/R/lgb.model.dt.tree.R; instead
+# of parsing the JSON dump with jsonlite, this parses the reference-format
+# model TEXT (one "Tree=k" block per tree with parallel per-node arrays),
+# which the bridge returns via LGBT_R_BoosterSaveModelToString — no external
+# packages needed.
+
+#' Parse a lgb.Booster into a per-node data.frame
+#'
+#' One row per split node and per leaf, with the columns the reference's
+#' table exposes: tree_index, split_feature, split_gain, threshold,
+#' internal_value, internal_count, leaf_index, leaf_value, leaf_count.
+#'
+#' @param model lgb.Booster
+#' @param num_iteration trees to include (-1 = all)
+#' @return data.frame with one row per node/leaf
+#' @export
+lgb.model.dt.tree <- function(model, num_iteration = -1L) {
+  txt <- .Call(LGBT_R_BoosterSaveModelToString,
+               lgb.check.handle(model$handle, "Booster"), 0L,
+               as.integer(num_iteration))
+  feature_names <- .Call(LGBT_R_BoosterGetFeatureNames,
+                         lgb.check.handle(model$handle, "Booster"))
+  blocks <- strsplit(txt, "\nTree=", fixed = TRUE)[[1L]]
+  if (length(blocks) < 2L) {
+    return(data.frame())
+  }
+  rows <- list()
+  for (b in blocks[-1L]) {
+    lines <- strsplit(b, "\n", fixed = TRUE)[[1L]]
+    tree_index <- as.integer(lines[1L])
+    kv <- list()
+    for (ln in lines[-1L]) {
+      eq <- regexpr("=", ln, fixed = TRUE)
+      if (eq > 0L) {
+        key <- substr(ln, 1L, eq - 1L)
+        kv[[key]] <- strsplit(substr(ln, eq + 1L, nchar(ln)), " ",
+                              fixed = TRUE)[[1L]]
+      }
+    }
+    n_leaves <- as.integer(kv[["num_leaves"]][1L])
+    leaf_value <- as.numeric(kv[["leaf_value"]])
+    leaf_count <- if (!is.null(kv[["leaf_count"]])) {
+      as.numeric(kv[["leaf_count"]])
+    } else {
+      rep(NA_real_, n_leaves)
+    }
+    if (n_leaves > 1L) {
+      sf <- as.integer(kv[["split_feature"]])
+      gain <- as.numeric(kv[["split_gain"]])
+      thr <- as.numeric(kv[["threshold"]])
+      ival <- as.numeric(kv[["internal_value"]])
+      icnt <- as.numeric(kv[["internal_count"]])
+      rows[[length(rows) + 1L]] <- data.frame(
+        tree_index = tree_index,
+        node_type = "split",
+        split_feature = feature_names[sf + 1L],
+        split_gain = gain,
+        threshold = thr,
+        internal_value = ival,
+        internal_count = icnt,
+        leaf_index = NA_integer_,
+        leaf_value = NA_real_,
+        leaf_count = NA_real_,
+        stringsAsFactors = FALSE
+      )
+    }
+    rows[[length(rows) + 1L]] <- data.frame(
+      tree_index = tree_index,
+      node_type = "leaf",
+      split_feature = NA_character_,
+      split_gain = NA_real_,
+      threshold = NA_real_,
+      internal_value = NA_real_,
+      internal_count = NA_real_,
+      leaf_index = seq_len(n_leaves) - 1L,
+      leaf_value = leaf_value,
+      leaf_count = leaf_count,
+      stringsAsFactors = FALSE
+    )
+  }
+  do.call(rbind, rows)
+}

@@ -1,0 +1,27 @@
+# lgb.plot.importance — horizontal bar chart of lgb.importance output.
+# API counterpart of the reference R-package/R/lgb.plot.importance.R (which
+# draws with graphics::barplot the same way).
+
+#' Plot feature importance
+#'
+#' @param tree_imp data.frame from lgb.importance
+#' @param top_n number of features to draw
+#' @param measure one of "Gain", "Cover", "Frequency"
+#' @param left_margin widened left margin for feature names
+#' @return the plotted subset, invisibly
+#' @export
+lgb.plot.importance <- function(tree_imp, top_n = 10L, measure = "Gain",
+                                left_margin = 10L) {
+  stopifnot(measure %in% c("Gain", "Cover", "Frequency"))
+  tree_imp <- tree_imp[order(-tree_imp[[measure]]), , drop = FALSE]
+  tree_imp <- head(tree_imp, top_n)
+  op <- graphics::par(mar = c(4, left_margin, 2, 1))
+  on.exit(graphics::par(op))
+  graphics::barplot(
+    rev(tree_imp[[measure]]),
+    names.arg = rev(tree_imp$Feature),
+    horiz = TRUE, las = 1, border = NA,
+    main = "Feature importance", xlab = measure
+  )
+  invisible(tree_imp)
+}

@@ -1,0 +1,24 @@
+# lgb.plot.interpretation — bar chart of one lgb.interprete breakdown.
+# API counterpart of the reference R-package/R/lgb.plot.interpretation.R.
+
+#' Plot one prediction's feature contributions
+#'
+#' @param tree_interpretation one element of lgb.interprete's result
+#' @param top_n number of contributions to draw
+#' @param left_margin widened left margin for feature names
+#' @return the plotted subset, invisibly
+#' @export
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L,
+                                    left_margin = 10L) {
+  tbl <- head(tree_interpretation, top_n)
+  op <- graphics::par(mar = c(4, left_margin, 2, 1))
+  on.exit(graphics::par(op))
+  cols <- ifelse(rev(tbl$Contribution) >= 0, "steelblue", "firebrick")
+  graphics::barplot(
+    rev(tbl$Contribution),
+    names.arg = rev(tbl$Feature),
+    horiz = TRUE, las = 1, border = NA, col = cols,
+    main = "Feature contribution", xlab = "Contribution"
+  )
+  invisible(tbl)
+}

@@ -1,0 +1,25 @@
+# lgb.prepare — coerce a data.frame's factor/character columns to numeric.
+# API counterpart of the reference R-package/R/lgb.prepare.R (which converts
+# in place for data.frame/data.table): factors become their integer codes,
+# characters go through factor first, everything else is left alone.
+
+#' Convert categorical columns to numeric codes
+#'
+#' @param data data.frame (or matrix, returned unchanged)
+#' @return data with factor/character columns replaced by numeric codes
+#' @export
+lgb.prepare <- function(data) {
+  if (!is.data.frame(data)) {
+    return(data)
+  }
+  for (col in names(data)) {
+    v <- data[[col]]
+    if (is.character(v)) {
+      v <- factor(v)
+    }
+    if (is.factor(v)) {
+      data[[col]] <- as.numeric(v)
+    }
+  }
+  data
+}

@@ -1,0 +1,25 @@
+# lgb.prepare2 — like lgb.prepare but produces integer codes.
+# API counterpart of the reference R-package/R/lgb.prepare2.R (the integer
+# variant: models treat the codes as categorical levels, so integer storage
+# avoids the double round-trip).
+
+#' Convert categorical columns to integer codes
+#'
+#' @param data data.frame (or matrix, returned unchanged)
+#' @return data with factor/character columns replaced by integer codes
+#' @export
+lgb.prepare2 <- function(data) {
+  if (!is.data.frame(data)) {
+    return(data)
+  }
+  for (col in names(data)) {
+    v <- data[[col]]
+    if (is.character(v)) {
+      v <- factor(v)
+    }
+    if (is.factor(v)) {
+      data[[col]] <- as.integer(v)
+    }
+  }
+  data
+}

@@ -1,0 +1,35 @@
+# lgb.prepare_rules — categorical-to-numeric conversion with reusable rules.
+# API counterpart of the reference R-package/R/lgb.prepare_rules.R: the first
+# call records each column's level mapping; applying the same rules to new
+# data (a test set) produces consistent codes, with unseen levels mapped to
+# NA the way the reference maps them to 0/NA.
+
+#' Convert categoricals to numeric with persistent level rules
+#'
+#' @param data data.frame to convert
+#' @param rules optional rules from a previous call, applied instead of fresh
+#' @return list(data = converted data, rules = named list of level vectors)
+#' @export
+lgb.prepare_rules <- function(data, rules = NULL) {
+  if (!is.data.frame(data)) {
+    return(list(data = data, rules = rules %||% list()))
+  }
+  if (is.null(rules)) {
+    rules <- list()
+    for (col in names(data)) {
+      v <- data[[col]]
+      if (is.character(v) || is.factor(v)) {
+        rules[[col]] <- levels(factor(v))
+      }
+    }
+  }
+  for (col in names(rules)) {
+    if (col %in% names(data)) {
+      data[[col]] <- as.numeric(factor(as.character(data[[col]]),
+                                       levels = rules[[col]]))
+    }
+  }
+  list(data = data, rules = rules)
+}
+
+`%||%` <- function(a, b) if (is.null(a)) b else a

@@ -1,0 +1,20 @@
+# lgb.prepare_rules2 — integer-code variant of lgb.prepare_rules.
+# API counterpart of the reference R-package/R/lgb.prepare_rules2.R.
+
+#' Convert categoricals to integer codes with persistent level rules
+#'
+#' @param data data.frame to convert
+#' @param rules optional rules from a previous call, applied instead of fresh
+#' @return list(data = converted data, rules = named list of level vectors)
+#' @export
+lgb.prepare_rules2 <- function(data, rules = NULL) {
+  out <- lgb.prepare_rules(data, rules)
+  if (is.data.frame(out$data)) {
+    for (col in names(out$rules)) {
+      if (col %in% names(out$data)) {
+        out$data[[col]] <- as.integer(out$data[[col]])
+      }
+    }
+  }
+  out
+}

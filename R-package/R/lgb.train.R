@@ -14,12 +14,15 @@
 #'   this many rounds (NULL disables)
 #' @param verbose 1 prints per-round eval lines, <= 0 is silent
 #' @param eval_freq print every eval_freq rounds
+#' @param callbacks list of callback closures (cb.print.evaluation,
+#'   cb.record.evaluation, cb.early.stop, or custom functions of the CB_ENV
+#'   environment) invoked after every round
 #' @return a trained lgb.Booster with \code{record_evals} and
 #'   \code{best_iter} populated
 #' @export
 lgb.train <- function(params = list(), data, nrounds = 100L, valids = list(),
                       early_stopping_rounds = NULL, verbose = 1L,
-                      eval_freq = 1L) {
+                      eval_freq = 1L, callbacks = list()) {
   stopifnot(inherits(data, "lgb.Dataset"), nrounds >= 1L)
   bst <- lgb.Booster.new(data, params)
   if (length(valids) > 0L) {
@@ -50,9 +53,13 @@ lgb.train <- function(params = list(), data, nrounds = 100L, valids = list(),
   stale <- 0L
   for (i in seq_len(nrounds)) {
     finished <- lgb.Booster.update(bst)
+    first_vals <- numeric(0L) # reused by the callback env (no double eval)
     if (length(bst$valid_names) > 0L) {
       for (vi in seq_along(bst$valid_names)) {
         vals <- lgb.Booster.eval(bst, vi)
+        if (vi == 1L) {
+          first_vals <- vals
+        }
         vname <- bst$valid_names[vi]
         for (mi in seq_along(vals)) {
           key <- sprintf("metric_%d", mi)
@@ -82,6 +89,20 @@ lgb.train <- function(params = list(), data, nrounds = 100L, valids = list(),
             }
           }
         }
+      }
+    }
+    if (length(callbacks) > 0L) {
+      evals <- list()
+      for (mi in seq_along(first_vals)) {
+        evals[[sprintf("%s_metric_%d", bst$valid_names[1L], mi)]] <-
+          first_vals[mi]
+      }
+      env <- CB_ENV(bst, i, evals)
+      for (cb in callbacks) {
+        cb(env)
+      }
+      if (isTRUE(env$met_early_stop)) {
+        return(bst)
       }
     }
     if (isTRUE(finished)) {

@@ -1,0 +1,28 @@
+# lgb.unloader — free handles and detach the package.
+# API counterpart of the reference R-package/R/lgb.unloader.R (which detaches
+# the namespace and optionally gc's leftover Booster/Dataset environments so
+# the shared library can be unloaded).
+
+#' Unload the package and release native handles
+#'
+#' @param restore reattach the package afterwards
+#' @param wipe remove lgb.Booster/lgb.Dataset objects from the global env
+#' @param envir environment to sweep when wipe = TRUE
+#' @export
+lgb.unloader <- function(restore = TRUE, wipe = FALSE, envir = .GlobalEnv) {
+  if (wipe) {
+    objs <- ls(envir = envir)
+    drop <- objs[vapply(objs, function(o) {
+      inherits(get(o, envir = envir), c("lgb.Booster", "lgb.Dataset"))
+    }, logical(1L))]
+    rm(list = drop, envir = envir)
+    gc(verbose = FALSE) # runs the externalptr finalizers -> LGBM_*Free
+  }
+  if ("package:lightgbm.tpu" %in% search()) {
+    detach("package:lightgbm.tpu", unload = TRUE)
+  }
+  if (restore) {
+    library(lightgbm.tpu)
+  }
+  invisible(NULL)
+}

@@ -1,0 +1,23 @@
+# readRDS.lgb.Booster — restore a booster saved by saveRDS.lgb.Booster.
+# API counterpart of the reference R-package/R/readRDS.lgb.Booster.R.
+
+#' Load a lgb.Booster from an RDS file
+#'
+#' @param file path written by saveRDS.lgb.Booster
+#' @param ... passed to base::readRDS
+#' @return lgb.Booster with a live handle rebuilt from the stored model text
+#' @export
+readRDS.lgb.Booster <- function(file, ...) {
+  snapshot <- readRDS(file, ...)
+  if (is.null(snapshot$raw)) {
+    stop("lightgbm.tpu: RDS file carries no raw model text; was it written ",
+         "by saveRDS.lgb.Booster?")
+  }
+  bst <- new.env(parent = emptyenv())
+  for (name in names(snapshot)) {
+    bst[[name]] <- snapshot[[name]]
+  }
+  bst$handle <- .Call(LGBT_R_BoosterLoadModelFromString, snapshot$raw)
+  class(bst) <- "lgb.Booster"
+  bst
+}

@@ -1,0 +1,21 @@
+# saveRDS.lgb.Booster — RDS persistence that survives the externalptr.
+# API counterpart of the reference R-package/R/saveRDS.lgb.Booster.R: the
+# booster handle is a C pointer that an ordinary saveRDS would serialize as
+# NULL, so the model text is captured into object$raw first (the reference's
+# lgb.Booster$raw slot) and the handle restored on read.
+
+#' Save a lgb.Booster to an RDS file
+#'
+#' @param object lgb.Booster
+#' @param file destination path
+#' @param ... passed to base::saveRDS
+#' @export
+saveRDS.lgb.Booster <- function(object, file, ...) {
+  object$raw <- .Call(LGBT_R_BoosterSaveModelToString,
+                      lgb.check.handle(object$handle, "Booster"), 0L, -1L)
+  # the externalptr itself is dropped from the serialized image
+  snapshot <- as.list(object)
+  snapshot$handle <- NULL
+  saveRDS(snapshot, file = file, ...)
+  invisible(object)
+}

@@ -286,6 +286,69 @@ SEXP LGBT_R_BoosterPredictForFile(SEXP handle, SEXP data_filename,
   return R_NilValue;
 }
 
+// two-call string protocol helper: size query, then copy
+static SEXP model_string_call(void* h, int start_iter, int num_iter,
+                              int (*fn)(void*, int, int, int64_t, int64_t*,
+                                        char*)) {
+  int64_t need = 0;
+  CHECK_CALL(fn(h, start_iter, num_iter, 0, &need, nullptr));
+  std::vector<char> buf(static_cast<size_t>(need));
+  CHECK_CALL(fn(h, start_iter, num_iter, need, &need, buf.data()));
+  return Rf_mkString(buf.data());
+}
+
+SEXP LGBT_R_BoosterSaveModelToString(SEXP handle, SEXP start_iteration,
+                                     SEXP num_iteration) {
+  return model_string_call(unwrap(handle, booster_tag(), "Booster"),
+                           Rf_asInteger(start_iteration),
+                           Rf_asInteger(num_iteration),
+                           &LGBM_BoosterSaveModelToString);
+}
+
+SEXP LGBT_R_BoosterDumpModel(SEXP handle, SEXP start_iteration,
+                             SEXP num_iteration) {
+  return model_string_call(unwrap(handle, booster_tag(), "Booster"),
+                           Rf_asInteger(start_iteration),
+                           Rf_asInteger(num_iteration),
+                           &LGBM_BoosterDumpModel);
+}
+
+SEXP LGBT_R_BoosterLoadModelFromString(SEXP model_str) {
+  void* out = nullptr;
+  int iters = 0;
+  CHECK_CALL(LGBM_BoosterLoadModelFromString(CHAR(Rf_asChar(model_str)),
+                                             &iters, &out));
+  return wrap_handle(out, booster_tag(), booster_finalizer);
+}
+
+SEXP LGBT_R_BoosterGetFeatureNames(SEXP handle) {
+  void* h = unwrap(handle, booster_tag(), "Booster");
+  // the joined two-call extension sizes the buffer exactly: the char**
+  // ABI call cannot be made overflow-safe for arbitrarily long names
+  int64_t need = 0;
+  CHECK_CALL(LGBT_BoosterGetFeatureNamesJoined(h, 0, &need, nullptr));
+  std::vector<char> joined(static_cast<size_t>(need));
+  CHECK_CALL(LGBT_BoosterGetFeatureNamesJoined(h, need, &need, joined.data()));
+  std::vector<std::pair<const char*, size_t>> parts;
+  const char* p = joined.data();
+  const char* end = joined.data() + (need > 0 ? need - 1 : 0);  // before NUL
+  while (p < end) {
+    const char* sep = static_cast<const char*>(
+        memchr(p, '\x01', static_cast<size_t>(end - p)));
+    const char* stop = sep ? sep : end;
+    parts.emplace_back(p, static_cast<size_t>(stop - p));
+    p = sep ? sep + 1 : end;
+  }
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, parts.size()));
+  for (size_t i = 0; i < parts.size(); ++i) {
+    SET_STRING_ELT(out, i,
+                   Rf_mkCharLen(parts[i].first,
+                                static_cast<int>(parts[i].second)));
+  }
+  UNPROTECT(1);
+  return out;
+}
+
 // registration table (R >= 3.4 native routine registration)
 static const R_CallMethodDef kCallMethods[] = {
     {"LGBT_R_DatasetCreateFromFile", (DL_FUNC)&LGBT_R_DatasetCreateFromFile, 3},
@@ -309,6 +372,13 @@ static const R_CallMethodDef kCallMethods[] = {
     {"LGBT_R_BoosterSaveModel", (DL_FUNC)&LGBT_R_BoosterSaveModel, 3},
     {"LGBT_R_BoosterPredictForMat", (DL_FUNC)&LGBT_R_BoosterPredictForMat, 7},
     {"LGBT_R_BoosterPredictForFile", (DL_FUNC)&LGBT_R_BoosterPredictForFile, 7},
+    {"LGBT_R_BoosterSaveModelToString",
+     (DL_FUNC)&LGBT_R_BoosterSaveModelToString, 3},
+    {"LGBT_R_BoosterDumpModel", (DL_FUNC)&LGBT_R_BoosterDumpModel, 3},
+    {"LGBT_R_BoosterLoadModelFromString",
+     (DL_FUNC)&LGBT_R_BoosterLoadModelFromString, 1},
+    {"LGBT_R_BoosterGetFeatureNames",
+     (DL_FUNC)&LGBT_R_BoosterGetFeatureNames, 1},
     {NULL, NULL, 0}};
 
 void R_init_lightgbm_tpu(DllInfo* dll) {

@@ -309,6 +309,13 @@ int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
                                   void* allgather_ext_fun);
 int LGBM_NetworkFree();
 
+/* EXTENSION (not in the reference ABI): feature names as one
+ * '\x01'-joined string via the two-call protocol — lets callers size
+ * buffers exactly (the char** contract above cannot be overflow-safe). */
+int LGBT_BoosterGetFeatureNamesJoined(BoosterHandle handle,
+                                      int64_t buffer_len, int64_t* out_len,
+                                      char* out_str);
+
 /* Set this thread's last-error message. The reference defines this as a
  * header inline over a static buffer (c_api.h:1000); here it is a real
  * export writing the same thread-local that LGBM_GetLastError reads. */

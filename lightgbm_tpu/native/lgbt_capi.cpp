@@ -924,3 +924,17 @@ LGBT_EXPORT int LGBM_BoosterFeatureImportance(void* handle, int num_iteration,
   Py_DECREF(r);
   return 0;
 }
+
+// Extension beyond the reference ABI: feature names via the two-call string
+// protocol ('\x01'-joined), so callers can size buffers exactly instead of
+// guessing per-name lengths (the fixed-width char** contract of
+// LGBM_BoosterGetFeatureNames cannot be made overflow-safe by the callee).
+LGBT_EXPORT int LGBT_BoosterGetFeatureNamesJoined(void* handle,
+                                                  int64_t buffer_len,
+                                                  int64_t* out_len,
+                                                  char* out_str) {
+  Gil gil;
+  return string_call(
+      call_impl("booster_get_feature_names", "(L)", as_id(handle)),
+      buffer_len, out_len, out_str);
+}

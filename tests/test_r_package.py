@@ -83,3 +83,91 @@ def test_makevars_links_capi():
     mk = open(os.path.join(RPKG, "src", "Makevars")).read()
     assert "_lgbt_capi.so" in mk
     assert "lightgbm_tpu/native" in mk
+
+
+# The reference R package's 20 source files and the function(s) here that
+# cover each one's job. The image carries no R interpreter and cannot
+# install one (no r-base in the apt sources, zero network egress — verified
+# `apt-get install -s r-base` -> "Unable to locate package"), so coverage is
+# asserted structurally: every reference file maps to an implemented,
+# exported function in our R sources.
+REFERENCE_R_SURFACE = {
+    "callback.R": ["cb.print.evaluation", "cb.record.evaluation", "cb.early.stop"],
+    "lgb.Booster.R": ["lgb.Booster.new", "predict.lgb.Booster", "lgb.save", "lgb.load"],
+    "lgb.Dataset.R": ["lgb.Dataset", "lgb.Dataset.create.valid"],
+    "lgb.Predictor.R": ["lgb.Predictor", "lgb.Predictor.predict"],
+    "lgb.cv.R": ["lgb.cv"],
+    "lgb.importance.R": ["lgb.importance"],
+    "lgb.interprete.R": ["lgb.interprete"],
+    "lgb.model.dt.tree.R": ["lgb.model.dt.tree"],
+    "lgb.plot.importance.R": ["lgb.plot.importance"],
+    "lgb.plot.interpretation.R": ["lgb.plot.interpretation"],
+    "lgb.prepare.R": ["lgb.prepare"],
+    "lgb.prepare2.R": ["lgb.prepare2"],
+    "lgb.prepare_rules.R": ["lgb.prepare_rules"],
+    "lgb.prepare_rules2.R": ["lgb.prepare_rules2"],
+    "lgb.train.R": ["lgb.train"],
+    "lgb.unloader.R": ["lgb.unloader"],
+    "lightgbm.R": ["lightgbm"],
+    "readRDS.lgb.Booster.R": ["readRDS.lgb.Booster"],
+    "saveRDS.lgb.Booster.R": ["saveRDS.lgb.Booster"],
+    "utils.R": ["lgb.params2str", "lgb.to.matrix"],
+}
+
+
+def test_reference_r_file_surface_covered():
+    """Every file in /root/reference/R-package/R/ has a counterpart function
+    implemented here (VERDICT round-2 item 6)."""
+    ref_dir = "/root/reference/R-package/R"
+    if os.path.isdir(ref_dir):
+        ref_files = {f for f in os.listdir(ref_dir) if f.endswith(".R")}
+        unmapped = ref_files - set(REFERENCE_R_SURFACE)
+        assert not unmapped, "reference R files with no coverage map: %s" % unmapped
+    all_src = "\n".join(_r_sources().values())
+    missing = [
+        fn
+        for fns in REFERENCE_R_SURFACE.values()
+        for fn in fns
+        if ("%s <- function" % fn) not in all_src
+        and ('`%s` <- function' % fn) not in all_src
+    ]
+    assert not missing, "R functions not implemented: %s" % missing
+
+
+def test_new_exports_in_namespace():
+    ns = open(os.path.join(RPKG, "NAMESPACE")).read()
+    for exp in (
+        "lgb.importance", "lgb.interprete", "lgb.model.dt.tree",
+        "lgb.plot.importance", "lgb.plot.interpretation", "lgb.prepare",
+        "lgb.prepare_rules", "lgb.unloader", "saveRDS.lgb.Booster",
+        "readRDS.lgb.Booster", "lgb.dump", "lgb.model.to.string",
+        "cb.early.stop",
+    ):
+        assert "export(%s)" % exp in ns, exp
+
+
+def test_model_text_parser_agrees_with_python_model():
+    """The R model-text parser's field expectations (Tree= blocks with
+    num_leaves / split_feature / split_gain / threshold / internal_count /
+    leaf_value parallel arrays) hold for models this framework writes —
+    validated from Python since R cannot run: train a model, save it, and
+    check every key lgb.model.dt.tree.R consumes is present per tree."""
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=3,
+    )
+    txt = bst.model_to_string()
+    blocks = txt.split("\nTree=")[1:]
+    assert len(blocks) == 3
+    for b in blocks:
+        for key in ("num_leaves=", "split_feature=", "split_gain=",
+                    "threshold=", "internal_value=", "internal_count=",
+                    "leaf_value=", "leaf_count="):
+            assert key in b, key
