@@ -124,6 +124,40 @@ class SplitResult(NamedTuple):
     cat_bitset: Any = False  # [B] bool bin membership
 
 
+def _bin_prefix(contrib: jax.Array) -> jax.Array:
+    """Inclusive prefix over the bin axis (axis=1 of [..., B, 3]).
+
+    On CPU this is a lax.scan left fold — the same sequential accumulation
+    order as the reference's per-bin loops, and ~2x faster than XLA:CPU's
+    O(B^2) reduce-window lowering of cumsum. Elsewhere (TPU) a 256-step
+    sequential scan would serialize, so jnp.cumsum's reduce-window stays.
+    The two differ by ~1ulp of f32 reassociation; each backend is
+    self-consistent, which is what the dense-vs-EFB tree-equality tests
+    require (any mixed-order scheme flips argmax tie-breaks — a reassociated
+    associative_scan measurably broke tests/test_sparse_efb.py).
+
+    The choice keys off the PROCESS-DEFAULT backend at trace time, not the
+    computation's actual placement: a CPU-placed grow in a TPU-default
+    process traces the reduce-window path (correct, just without the CPU
+    speedup). Per-process platform pinning — what tests/conftest.py and the
+    bench worker do — is the supported way to select the CPU fold.
+    """
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    if backend != "cpu":
+        return jnp.cumsum(contrib, axis=1)
+    xs = jnp.moveaxis(contrib, 1, 0)
+
+    def step(carry, row):
+        carry = carry + row
+        return carry, carry
+
+    _, ys = jax.lax.scan(step, jnp.zeros_like(xs[0]), xs)
+    return jnp.moveaxis(ys, 0, 1)
+
+
 class _ScanOut(NamedTuple):
     """Per-feature best candidates + side-sum arrays for recovery."""
 
@@ -191,12 +225,7 @@ def _scan_candidates(
     excl |= use_na[:, None] & (bins == nan_bin)
     contrib = hist * (~excl)[:, :, None].astype(hist.dtype)  # [F, B, 3]
 
-    # inclusive prefix over bins. MUST stay a sequential scan: a reassociated
-    # prefix (associative_scan — ~2x faster on CPU) perturbs f32 candidate
-    # gains by ~1ulp, which flips argmax tie-breaks between equally-good
-    # splits and breaks the dense-vs-EFB-bundled tree equivalence that
-    # tests/test_sparse_efb.py enforces.
-    prefix = jnp.cumsum(contrib, axis=1)
+    prefix = _bin_prefix(contrib)
     total = prefix[:, -1, :]  # [F, 3] sums over included bins
 
     thresholds = jnp.arange(B, dtype=jnp.int32)[None, :]  # threshold t -> left bins <= t
@@ -320,9 +349,10 @@ def _scan_candidates(
             i+1 bins as the left side. min_data_per_group grouping is sequential
             (the group counter resets only on an emitted candidate) -> lax.scan.
             """
-            lg = jnp.cumsum(h_dir[:, :, 0], axis=1)
-            lh = jnp.cumsum(h_dir[:, :, 1], axis=1) + K_EPSILON
-            lc = jnp.cumsum(h_dir[:, :, 2], axis=1)
+            pref = _bin_prefix(h_dir)  # one scan for all 3 channels
+            lg = pref[:, :, 0]
+            lh = pref[:, :, 1] + K_EPSILON
+            lc = pref[:, :, 2]
             rg = sum_grad - lg
             rh = sum_hess - lh
             rc = num_data - lc
