@@ -1,0 +1,91 @@
+"""Advanced Python-API walkthrough (reference:
+examples/python-guide/advanced_example.py — same feature tour, written for
+this package): weights, init score, categorical features, custom
+objective/metric, continued training, model text/JSON, importances, SHAP.
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(42)
+N = 4000
+X = rng.randn(N, 8)
+X[:, 0] = rng.randint(0, 6, N)  # a categorical column (integer codes)
+cat_effect = np.asarray([-2.0, -1.0, 0.0, 0.5, 1.0, 2.0])
+logits = cat_effect[X[:, 0].astype(int)] + X[:, 1] - 0.5 * X[:, 2]
+y = (logits + rng.randn(N) > 0).astype(float)
+w = 0.5 + rng.rand(N)  # per-row weights
+
+train = lgb.Dataset(
+    X[:3000], label=y[:3000], weight=w[:3000],
+    categorical_feature=[0],
+    free_raw_data=False,
+)
+valid = train.create_valid(X[3000:], label=y[3000:], weight=w[3000:])
+
+params = {
+    "objective": "binary",
+    "metric": ["auc", "binary_logloss"],
+    "num_leaves": 31,
+    "learning_rate": 0.1,
+    "verbosity": -1,
+}
+
+# --- plain training with early stopping -----------------------------------
+evals = {}
+bst = lgb.train(
+    params, train, num_boost_round=40,
+    valid_sets=[valid], valid_names=["valid"],
+    callbacks=[lgb.early_stopping(8, verbose=False),
+               lgb.record_evaluation(evals)],
+)
+print("valid AUC:", evals["valid"]["auc"][-1])
+
+# --- model IO: text, JSON dump, round-trip --------------------------------
+with tempfile.TemporaryDirectory() as d:
+    path = os.path.join(d, "model.txt")
+    bst.save_model(path)
+    reloaded = lgb.Booster(model_file=path)
+    assert np.array_equal(bst.predict(X), reloaded.predict(X))
+    dump = bst.dump_model()
+    print("trees in dump:", len(dump["tree_info"]))
+
+    # continued training: new booster picks up where the saved model stopped
+    bst2 = lgb.train(
+        params, train, num_boost_round=10, init_model=path,
+    )
+    print("continued to", bst2.num_trees(), "trees")
+
+# --- importances + SHAP ---------------------------------------------------
+print("split importance:", bst.feature_importance("split")[:4], "...")
+print("gain  importance:", np.round(bst.feature_importance("gain")[:4], 2), "...")
+contrib = bst.predict(X[:5], pred_contrib=True)
+raw = bst.predict(X[:5], raw_score=True)
+assert np.allclose(contrib.sum(axis=1), raw, atol=1e-6)
+print("SHAP rows sum to raw scores: OK")
+
+# --- custom objective + metric --------------------------------------------
+def logloss_obj(preds, dataset):
+    labels = dataset.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return p - labels, p * (1.0 - p)
+
+
+def brier_metric(preds, dataset):
+    labels = dataset.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return "brier", float(np.mean((p - labels) ** 2)), False
+
+
+bst3 = lgb.train(
+    {"num_leaves": 31, "verbosity": -1, "objective": "none"},
+    train, num_boost_round=15, fobj=logloss_obj, feval=brier_metric,
+    valid_sets=[valid], valid_names=["valid"],
+    callbacks=[lgb.record_evaluation(evals)],
+)
+print("custom-objective brier:", evals["valid"]["brier"][-1])
+print("advanced example done")

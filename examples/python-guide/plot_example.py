@@ -1,0 +1,55 @@
+"""Plotting walkthrough (reference: examples/python-guide/plot_example.py):
+metric curves, feature importance, split-value histogram, and a rendered
+tree — written to files via the Agg backend so it runs headless.
+"""
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(1)
+X = rng.randn(3000, 10)
+y = X[:, 0] * 2 - X[:, 3] + 0.3 * rng.randn(3000)
+
+train = lgb.Dataset(X[:2400], label=y[:2400])
+valid = train.create_valid(X[2400:], label=y[2400:])
+
+evals = {}
+bst = lgb.train(
+    {"objective": "regression", "metric": "l2", "num_leaves": 15,
+     "verbosity": -1},
+    train, num_boost_round=30,
+    valid_sets=[valid], valid_names=["valid"],
+    callbacks=[lgb.record_evaluation(evals)],
+)
+
+out = os.environ.get("PLOT_DIR", ".")
+ax = lgb.plot_metric(evals, metric="l2")
+plt.savefig(os.path.join(out, "metric.png"))
+plt.close("all")
+
+ax = lgb.plot_importance(bst, max_num_features=8)
+plt.savefig(os.path.join(out, "importance.png"))
+plt.close("all")
+
+ax = lgb.plot_split_value_histogram(bst, feature=0)
+plt.savefig(os.path.join(out, "split_values.png"))
+plt.close("all")
+
+made = ["metric.png", "importance.png", "split_values.png"]
+try:
+    ax = lgb.plot_tree(bst, tree_index=0)
+    plt.savefig(os.path.join(out, "tree.png"))
+    plt.close("all")
+    made.append("tree.png")
+except Exception as e:  # rendering trees needs the graphviz `dot` binary
+    print("plot_tree skipped (%s)" % e.__class__.__name__)
+
+for f in made:
+    assert os.path.exists(os.path.join(out, f)), f
+print("plot example done:", " ".join(made))

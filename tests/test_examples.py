@@ -54,7 +54,16 @@ def test_cli_example(task, rounds, tmp_path, monkeypatch):
     assert out.shape[0] == data_rows
 
 
-@pytest.mark.parametrize("script", ["simple_example.py", "sklearn_example.py"])
+@pytest.mark.parametrize(
+    "script",
+    [
+        "simple_example.py",
+        "sklearn_example.py",
+        "advanced_example.py",
+        "logistic_regression.py",
+        "plot_example.py",
+    ],
+)
 def test_python_guide(script, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     path = os.path.join(EXAMPLES, "python-guide", script)
