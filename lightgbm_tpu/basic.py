@@ -129,6 +129,7 @@ class Dataset:
             md.weight = np.asarray(self.weight, np.float32).reshape(-1)
         if self.init_score is not None:
             md.init_score = np.asarray(self.init_score, np.float64)
+            md._validate()  # size check (Metadata::SetInitScore)
         if self.group is not None:
             from .dataset import Metadata
 
@@ -325,7 +326,9 @@ class Dataset:
     def set_init_score(self, init_score) -> "Dataset":
         self.init_score = init_score
         if self._binned is not None and init_score is not None:
-            self._binned.metadata.init_score = np.asarray(init_score, np.float64)
+            md = self._binned.metadata
+            md.init_score = np.asarray(init_score, np.float64)
+            md._validate()  # size check (Metadata::SetInitScore)
         return self
 
     def get_label(self):
@@ -926,10 +929,22 @@ class Booster:
         pred_contrib: bool = False,
         **kwargs,
     ) -> np.ndarray:
+        if isinstance(data, np.ndarray) and data.ndim == 1:
+            # a bare feature vector is ambiguous (1 row? 1 feature?); the
+            # reference python package rejects it with this message
+            raise LightGBMError("Input numpy.ndarray must be 2 dimensional")
         from_pandas = _data_from_pandas(
             data, pandas_categorical=self.pandas_categorical or []
         )
         X = from_pandas[0] if from_pandas is not None else _to_2d_float(data)
+        n_model = self.num_feature()
+        if X.shape[1] != n_model:
+            # Predictor::Predict's guard (the reference fatals with the same
+            # sentence; silent broadcasting would score garbage)
+            raise LightGBMError(
+                "The number of features in data (%d) is not the same as it "
+                "was in training data (%d)" % (X.shape[1], n_model)
+            )
         if pred_leaf:
             return self._gbdt.predict_leaf_index(X, num_iteration)
         if pred_contrib:

@@ -80,6 +80,15 @@ class Metadata:
         for name, arr in (("label", self.label), ("weight", self.weight)):
             if arr is not None and len(arr) != self.num_data:
                 log.fatal("Length of %s (%d) != number of data (%d)" % (name, len(arr), self.num_data))
+        if self.init_score is not None:
+            n = self.init_score.reshape(-1).shape[0]
+            # num_data or num_class * num_data (Metadata::SetInitScore,
+            # metadata.cpp:192 "Initial score size doesn't match data size")
+            if n == 0 or self.num_data == 0 or n % self.num_data != 0:
+                log.fatal(
+                    "Initial score size doesn't match data size (%d vs %d)"
+                    % (n, self.num_data)
+                )
 
     @property
     def num_queries(self) -> int:
@@ -340,6 +349,10 @@ def construct_dataset(
     reference's Dataset::CreateValid / CheckAlign contract, dataset.h:300).
     scipy sparse matrices bin without densifying and may EFB-bundle (efb.py).
     """
+    if data.shape[0] == 0:
+        # DatasetLoader fatals on an empty data file; an empty in-memory
+        # matrix is the same user error, not a trainable dataset
+        log.fatal("Cannot construct a Dataset with 0 rows")
     if _is_scipy_sparse(data):
         return _construct_sparse(
             data, config, label=label, weight=weight, group=group,
