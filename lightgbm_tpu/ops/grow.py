@@ -396,14 +396,16 @@ def grow_tree(
                 # off + rank-within-class (lefts first).
                 is_left = valid & gl
                 is_right = valid & ~gl
-                # int ranks: associative_scan reassociation is exact for ints
+                # int ranks: associative_scan reassociation is exact for ints.
+                # One scan suffices: the segment is contiguous, so a right
+                # element's rank among rights is (in-segment position) minus
+                # (lefts before it) = pos - off - (left_rank + 1).
                 left_rank = jax.lax.associative_scan(jnp.add, is_left.astype(jnp.int32)) - 1
-                right_rank = jax.lax.associative_scan(jnp.add, is_right.astype(jnp.int32)) - 1
                 left_cnt = left_rank[-1] + 1
                 target = jnp.where(
                     is_left,
                     off + left_rank,
-                    jnp.where(is_right, off + left_cnt + right_rank, pos),
+                    jnp.where(is_right, left_cnt + pos - left_rank - 1, pos),
                 )
                 out = jnp.zeros_like(seg).at[target].set(seg, unique_indices=True)
                 order2 = jax.lax.dynamic_update_slice(order, out, (start,))
