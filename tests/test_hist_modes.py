@@ -1,7 +1,7 @@
 """Bucketed (segment-gather) vs masked (full-N) histogram growth equivalence.
 
 The bucketed path is the perf-critical default: a DataPartition-style row
-permutation (data_partition.hpp:20) with power-of-2 gathered buckets makes
+permutation (data_partition.hpp:20) with size-lattice gathered buckets makes
 per-split histogram cost track leaf size, like the reference's ordered-index
 kernels (dense_bin.hpp:71). The masked path is the simple oracle; both must
 produce identical trees and row->leaf assignments.
