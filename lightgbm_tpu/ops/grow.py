@@ -124,10 +124,45 @@ def _unpack_best_row(pb: PackedBest, idx) -> SplitResult:
 _LAUX_SG, _LAUX_SH, _LAUX_ND, _LAUX_MIN, _LAUX_MAX = range(5)
 
 
+class PackedTree(NamedTuple):
+    """Internal packed tree carry: the ~21 single-element wiring scatters per
+    split collapse into 5 (one per array). Node arrays carry M rows; real
+    nodes occupy [0, M-1) and row M-1 is the write-off target for the
+    parent child-pointer update when the split leaf is the root
+    (parent == -1). Unpacked into TreeArrays once, after the grow loop."""
+
+    num_leaves: jax.Array  # scalar int32
+    node_f: jax.Array  # [M, 3] f32: split_gain, internal_value, internal_count
+    node_i: jax.Array  # [M, 4] i32: split_feature, threshold, left/right child
+    node_b: jax.Array  # [M, 1 + B] bool: default_left | cat_member
+    leaf_f: jax.Array  # [M, 3] f32: leaf_value, leaf_count, leaf_weight
+    leaf_i: jax.Array  # [M, 2] i32: leaf_parent, leaf_depth
+
+
+def _unpack_tree(pt: PackedTree, M: int) -> TreeArrays:
+    return TreeArrays(
+        num_leaves=pt.num_leaves,
+        split_feature=pt.node_i[: M - 1, 0],
+        threshold_bin=pt.node_i[: M - 1, 1],
+        default_left=pt.node_b[: M - 1, 0],
+        left_child=pt.node_i[: M - 1, 2],
+        right_child=pt.node_i[: M - 1, 3],
+        split_gain=pt.node_f[: M - 1, 0],
+        internal_value=pt.node_f[: M - 1, 1],
+        internal_count=pt.node_f[: M - 1, 2],
+        leaf_value=pt.leaf_f[:, 0],
+        leaf_count=pt.leaf_f[:, 1],
+        leaf_weight=pt.leaf_f[:, 2],
+        leaf_parent=pt.leaf_i[:, 0],
+        leaf_depth=pt.leaf_i[:, 1],
+        cat_member=pt.node_b[: M - 1, 1:],
+    )
+
+
 class GrowState(NamedTuple):
     it: jax.Array
     leaf_id: jax.Array  # [N] int32 (masked mode; [1] dummy when bucketed)
-    tree: TreeArrays
+    tree: PackedTree
     best: PackedBest  # per-leaf best splits, packed
     laux: jax.Array  # [M, 5] f32: sum_grad, sum_hess, num_data, min/max_con
     hist: jax.Array  # [M, F, B, 3] ([P, F, B, 3] when the pool is capped)
@@ -527,7 +562,7 @@ def grow_tree(
         )(hist, lsg, lsh, lnd, mn, mx, pen)
         exists = jnp.arange(M, dtype=jnp.int32) < tree.num_leaves
         gain = jnp.where(exists, res.gain, neg_inf)
-        gain = depth_gate(gain, tree.leaf_depth)
+        gain = depth_gate(gain, tree.leaf_i[:, 1])
         return res._replace(gain=gain)
 
     # ---- root ----------------------------------------------------------
@@ -582,24 +617,21 @@ def grow_tree(
             jnp.zeros((M, row.b.shape[-1]), bool).at[idx].set(row.b),
         )
 
-    tree0 = TreeArrays(
+    tree0 = PackedTree(
         num_leaves=jnp.int32(1),
-        split_feature=jnp.zeros((M - 1,), jnp.int32),
-        threshold_bin=jnp.zeros((M - 1,), jnp.int32),
-        default_left=jnp.zeros((M - 1,), bool),
-        left_child=jnp.zeros((M - 1,), jnp.int32),
-        right_child=jnp.zeros((M - 1,), jnp.int32),
-        split_gain=jnp.zeros((M - 1,), f32),
-        internal_value=jnp.zeros((M - 1,), f32),
-        internal_count=jnp.zeros((M - 1,), f32),
-        leaf_value=jnp.zeros((M,), f32).at[0].set(
-            calculate_leaf_output(root_g, root_h, params)
+        node_f=jnp.zeros((M, 3), f32),
+        node_i=jnp.zeros((M, 4), jnp.int32),
+        node_b=jnp.zeros((M, 1 + B), bool),
+        leaf_f=jnp.zeros((M, 3), f32).at[0].set(
+            jnp.stack(
+                [calculate_leaf_output(root_g, root_h, params), root_n, root_h]
+            )
         ),
-        leaf_count=jnp.zeros((M,), f32).at[0].set(root_n),
-        leaf_weight=jnp.zeros((M,), f32).at[0].set(root_h),
-        leaf_parent=jnp.full((M,), -1, jnp.int32),
-        leaf_depth=jnp.zeros((M,), jnp.int32),  # root depth 0 (tree.cpp ctor)
-        cat_member=jnp.zeros((M - 1, B), bool),
+        # leaf_parent -1, leaf_depth 0 (root depth 0, tree.cpp ctor)
+        leaf_i=jnp.concatenate(
+            [jnp.full((M, 1), -1, jnp.int32), jnp.zeros((M, 1), jnp.int32)],
+            axis=1,
+        ),
     )
 
     # The [M, F, B, 3] carry only needs slice 0 initialized: every other
@@ -712,55 +744,57 @@ def grow_tree(
             leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, s.leaf_id)
             order, leaf_begin, leaf_phys = s.order, s.leaf_begin, s.leaf_phys
 
-        # ---- wire the tree ------------------------------------------------
+        # ---- wire the tree (5 scatters, PackedTree) ----------------------
         t = s.tree
-        parent = t.leaf_parent[best_leaf]
-        parent_safe = jnp.maximum(parent, 0)
+        child_idx = jnp.stack([best_leaf, new_leaf])
+        parent = t.leaf_i[best_leaf, 0]
+        # row M-1 is the write-off target when the split leaf is the root
+        prow = jnp.where(parent >= 0, parent, M - 1)
         enc_old = -(best_leaf + 1)
-        lc = t.left_child
-        rc = t.right_child
-        lc = lc.at[parent_safe].set(
-            jnp.where((parent >= 0) & (lc[parent_safe] == enc_old), node, lc[parent_safe])
-        )
-        rc = rc.at[parent_safe].set(
-            jnp.where((parent >= 0) & (rc[parent_safe] == enc_old), node, rc[parent_safe])
-        )
-        lc = lc.at[node].set(-(best_leaf + 1))
-        rc = rc.at[node].set(-(new_leaf + 1))
+        old_plc = t.node_i[prow, 2]
+        old_prc = t.node_i[prow, 3]
+        new_plc = jnp.where((parent >= 0) & (old_plc == enc_old), node, old_plc)
+        new_prc = jnp.where((parent >= 0) & (old_prc == enc_old), node, old_prc)
 
-        depth_child = t.leaf_depth[best_leaf] + 1
+        depth_child = t.leaf_i[best_leaf, 1] + 1
         parent_aux = s.laux[best_leaf]  # [5]
         parent_value = calculate_leaf_output(
             parent_aux[_LAUX_SG], parent_aux[_LAUX_SH], params
         )
-        tree = TreeArrays(
+        # (row, col) pairs are distinct: prow < node always (parents are
+        # older nodes), and the write-off row M-1 exceeds every node index
+        node_i = t.node_i.at[
+            jnp.stack([node, node, node, node, prow, prow]),
+            jnp.asarray([0, 1, 2, 3, 2, 3]),
+        ].set(
+            jnp.stack([
+                f, rec.threshold, -(best_leaf + 1), -(new_leaf + 1),
+                new_plc, new_prc,
+            ])
+        )
+        tree = PackedTree(
             num_leaves=t.num_leaves + 1,
-            split_feature=t.split_feature.at[node].set(f),
-            threshold_bin=t.threshold_bin.at[node].set(rec.threshold),
-            default_left=t.default_left.at[node].set(rec.default_left),
-            left_child=lc,
-            right_child=rc,
-            split_gain=t.split_gain.at[node].set(rec.gain),
-            internal_value=t.internal_value.at[node].set(parent_value),
-            internal_count=t.internal_count.at[node].set(parent_aux[_LAUX_ND]),
-            leaf_value=t.leaf_value.at[best_leaf]
-            .set(rec.left_output)
-            .at[new_leaf]
-            .set(rec.right_output),
-            leaf_count=t.leaf_count.at[best_leaf]
-            .set(rec.left_count)
-            .at[new_leaf]
-            .set(rec.right_count),
-            leaf_weight=t.leaf_weight.at[best_leaf]
-            .set(rec.left_sum_hess)
-            .at[new_leaf]
-            .set(rec.right_sum_hess),
-            leaf_parent=t.leaf_parent.at[best_leaf].set(node).at[new_leaf].set(node),
-            leaf_depth=t.leaf_depth.at[best_leaf]
-            .set(depth_child)
-            .at[new_leaf]
-            .set(depth_child),
-            cat_member=t.cat_member.at[node].set(rec.cat_bitset),
+            node_f=t.node_f.at[node].set(
+                jnp.stack([rec.gain, parent_value, parent_aux[_LAUX_ND]])
+            ),
+            node_i=node_i,
+            node_b=t.node_b.at[node].set(
+                jnp.concatenate([rec.default_left[None], rec.cat_bitset])
+            ),
+            leaf_f=t.leaf_f.at[child_idx].set(
+                jnp.stack([
+                    jnp.stack([rec.left_output, rec.left_count,
+                               rec.left_sum_hess]),
+                    jnp.stack([rec.right_output, rec.right_count,
+                               rec.right_sum_hess]),
+                ])
+            ),
+            leaf_i=t.leaf_i.at[child_idx].set(
+                jnp.stack([
+                    jnp.stack([node, depth_child]),
+                    jnp.stack([node, depth_child]),
+                ])
+            ),
         )
 
         # ---- leaf aggregates + monotone windows (one [2,5] scatter) ------
@@ -775,7 +809,6 @@ def grow_tree(
         l_max = jnp.where(mono_f > 0, mid, pmax)
         r_min = jnp.where(mono_f > 0, mid, pmin)
         r_max = jnp.where(mono_f < 0, mid, pmax)
-        child_idx = jnp.stack([best_leaf, new_leaf])
         laux = s.laux.at[child_idx].set(
             jnp.stack(
                 [
@@ -1018,7 +1051,7 @@ def grow_tree(
             )._replace(feature=jnp.int32(feat_i))
             valid = rec.gain > neg_inf
             if max_depth > 0:
-                valid &= state.tree.leaf_depth[leaf_i] < max_depth
+                valid &= state.tree.leaf_i[leaf_i, 1] < max_depth
             can = (~aborted) & valid
             applied = apply_split(state, jnp.int32(leaf_i), rec)
             state = jax.tree_util.tree_map(
@@ -1056,7 +1089,7 @@ def grow_tree(
     else:
         out_leaf_id = final.leaf_id
 
-    out = (final.tree, out_leaf_id)
+    out = (_unpack_tree(final.tree, M), out_leaf_id)
     if cegb_on:
         out = out + ((final.feature_used, final.used_in_data),)
     if hist_buf is not None:
