@@ -1,0 +1,104 @@
+"""The C ABI from a PLAIN C program — no Python host process.
+
+The shim's second operating mode (native/lgbt_capi.cpp: Py_InitializeEx on
+first call) is what makes "callers written against the reference's
+lib_lightgbm.so work unchanged" true for actual C programs, not just
+ctypes. This compiles a real C caller against the shipped header, links
+_lgbt_capi.so, and runs it: dataset from a matrix, label field, 5 boosting
+iterations, prediction, handle frees.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from lightgbm_tpu.capi import load_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "lightgbm_tpu", "native")
+
+C_SOURCE = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+#include "lgbt_c_api.h"
+
+int main(void) {
+  enum { N = 400, F = 4 };
+  static double data[N * F];
+  static float label[N];
+  srand(7);
+  for (int i = 0; i < N; ++i) {
+    for (int j = 0; j < F; ++j)
+      data[i * F + j] = (double)rand() / RAND_MAX - 0.5;
+    label[i] = data[i * F] > 0 ? 1.0f : 0.0f;
+  }
+  DatasetHandle ds = NULL;
+  if (LGBM_DatasetCreateFromMat(data, C_API_DTYPE_FLOAT64, N, F, 1,
+                                "max_bin=31", NULL, &ds)) {
+    fprintf(stderr, "create: %s\n", LGBM_GetLastError());
+    return 1;
+  }
+  if (LGBM_DatasetSetField(ds, "label", label, N, C_API_DTYPE_FLOAT32)) {
+    fprintf(stderr, "label: %s\n", LGBM_GetLastError());
+    return 1;
+  }
+  BoosterHandle bst = NULL;
+  if (LGBM_BoosterCreate(ds, "objective=binary verbosity=-1", &bst)) {
+    fprintf(stderr, "booster: %s\n", LGBM_GetLastError());
+    return 1;
+  }
+  int fin = 0;
+  for (int it = 0; it < 5; ++it)
+    if (LGBM_BoosterUpdateOneIter(bst, &fin)) {
+      fprintf(stderr, "update: %s\n", LGBM_GetLastError());
+      return 1;
+    }
+  int ntot = 0;
+  LGBM_BoosterNumberOfTotalModel(bst, &ntot);
+  static double out[N];
+  int64_t out_len = 0;
+  if (LGBM_BoosterPredictForMat(bst, data, C_API_DTYPE_FLOAT64, N, F, 1, 0,
+                                -1, "", &out_len, out)) {
+    fprintf(stderr, "predict: %s\n", LGBM_GetLastError());
+    return 1;
+  }
+  int correct = 0;
+  for (int i = 0; i < N; ++i)
+    correct += (out[i] > 0.5) == (label[i] > 0.5f);
+  printf("STANDALONE_OK trees=%d len=%lld acc=%.3f\n", ntot,
+         (long long)out_len, (double)correct / N);
+  LGBM_BoosterFree(bst);
+  LGBM_DatasetFree(ds);
+  return 0;
+}
+"""
+
+
+@pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("g++") is None,
+    reason="gcc/g++ not installed (g++ builds the shim itself)",
+)
+def test_plain_c_caller_trains_and_predicts(tmp_path):
+    assert load_lib() is not None  # builds the shim if needed
+    src = tmp_path / "standalone.c"
+    src.write_text(C_SOURCE)
+    exe = tmp_path / "standalone"
+    subprocess.run(
+        [
+            "gcc", str(src), "-I", NATIVE, "-L", NATIVE, "-l:_lgbt_capi.so",
+            "-Wl,-rpath," + NATIVE, "-o", str(exe),
+        ],
+        check=True, capture_output=True, text=True,
+    )
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [str(exe)], env=env, capture_output=True, text=True, timeout=600,
+        cwd=tmp_path,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "STANDALONE_OK trees=5" in r.stdout
+    acc = float(r.stdout.split("acc=")[1])
+    assert acc > 0.95, r.stdout
