@@ -1,0 +1,237 @@
+"""One-command TPU bring-up: staged first-contact validation + bench.
+
+The TPU relay has been dead for rounds 2-3; when it returns, chip time is
+scarce and the first contact must be choreographed, not improvised. This
+script runs, in order, each stage in its own killed-process-group subprocess
+(a timeout-killed TPU client can wedge the tunnel — see BENCH_NOTES.md):
+
+  1. matmul      — device claim + one bf16 matmul (tunnel sanity)
+  2. pallas      — histogram_pallas(interpret=False) vs the numpy oracle at
+                   bench shapes, bf16 and f32 operands. The on-silicon
+                   analogue of the reference GPU path's in-code cross-check
+                   (/root/reference/src/treelearner/gpu_tree_learner.cpp:996-1019).
+  3. smoke       — 100k-row binary training (pow2 lattice to cap compile
+                   cost), train-AUC sanity vs the known CPU value (~0.74)
+  4. bench       — full bench.py on the env-default backend; result copied
+                   to BENCH_TPU.json so the number survives even if the
+                   relay dies again before the driver's end-of-round run.
+
+Every stage appends a JSON line to .tpu_bringup.log and the final summary
+lands in TPU_BRINGUP.json. Run directly, or let the probe chain fire it:
+
+    python helpers/tpu_probe_loop.py && python helpers/tpu_bringup.py
+
+Uses the persistent JAX compilation cache (.jax_cache) so a second contact
+skips the multi-minute compiles.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, ".tpu_bringup.log")
+SUMMARY = os.path.join(REPO, "TPU_BRINGUP.json")
+
+STAGE_TIMEOUTS = {
+    "matmul": 180,
+    "pallas": 900,     # first Mosaic lowering can be slow
+    "smoke": 1800,     # bucket-lattice switch compile at 100k rows
+    "bench": 3600,
+}
+
+_COMMON = """
+import os, sys, time, json
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "axon")
+import jax
+jax.config.update("jax_compilation_cache_dir", %r)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+import jax.numpy as jnp
+""" % os.path.join(REPO, ".jax_cache")
+
+MATMUL = _COMMON + """
+d = jax.devices()
+t0 = time.time()
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+print(json.dumps({"ok": True, "platform": d[0].platform, "n_devices": len(d),
+                  "matmul_s": round(time.time() - t0, 2),
+                  "checksum": float(jnp.sum(y, dtype=jnp.float32))}))
+"""
+
+PALLAS = _COMMON + """
+sys.path.insert(0, %r)
+from lightgbm_tpu.ops.hist_pallas import histogram_pallas
+
+rng = np.random.RandomState(0)
+F, N, B, K = 28, 1 << 18, 255, 3
+bins = rng.randint(0, B, size=(F, N)).astype(np.uint8)
+vals = rng.randn(N, K).astype(np.float32)
+
+def oracle(bins, vals):
+    out = np.zeros((F, B, K), np.float64)
+    for f in range(F):
+        for k in range(K):
+            out[f, :, k] = np.bincount(bins[f], weights=vals[:, k], minlength=B)[:B]
+    return out
+
+ref = oracle(bins, vals)
+res = {}
+for dt in ("float32", "bfloat16"):
+    t0 = time.time()
+    h = np.asarray(histogram_pallas(jnp.asarray(bins), jnp.asarray(vals), B,
+                                    dtype_name=dt, interpret=False))
+    dtime = time.time() - t0
+    err = np.abs(h.astype(np.float64) - ref)
+    rel = err / np.maximum(np.abs(ref), 1.0)
+    res[dt] = {"max_abs": float(err.max()), "max_rel": float(rel.max()),
+               "first_call_s": round(dtime, 2)}
+    # steady-state timing
+    t0 = time.time()
+    for _ in range(5):
+        histogram_pallas(jnp.asarray(bins), jnp.asarray(vals), B,
+                         dtype_name=dt, interpret=False).block_until_ready()
+    res[dt]["per_call_ms"] = round((time.time() - t0) / 5 * 1000, 2)
+# bf16 operands round grad/hess; tolerance mirrors the reference GPU path's
+# single-precision-accumulator acceptance, f32 should be near-exact
+ok = res["float32"]["max_rel"] < 1e-5 and res["bfloat16"]["max_rel"] < 2e-2
+print(json.dumps({"ok": bool(ok), **res}))
+""" % REPO
+
+SMOKE = _COMMON + """
+sys.path.insert(0, %r)
+os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"   # cap first-contact compile cost
+import lightgbm_tpu as lgb
+from lightgbm_tpu.metric import AUCMetric
+
+sys.path.insert(0, %r)
+from bench import make_higgs_like
+X, y = make_higgs_like(100_000, 28)
+params = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
+          "learning_rate": 0.1, "metric": "auc", "verbosity": -1}
+ds = lgb.Dataset(X, label=y)
+bst = lgb.Booster(params=params, train_set=ds)
+t0 = time.time()
+bst.update()
+jax.block_until_ready(bst._gbdt.scores)
+compile_s = time.time() - t0
+t0 = time.time()
+for _ in range(10):
+    bst.update()
+jax.block_until_ready(bst._gbdt.scores)
+bench_s = time.time() - t0
+score = bst._gbdt._train_score_np()
+m = AUCMetric(bst.config); m.init(ds._binned.metadata, ds.num_data())
+auc = float(m.eval(score, bst._gbdt.objective)[0][1])
+print(json.dumps({"ok": auc > 0.70, "first_iter_s": round(compile_s, 1),
+                  "iters_per_sec": round(10 / bench_s, 3),
+                  "train_auc_11_iters": round(auc, 5),
+                  "platform": jax.default_backend()}))
+""" % (REPO, REPO)
+
+
+def log_line(stage: str, payload: dict) -> None:
+    with open(LOG, "a") as f:
+        f.write(json.dumps({"t": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                            "stage": stage, **payload}) + "\n")
+
+
+def _parse_result(out: str):
+    """Last parseable JSON line of stdout, or None. Scans from the end so a
+    stray brace-initial log line (e.g. a printed dict repr) can't shadow the
+    real result; invalid candidates are skipped, not fatal — during the
+    scarce TPU window this script must never die on a parse error."""
+    for l in reversed(out.splitlines()):
+        if l.startswith("{"):
+            try:
+                return json.loads(l)
+            except ValueError:
+                continue
+    return None
+
+
+def _run_child(stage: str, argv, env=None) -> dict:
+    t0 = time.time()
+    proc = subprocess.Popen(
+        argv, cwd=REPO, env=env, start_new_session=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=STAGE_TIMEOUTS[stage])
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        proc.wait()
+        # drain what the wedged stage managed to print before the kill — the
+        # only clue to where first contact stalled (TimeoutExpired itself
+        # carries output=None for Popen.communicate)
+        try:
+            out, err = proc.communicate(timeout=5)
+        except Exception:
+            out, err = "", ""
+        result = {"ok": False, "error": "timeout after %ds" % STAGE_TIMEOUTS[stage],
+                  "stdout_tail": out.strip()[-800:], "stderr_tail": err.strip()[-800:]}
+        result["wall_s"] = round(time.time() - t0, 1)
+        log_line(stage, result)
+        return result
+    result = _parse_result(out)
+    if proc.returncode != 0 or result is None:
+        result = {"ok": False, "error": "rc=%s" % proc.returncode,
+                  "stderr_tail": err.strip()[-800:]}
+    result["wall_s"] = round(time.time() - t0, 1)
+    log_line(stage, result)
+    return result
+
+
+def run_stage(stage: str, src: str) -> dict:
+    return _run_child(stage, [sys.executable, "-c", src])
+
+
+def run_bench() -> dict:
+    env = dict(os.environ)
+    env.pop("BENCH_FORCE_PLATFORMS", None)
+    env["BENCH_TIMEOUT_S"] = str(STAGE_TIMEOUTS["bench"] - 120)
+    result = _run_child("bench", [sys.executable, os.path.join(REPO, "bench.py")], env=env)
+    result.setdefault("ok", result.get("value", 0) > 0)
+    if "metric" in result:
+        with open(os.path.join(REPO, "BENCH_TPU.json"), "w") as f:
+            json.dump({k: v for k, v in result.items() if k not in ("ok", "wall_s")}, f)
+            f.write("\n")
+    return result
+
+
+def main() -> int:
+    summary = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {}}
+    for stage, src in (("matmul", MATMUL), ("pallas", PALLAS), ("smoke", SMOKE)):
+        print("bringup: stage %s ..." % stage, flush=True)
+        result = run_stage(stage, src)
+        summary["stages"][stage] = result
+        print("bringup: %s -> %s" % (stage, json.dumps(result)), flush=True)
+        if not result.get("ok"):
+            # matmul failing = relay gone again; pallas failing = still worth
+            # trying the XLA-impl smoke + bench (bench.py retries with
+            # LIGHTGBM_TPU_HIST_IMPL=xla on TPU worker failure by itself)
+            if stage == "matmul":
+                summary["verdict"] = "relay dead at stage %s" % stage
+                with open(SUMMARY, "w") as f:
+                    json.dump(summary, f, indent=1)
+                return 1
+    print("bringup: stage bench ...", flush=True)
+    summary["stages"]["bench"] = run_bench()
+    ok = summary["stages"]["bench"].get("ok", False)
+    summary["verdict"] = "ok" if ok else "bench failed"
+    with open(SUMMARY, "w") as f:
+        json.dump(summary, f, indent=1)
+    print("bringup: done -> %s" % json.dumps(summary["stages"]["bench"]), flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ..utils.platform import env_choice
 from .histogram import leaf_histogram, leaf_values
 from .split import (
     MISSING_NAN,
@@ -54,6 +55,14 @@ from .split import (
     find_best_split,
     gather_info_for_threshold,
 )
+
+
+# Bucket-lattice override, resolved ONCE at import (like histogram._ENV_IMPL:
+# a trace-time env read would silently keep stale routing for already-compiled
+# shapes). "pow2" drops the 3·2^k family; "coarse" keeps every other power of
+# two — both cap the lax.switch branch count for compile-time-sensitive runs
+# (first TPU contact). Unknown values fall back to the full lattice, loudly.
+_ENV_LATTICE = env_choice("LIGHTGBM_TPU_LATTICE", ("pow2", "coarse"))
 
 
 class TreeArrays(NamedTuple):
@@ -387,13 +396,22 @@ def grow_tree(
     # gathered-segment bucket sizes for the bucketed partition/histogram:
     # the {2^k} ∪ {3·2^k} lattice (x1.33/x1.5 steps) caps round-up waste at
     # 33% where pure powers of two waste up to 2x — worth ~15% of total
-    # histogram work at large shapes for ~1.6x the switch branches
+    # histogram work at large shapes for ~1.6x the switch branches.
+    # _ENV_LATTICE (import-time, like histogram._ENV_IMPL) trades bounded
+    # histogram over-work for lax.switch branch count and therefore
+    # first-contact compile time (20-40s+ per branch class on TPU).
     if bucketed:
-        SIZES = sorted(
-            {min(1 << b, N) for b in range(MIN_BUCKET_LOG2, _ceil_log2(N) + 1)}
-            | {min(3 << b, N) for b in range(MIN_BUCKET_LOG2 - 1, _ceil_log2(N) + 1)}
-            | {N}
-        )
+        step = 2 if _ENV_LATTICE == "coarse" else 1
+        sizes = {
+            min(1 << b, N)
+            for b in range(MIN_BUCKET_LOG2, _ceil_log2(N) + 1, step)
+        }
+        if _ENV_LATTICE == "":
+            sizes |= {
+                min(3 << b, N)
+                for b in range(MIN_BUCKET_LOG2 - 1, _ceil_log2(N) + 1)
+            }
+        SIZES = sorted(sizes | {N})
         sizes_arr = jnp.asarray(SIZES, jnp.int32)
 
     def _segment_slice(order, begin, cnt, S):

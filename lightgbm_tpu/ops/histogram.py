@@ -43,11 +43,9 @@ def _default_backend() -> str:
 # Set LIGHTGBM_TPU_HIST_IMPL before importing lightgbm_tpu (bench.py's
 # Mosaic-failure escape hatch re-execs the worker process for exactly this
 # reason).
-import os as _os
+from ..utils.platform import env_choice
 
-_ENV_IMPL = _os.environ.get("LIGHTGBM_TPU_HIST_IMPL", "").lower()
-if _ENV_IMPL not in ("xla", "scatter", "pallas"):
-    _ENV_IMPL = ""
+_ENV_IMPL = env_choice("LIGHTGBM_TPU_HIST_IMPL", ("xla", "scatter", "pallas"))
 
 
 def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:

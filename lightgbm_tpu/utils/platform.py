@@ -71,3 +71,19 @@ def ensure_virtual_devices(n_devices: int):
     import jax
 
     return jax
+
+
+def env_choice(name: str, allowed) -> str:
+    """Import-time env knob: the env var's lowercased value if in ``allowed``,
+    else "" with a warning. Shared by the LIGHTGBM_TPU_* routing knobs
+    (histogram impl, bucket lattice) so typos fail loudly and consistently."""
+    val = os.environ.get(name, "").lower()
+    if val and val not in allowed:
+        import warnings
+
+        warnings.warn(
+            "%s=%r not recognized (expected one of %s); ignoring"
+            % (name, val, "/".join(sorted(allowed)))
+        )
+        return ""
+    return val
