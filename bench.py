@@ -249,19 +249,30 @@ def _run() -> None:
         pass
     platform = os.environ.get("BENCH_WORKER_PLATFORM", "unknown")
     platforms = os.environ.get("BENCH_FORCE_PLATFORMS")
+    # CPU fallback: the native host learner (device_type=cpu,
+    # ops/grow_native.py — C++ histogram/partition/split-scan kernels with
+    # OpenMP) replaces the XLA serial grower; it measures faster than the
+    # reference CLI on this host (BENCH_NOTES.md) and scales cores via
+    # OpenMP rather than a virtual device mesh. If the native library can't
+    # build on this host, fall back to the previous strategy: shard rows over
+    # virtual CPU devices with the data-parallel learner (must be decided
+    # before the backend initializes — XLA_FLAGS is read at backend init).
     n_shards = 1
     if platform not in ("tpu", "axon"):
-        # CPU fallback parallelism: split rows over virtual CPU devices and
-        # run the data-parallel tree learner (tree-for-tree equal to serial,
-        # tests/test_parallel.py). XLA's CPU scatter is single-threaded per
-        # shard, so the mesh is what buys multi-core throughput here. Must be
-        # set before the backend initializes.
-        n_shards = min(8, os.cpu_count() or 1)
-        if n_shards > 1:
-            flags = os.environ.get("XLA_FLAGS", "")
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=%d" % n_shards
-            ).strip()
+        from lightgbm_tpu import native as _native
+
+        if _native.get_lib() is None:
+            n_shards = min(8, os.cpu_count() or 1)
+            if n_shards > 1:
+                flags = os.environ.get("XLA_FLAGS", "")
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=%d" % n_shards
+                ).strip()
+                print(
+                    "bench: native library unavailable - falling back to the "
+                    "%d-shard virtual-mesh data-parallel learner" % n_shards,
+                    file=sys.stderr, flush=True,
+                )
     if platforms is not None:
         # apply in-process: the env var alone is overridden by sitecustomize's
         # jax.config.update pin (see _PROBE_SRC note). Also sync the env var —
@@ -340,8 +351,11 @@ def _run() -> None:
         "metric": "auc",
         "verbosity": -1,
     }
-    if n_shards > 1 and len(jax.devices()) >= n_shards:
-        params["tree_learner"] = "data"
+    if platform not in ("tpu", "axon"):
+        params["device_type"] = "cpu"  # native host learner (grow_native.py)
+        if n_shards > 1 and len(jax.devices()) >= n_shards:
+            # native library unavailable: virtual-mesh data-parallel fallback
+            params["tree_learner"] = "data"
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     booster = lgb.Booster(params=params, train_set=ds)

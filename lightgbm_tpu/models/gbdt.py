@@ -27,6 +27,7 @@ from ..config import Config
 from ..dataset import BinnedDataset
 from ..metric import Metric
 from ..objective import ObjectiveFunction
+from ..ops import grow_native
 from ..ops.grow import grow_tree
 from ..ops.predict import PredictTree, make_predict_tree, tree_predict_value
 from ..ops.split import CegbParams, SplitParams
@@ -105,6 +106,7 @@ class GBDT:
         )
         meta_np = train_set.feature_meta_arrays()
         self.feature_meta = {k: jnp.asarray(v) for k, v in meta_np.items()}
+        self._feature_meta_np = meta_np  # host copies for the native learner
         # trace-time specialization: the dir=+1 split scan exists only for
         # missing-value handling, so datasets with no missing-typed multi-bin
         # feature compile the single-direction program (ops/split.py two_way)
@@ -478,6 +480,14 @@ class GBDT:
         # resolve the pool cap up front: warns once when a parallel learner
         # ignores a configured histogram_pool_size
         slots = self._hist_pool_slots()
+        if learner == "serial" and grow_native.supported(
+            cfg, self.feature_meta, self._forced_splits, self.cegb_params,
+            self.num_bins,
+        ):
+            # device_type=cpu: the native host learner (ops/grow_native.py) —
+            # the analogue of the reference's C++ CPU tree learner; the
+            # XLA/Pallas grower below is the device (TPU) path
+            return self._train_tree_host(grad_k, hess_k, fmask)
         if learner == "serial":
             # donated scratch for the [P|M, F, B, 3] histogram carry: grow_tree
             # reuses and returns it (aliased), skipping a full-buffer zeros
@@ -541,6 +551,29 @@ class GBDT:
                 tree, leaf_id = out
         # drop shard-padding rows so score updates stay [N]-shaped
         return tree, leaf_id[: self.num_data]
+
+    def _train_tree_host(self, grad_k, hess_k, fmask):
+        """Native host growth (device_type=cpu): numpy/C++ loops over the
+        same jitted split scan; see ops/grow_native.py."""
+        cfg = self.config
+        st = getattr(self, "_native_state", None)
+        if st is None or st.hist.shape[:1] != (cfg.num_leaves,) or \
+                st.hist.shape[2] != self.num_bins:
+            st = grow_native._HostState(
+                np.asarray(self.bins_dev), cfg.num_leaves, self.num_bins,
+                bins_nf=np.asarray(self.bins_dev_nf)
+                if self.bins_dev_nf is not None
+                else None,
+            )
+            self._native_state = st
+        tree, leaf_id = grow_native.grow_tree_native(
+            st,
+            np.asarray(grad_k), np.asarray(hess_k), np.asarray(self._bag_mask),
+            fmask, self.feature_meta, self._feature_meta_np,
+            cfg.num_leaves, cfg.max_depth, self.num_bins, self.split_params,
+            two_way=self._two_way,
+        )
+        return tree, jnp.asarray(leaf_id)
 
     def _hist_pool_slots(self):
         """histogram_pool_size (MB) -> LRU slot count, or None for unlimited

@@ -25,6 +25,7 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 c_double_p = ctypes.POINTER(ctypes.c_double)
+c_float_p = ctypes.POINTER(ctypes.c_float)
 c_int32_p = ctypes.POINTER(ctypes.c_int32)
 c_int8_p = ctypes.POINTER(ctypes.c_int8)
 c_uint8_p = ctypes.POINTER(ctypes.c_uint8)
@@ -73,6 +74,39 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.lgbt_predict_leaf.argtypes = [
         c_double_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
         c_int32_p, c_double_p, c_int8_p, c_int32_p, c_int32_p, c_int32_p,
+    ]
+    lib.lgbt_hist_segment.restype = None
+    lib.lgbt_hist_segment.argtypes = [
+        c_int32_p, ctypes.c_int64, ctypes.c_int64, c_uint8_p, c_uint8_p,
+        ctypes.c_int64, ctypes.c_int64, c_float_p, ctypes.c_int32,
+        c_float_p, c_float_p, ctypes.c_int64,
+    ]
+    lib.lgbt_partition_segment.restype = ctypes.c_int64
+    lib.lgbt_partition_segment.argtypes = [
+        c_int32_p, ctypes.c_int64, ctypes.c_int64, c_uint8_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, c_uint8_p, c_int32_p,
+    ]
+    lib.lgbt_alloc.restype = ctypes.c_void_p
+    lib.lgbt_alloc.argtypes = [ctypes.c_int64]
+    lib.lgbt_free.restype = None
+    lib.lgbt_free.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.lgbt_rowrec_init.restype = None
+    lib.lgbt_rowrec_init.argtypes = [
+        c_uint8_p, ctypes.c_int64, ctypes.c_int64, c_uint8_p,
+    ]
+    lib.lgbt_rowrec_set_vals.restype = None
+    lib.lgbt_rowrec_set_vals.argtypes = [c_float_p, ctypes.c_int64, c_uint8_p]
+    lib.lgbt_best_split_numerical.restype = None
+    lib.lgbt_best_split_numerical.argtypes = [
+        c_float_p, ctypes.c_int64, ctypes.c_int32,  # hist, F, B
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,  # sums
+        ctypes.c_float, ctypes.c_float,  # min_c, max_c
+        c_int32_p, c_int32_p, c_int32_p, c_int32_p, c_uint8_p,  # meta + mask
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,  # l1, l2, mds
+        ctypes.c_float, ctypes.c_float, ctypes.c_float,  # min_data/hess/gain
+        ctypes.c_int32,  # two_way
+        c_float_p, c_int32_p, c_uint8_p,  # out_f, out_i, out_b
     ]
 
 
@@ -191,6 +225,166 @@ def values_to_bins_numerical(
             None, out.ctypes.data_as(c_int32_p), 0,
         )
     return out
+
+
+def hist_scratch_size(n: int, num_features: int, num_bins: int) -> int:
+    """f32 elements the hist_segment scratch needs: the column pass gathers
+    [cnt, 3] ordered values into it (the row-record pass needs no scratch)."""
+    del num_features, num_bins  # row pass accumulates straight into `out`
+    return n * 3
+
+
+class HugeArrays:
+    """Hugepage-backed numpy allocations (lgbt_alloc / MADV_HUGEPAGE).
+
+    The host learner's random-access arrays (row records, bin matrices) pay a
+    TLB miss + virtualized page walk per cache-line fill on 4K pages; 2MB
+    pages keep them TLB-resident (measured 3-5x on the histogram pass).
+    Lifetime is per-array: each mapping is released by a weakref finalizer on
+    the ctypes buffer the returned ndarray holds as its base, so an array
+    that escapes its creator stays valid until ITS last reference dies.
+    """
+
+    def empty(self, shape, dtype) -> np.ndarray:
+        import weakref
+
+        lib = get_lib()
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        ptr = lib.lgbt_alloc(nbytes) if lib is not None and nbytes > 0 else None
+        if not ptr:
+            return np.empty(shape, dtype)
+        buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        weakref.finalize(buf, lib.lgbt_free, ptr, nbytes)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+
+REC_SIZE = 64  # bytes per row record (one cache line): bins strip + g/h/c
+
+
+def rowrec_build(bins_nf: np.ndarray, alloc: Optional[HugeArrays] = None) -> Optional[np.ndarray]:
+    """[N, 64] uint8 row records with the static bin strips filled; None when
+    the native library is unavailable or F > 48 (vals occupy bytes 48..59).
+    Allocated from ``alloc`` (hugepages) when given."""
+    lib = get_lib()
+    N, F = bins_nf.shape
+    if lib is None or F > 48:
+        return None
+    rec = (alloc.empty if alloc is not None else np.empty)((N, REC_SIZE), np.uint8)
+    lib.lgbt_rowrec_init(bins_nf.ctypes.data_as(c_uint8_p), N, F,
+                         rec.ctypes.data_as(c_uint8_p))
+    return rec
+
+
+def rowrec_set_vals(rec: np.ndarray, vals: np.ndarray) -> None:
+    """Refresh the per-tree (grad*bag, hess*bag, bag) slots of the records."""
+    lib = get_lib()
+    lib.lgbt_rowrec_set_vals(vals.ctypes.data_as(c_float_p), rec.shape[0],
+                             rec.ctypes.data_as(c_uint8_p))
+
+
+def hist_segment(
+    order: np.ndarray, begin: int, cnt: int, bins_fn: np.ndarray,
+    rowrec: Optional[np.ndarray], vals: np.ndarray, num_bins: int,
+    og_scratch: np.ndarray, out: Optional[np.ndarray] = None,
+    row_pass_min: int = 1 << 62,
+) -> Optional[np.ndarray]:
+    """[F, B, 3] ordered histogram of rows order[begin:begin+cnt).
+
+    ``bins_fn`` is the [F, N] uint8 bin matrix and ``rowrec`` the optional
+    [N, 64] row-record array (rowrec_build + rowrec_set_vals) enabling the
+    one-line-per-row pass for large segments; ``vals`` the [N, 3] f32
+    (grad*bag, hess*bag, bag) accumulands, ``og_scratch`` a reusable
+    >= hist_scratch_size(...) f32 buffer. None when the native library is
+    unavailable.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    F, N = bins_fn.shape
+    if out is None:
+        out = np.empty((F, num_bins, 3), np.float32)
+    lib.lgbt_hist_segment(
+        order.ctypes.data_as(c_int32_p), int(begin), int(cnt),
+        bins_fn.ctypes.data_as(c_uint8_p),
+        rowrec.ctypes.data_as(c_uint8_p) if rowrec is not None else None,
+        N, F,
+        vals.ctypes.data_as(c_float_p), int(num_bins),
+        og_scratch.ctypes.data_as(c_float_p), out.ctypes.data_as(c_float_p),
+        int(row_pass_min),
+    )
+    return out
+
+
+def partition_segment(
+    order: np.ndarray, begin: int, cnt: int, col: np.ndarray,
+    threshold: int, default_left: bool, missing_type: int, default_bin: int,
+    nan_bin: int, is_cat: bool, member: Optional[np.ndarray],
+    tmp_scratch: np.ndarray,
+) -> Optional[int]:
+    """Stable in-place partition of order[begin:begin+cnt); returns the left
+    count, or None when the native library is unavailable. ``col`` is one
+    feature's [N] uint8 column; ``member`` the [B] uint8 bitset for
+    categorical splits; ``tmp_scratch`` a reusable >= cnt int32 buffer."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    return lib.lgbt_partition_segment(
+        order.ctypes.data_as(c_int32_p), int(begin), int(cnt),
+        col.ctypes.data_as(c_uint8_p), int(threshold), int(bool(default_left)),
+        int(missing_type), int(default_bin), int(nan_bin), int(bool(is_cat)),
+        member.ctypes.data_as(c_uint8_p) if member is not None else None,
+        tmp_scratch.ctypes.data_as(c_int32_p),
+    )
+
+
+class SplitScanMeta:
+    """Pre-marshalled per-feature meta + params for best_split_numerical."""
+
+    def __init__(self, num_bin, missing, default_bin, mono, params, two_way):
+        self.num_bin = np.ascontiguousarray(num_bin, np.int32)
+        self.missing = np.ascontiguousarray(missing, np.int32)
+        self.default_bin = np.ascontiguousarray(default_bin, np.int32)
+        self.mono = np.ascontiguousarray(mono, np.int32)
+        self.params = params
+        self.two_way = int(bool(two_way))
+        self._ptrs = (
+            self.num_bin.ctypes.data_as(c_int32_p),
+            self.missing.ctypes.data_as(c_int32_p),
+            self.default_bin.ctypes.data_as(c_int32_p),
+            self.mono.ctypes.data_as(c_int32_p),
+        )
+
+
+def best_split_numerical(
+    hist: np.ndarray,  # [F, B, 3] f32 contiguous
+    sum_grad: float, sum_hess: float, num_data: float,
+    min_c: float, max_c: float,
+    meta: SplitScanMeta, fmask_u8: np.ndarray,
+    out_f: np.ndarray, out_i: np.ndarray, out_b: np.ndarray,
+) -> bool:
+    """Native FindBestThresholdNumerical; fills the packed best row
+    (out_f [9] f32, out_i [3] i32, out_b [1+B] u8). False when the native
+    library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return False
+    F, B, _ = hist.shape
+    p = meta.params
+    lib.lgbt_best_split_numerical(
+        hist.ctypes.data_as(c_float_p), F, B,
+        float(sum_grad), float(sum_hess), float(num_data),
+        float(min_c), float(max_c),
+        *meta._ptrs,
+        fmask_u8.ctypes.data_as(c_uint8_p),
+        float(p.lambda_l1), float(p.lambda_l2), float(p.max_delta_step),
+        float(p.min_data_in_leaf), float(p.min_sum_hessian_in_leaf),
+        float(p.min_gain_to_split),
+        meta.two_way,
+        out_f.ctypes.data_as(c_float_p), out_i.ctypes.data_as(c_int32_p),
+        out_b.ctypes.data_as(c_uint8_p),
+    )
+    return True
 
 
 def predict_leaf(X: np.ndarray, tree) -> Optional[np.ndarray]:

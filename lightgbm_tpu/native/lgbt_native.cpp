@@ -26,6 +26,8 @@
 #include <omp.h>
 #endif
 
+#include <sys/mman.h>
+
 namespace {
 
 constexpr double kZeroThreshold = 1e-35;  // meta.h:44
@@ -358,6 +360,448 @@ void lgbt_predict_leaf(const double* X, int64_t n, int64_t F,
     }
     out_leaf[r] = -(node + 1);
   }
+}
+
+// ---------------------------------------------------------------------------
+// Host tree-learner kernels (the device_type=cpu path, ops/grow_native.py).
+//
+// The two RAM-latency-bound inner loops of histogram tree growth that XLA's
+// CPU backend runs poorly (its scatter-add lowers to serial per-element
+// updates with no software prefetch): per-leaf ordered histograms and the
+// stable leaf partition. Design follows the reference's CPU architecture —
+// ordered gradients gathered once per leaf, then per-feature passes over an
+// L1-resident accumulator (src/treelearner/serial_tree_learner.cpp:331-420,
+// src/io/dense_bin.hpp:71-167) — re-implemented fresh: f64 accumulation into
+// two interleaved sub-accumulators (breaks same-bin add dependences) with
+// +PREFETCH_AHEAD software prefetch on the bin gather.
+// ---------------------------------------------------------------------------
+
+// Ordered [F, B, 3] (sum_grad, sum_hess, count) histogram of the rows
+// order[begin : begin+cnt).
+//   bins_fn: [F, N] feature-major bin matrix (uint8; B <= 256)
+//   bins_nf: [N, F] row-major copy (may be null: column path only)
+//   vals:    [N, 3] f32 (grad*bag, hess*bag, bag) — bag-zeroed rows add 0
+//   og:      caller scratch, >= max(cnt*3 floats, F*B*3 doubles)
+//   out:     [F, B, 3] f32
+//
+// Two pass shapes:
+//  * row-record (default): one pass over rows; each row costs ONE cache-line
+//    fill of its 64-byte record (bin strip + g/h/c packed by
+//    lgbt_rowrec_init/set_vals) plus 3F f32 adds into the L2-resident
+//    [F, B, 3] output (258KB at F=28/B=256; L2 is 2MB here).
+//  * column-major (fallback, F > 48): per-feature passes over an L1-resident
+//    [B, 3] f64 accumulator pair — F column gathers per row,
+//    software-prefetched, ordered-gradients gathered once.
+// Deterministic under any OMP thread count: work splits by feature (column
+// pass) or not at all (row pass); each accumulator sees rows in segment
+// order.
+static void hist_columns(const int32_t* idx, int64_t cnt,
+                         const uint8_t* bins_fn, int64_t N, int64_t F,
+                         const float* og, int32_t B, float* out) {
+  constexpr int64_t kPrefetchAhead = 32;
+#pragma omp parallel for schedule(static)
+  for (int64_t f = 0; f < F; ++f) {
+    const uint8_t* col = bins_fn + f * N;
+    // two interleaved f32 sub-accumulators (6KB, L1-resident): adjacent rows
+    // hitting the same bin don't serialize on one add chain. f32 matches the
+    // row pass / device paths' single-precision accumulation.
+    float acc0[256 * 3] = {0.0f};
+    float acc1[256 * 3] = {0.0f};
+    int64_t i = 0;
+    for (; i + 1 < cnt; i += 2) {
+      if (i + kPrefetchAhead < cnt) {
+        __builtin_prefetch(col + idx[i + kPrefetchAhead], 0, 0);
+      }
+      const int b0 = col[idx[i]] * 3;
+      const int b1 = col[idx[i + 1]] * 3;
+      const float* g0 = og + i * 3;
+      acc0[b0 + 0] += g0[0];
+      acc0[b0 + 1] += g0[1];
+      acc0[b0 + 2] += g0[2];
+      acc1[b1 + 0] += g0[3];
+      acc1[b1 + 1] += g0[4];
+      acc1[b1 + 2] += g0[5];
+    }
+    if (i < cnt) {
+      const int b0 = col[idx[i]] * 3;
+      const float* g0 = og + i * 3;
+      acc0[b0 + 0] += g0[0];
+      acc0[b0 + 1] += g0[1];
+      acc0[b0 + 2] += g0[2];
+    }
+    float* dst = out + f * B * 3;
+    for (int k = 0; k < B * 3; ++k) {
+      dst[k] = acc0[k] + acc1[k];
+    }
+  }
+}
+
+// Hugepage-backed allocation for the learner's large random-access arrays
+// (row records, bin matrices). The histogram pass is one random cache-line
+// fill per row; with 4K pages over a 64MB array nearly every fill also pays
+// a dTLB miss + (virtualized, EPT double) page walk — measured 3-5x the
+// line-fill cost on this host. MADV_HUGEPAGE collapses the range to 2MB
+// pages so the whole array stays TLB-resident.
+void* lgbt_alloc(int64_t bytes) {
+  void* p = mmap(nullptr, static_cast<size_t>(bytes), PROT_READ | PROT_WRITE,
+                 MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (p == MAP_FAILED) return nullptr;
+  madvise(p, static_cast<size_t>(bytes), MADV_HUGEPAGE);
+  return p;
+}
+
+void lgbt_free(void* p, int64_t bytes) {
+  if (p) munmap(p, static_cast<size_t>(bytes));
+}
+
+// Row records: one 64-byte (cache-line) record per row packing the bin strip
+// with that row's (grad*bag, hess*bag, bag) floats, so the row-major
+// histogram pass costs exactly ONE line fill per row instead of two random
+// streams (bins_nf strip + vals). The bin part is static per dataset; the
+// vals slots are refreshed once per tree (lgbt_rowrec_set_vals).
+constexpr int64_t kRecSize = 64;
+constexpr int64_t kRecValsOff = 48;  // f32 g,h,c at bytes 48..59; F <= 48
+
+void lgbt_rowrec_init(const uint8_t* bins_nf, int64_t N, int64_t F,
+                      uint8_t* rec) {
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < N; ++r) {
+    memcpy(rec + r * kRecSize, bins_nf + r * F, F);
+  }
+}
+
+void lgbt_rowrec_set_vals(const float* vals, int64_t N, uint8_t* rec) {
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < N; ++r) {
+    memcpy(rec + r * kRecSize + kRecValsOff, vals + r * 3, 3 * sizeof(float));
+  }
+}
+
+static void hist_rows(const int32_t* idx, int64_t cnt, const uint8_t* rec,
+                      int64_t F, int32_t B, float* out) {
+  // f32 accumulation directly into `out` — the same single-precision trade
+  // the device paths make (XLA f32 scatter / the Pallas kernel's f32
+  // accumulator; the reference GPU path validates the AUC parity of exactly
+  // this trade, docs/GPU-Performance.rst:131-145). Keeps the hot block at
+  // 258KB (L2) instead of a 516KB f64 block, measured 20-40% faster.
+  constexpr int64_t kPrefetchAhead = 16;
+  memset(out, 0, static_cast<size_t>(F) * B * 3 * sizeof(float));
+  for (int64_t i = 0; i < cnt; ++i) {
+    if (i + kPrefetchAhead < cnt) {
+      __builtin_prefetch(rec + static_cast<int64_t>(idx[i + kPrefetchAhead]) * kRecSize, 0, 0);
+    }
+    const uint8_t* row = rec + static_cast<int64_t>(idx[i]) * kRecSize;
+    float g, h, c;
+    memcpy(&g, row + kRecValsOff, 4);
+    memcpy(&h, row + kRecValsOff + 4, 4);
+    memcpy(&c, row + kRecValsOff + 8, 4);
+    for (int64_t f = 0; f < F; ++f) {
+      float* a = out + (f * B + row[f]) * 3;
+      a[0] += g;
+      a[1] += h;
+      a[2] += c;
+    }
+  }
+}
+
+void lgbt_hist_segment(const int32_t* order, int64_t begin, int64_t cnt,
+                       const uint8_t* bins_fn, const uint8_t* rowrec,
+                       int64_t N, int64_t F, const float* vals, int32_t B,
+                       float* og, float* out, int64_t row_pass_min) {
+  if (B > 256 || cnt < 0) return;
+  const int32_t* idx = order + begin;
+  // Pass choice: the row-record pass streams ~one line fill per row from the
+  // 64B-per-row record array — unbeatable for large/dense segments, but for
+  // mid-size sparse leaves every fill is a cold line from a 64MB range. The
+  // column pass bounds its working set to one [N]-byte column (plus the L1
+  // accumulators) per feature, so sibling leaves re-hit the same cached
+  // column lines. Crossover tuned by the caller (row_pass_min rows).
+  if (rowrec != nullptr && F <= kRecValsOff && cnt >= row_pass_min) {
+    hist_rows(idx, cnt, rowrec, F, B, out);
+    return;
+  }
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < cnt; ++i) {
+    const float* v = vals + static_cast<int64_t>(idx[i]) * 3;
+    og[i * 3 + 0] = v[0];
+    og[i * 3 + 1] = v[1];
+    og[i * 3 + 2] = v[2];
+  }
+  hist_columns(idx, cnt, bins_fn, N, F, og, B, out);
+}
+
+// Stable in-place partition of order[begin : begin+cnt): rows going left
+// first (original relative order kept on both sides), returns the left
+// count. Decision semantics mirror ops/grow.py _decision_go_left exactly
+// (dense_bin.hpp Split / tree.h:275 CategoricalDecisionInner):
+//   go_left = bin <= threshold
+//   missing_type ZERO(1): bin == default_bin -> default_left
+//   missing_type NAN(2):  bin == nan_bin    -> default_left
+//   is_cat: go_left = member[bin]  (no default-direction logic)
+//   member: [B] uint8 left-side membership bitset (may be null when !is_cat)
+//   tmp: caller scratch, >= cnt int32
+int64_t lgbt_partition_segment(int32_t* order, int64_t begin, int64_t cnt,
+                               const uint8_t* col, int32_t threshold,
+                               int32_t default_left, int32_t missing_type,
+                               int32_t default_bin, int32_t nan_bin,
+                               int32_t is_cat, const uint8_t* member,
+                               int32_t* tmp) {
+  int32_t* seg = order + begin;
+  int64_t nl = 0, nr = 0;
+  if (is_cat) {
+    for (int64_t i = 0; i < cnt; ++i) {
+      const int32_t r = seg[i];
+      if (member[col[r]])
+        seg[nl++] = r;
+      else
+        tmp[nr++] = r;
+    }
+  } else {
+    for (int64_t i = 0; i < cnt; ++i) {
+      const int32_t r = seg[i];
+      const int32_t b = col[r];
+      bool go_left = b <= threshold;
+      if (missing_type == 1 && b == default_bin) go_left = default_left;
+      if (missing_type == 2 && b == nan_bin) go_left = default_left;
+      if (go_left)
+        seg[nl++] = r;
+      else
+        tmp[nr++] = r;
+    }
+  }
+  memcpy(seg + nl, tmp, nr * sizeof(int32_t));
+  return nl;
+}
+
+// ---------------------------------------------------------------------------
+// Numerical best-split scan (FindBestThresholdNumerical) — the native twin of
+// ops/split.py find_best_split for the host learner's hot loop. Strictly f32
+// with the same operation order as the jitted scan (sequential bin prefix,
+// identical kEpsilon placements, identical tie-break comparisons), so results
+// are bit-identical to the XLA CPU path (pinned by tests/test_grow_native.py).
+// NOTE: this translation unit must stay free of -march/-ffast-math flags —
+// FMA contraction or reassociation would break that equality. Numerical
+// features only; callers route categorical datasets through the jitted scan.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+constexpr float kEps = 1e-15f;        // meta.h:42 kEpsilon
+constexpr float kNegInf = -std::numeric_limits<float>::infinity();
+
+inline float ThrL1(float s, float l1) {
+  if (l1 == 0.0f) return s;
+  float a = std::fabs(s) - l1;
+  if (a < 0.0f) a = 0.0f;
+  return (s > 0.0f ? 1.0f : (s < 0.0f ? -1.0f : 0.0f)) * a;
+}
+
+inline float LeafOut(float sg, float sh, float l1, float l2, float mds) {
+  float ret = -ThrL1(sg, l1) / (sh + l2);
+  if (mds > 0.0f) {
+    if (ret > mds) ret = mds;
+    if (ret < -mds) ret = -mds;
+  }
+  return ret;
+}
+
+inline float Clip(float v, float lo, float hi) {
+  // jnp.clip semantics: max then min
+  if (v < lo) v = lo;
+  if (v > hi) v = hi;
+  return v;
+}
+
+inline float GainGivenOut(float sg, float sh, float out, float l1, float l2) {
+  float sg_l1 = ThrL1(sg, l1);
+  return -(2.0f * sg_l1 * out + (sh + l2) * out * out);
+}
+
+inline float LeafSplitGain(float sg, float sh, float l1, float l2, float mds) {
+  float out = LeafOut(sg, sh, l1, l2, mds);
+  return GainGivenOut(sg, sh, out, l1, l2);
+}
+
+}  // namespace
+
+// out_f layout (ops/grow.py _BEST_F): gain, lsg, lsh, lcn, rsg, rsh, rcn,
+// lout, rout. out_i (_BEST_I): feature, threshold, num_cat. out_b: [1 + B]
+// default_left | cat_bitset(bins == threshold).
+void lgbt_best_split_numerical(
+    const float* hist, int64_t F, int32_t B, float sum_grad, float sum_hess,
+    float num_data, float min_c, float max_c, const int32_t* num_bin,
+    const int32_t* missing, const int32_t* dbin, const int32_t* mono,
+    const uint8_t* fmask, float l1, float l2, float mds, float min_data,
+    float min_hess, float min_gain, int32_t two_way, float* out_f,
+    int32_t* out_i, uint8_t* out_b) {
+  const float sum_hess_eff = sum_hess + 2.0f * kEps;  // feature_histogram.hpp:87
+  const float gain_shift = LeafSplitGain(sum_grad, sum_hess_eff, l1, l2, mds);
+  const float min_gain_shift = gain_shift + min_gain;
+
+  float best_gain = kNegInf;
+  int32_t best_f = -1, best_t = 0;
+  bool best_dl = false, best_use_pos = false;
+
+  std::vector<float> pg(B), ph(B), pc(B);
+
+  for (int64_t f = 0; f < F; ++f) {
+    if (!fmask[f]) continue;
+    const int32_t nb = num_bin[f];
+    const int32_t mt = missing[f];
+    const int32_t db = dbin[f];
+    const bool multi = nb > 2;
+    const bool use_na = (mt == 2) && multi;
+    const bool skip_def = (mt == 1) && multi;
+    const bool single_scan = !(use_na || skip_def);
+    const float* h = hist + f * B * 3;
+
+    // sequential masked f32 prefix (the _bin_prefix CPU fold order)
+    float ag = 0.0f, ah = 0.0f, ac = 0.0f;
+    for (int32_t b = 0; b < B; ++b) {
+      const bool excl =
+          (b >= nb) || (skip_def && b == db) || (use_na && b == nb - 1);
+      ag += excl ? 0.0f : h[b * 3 + 0];
+      ah += excl ? 0.0f : h[b * 3 + 1];
+      ac += excl ? 0.0f : h[b * 3 + 2];
+      pg[b] = ag;
+      ph[b] = ah;
+      pc[b] = ac;
+    }
+    const float tg = pg[B - 1], th = ph[B - 1], tc = pc[B - 1];
+    const int32_t mono_f = mono[f];
+
+    auto cand_gain = [&](float lg, float lh, float rg, float rh, float lc,
+                         float rc) -> float {
+      if (!(lc >= min_data && rc >= min_data && lh >= min_hess &&
+            rh >= min_hess))
+        return kNegInf;
+      const float lo = Clip(LeafOut(lg, lh, l1, l2, mds), min_c, max_c);
+      const float ro = Clip(LeafOut(rg, rh, l1, l2, mds), min_c, max_c);
+      float g = GainGivenOut(lg, lh, lo, l1, l2) +
+                GainGivenOut(rg, rh, ro, l1, l2);
+      if ((mono_f > 0 && lo > ro) || (mono_f < 0 && lo < ro)) g = 0.0f;
+      if (!(g > min_gain_shift)) return kNegInf;
+      return g;
+    };
+
+    // dir = -1 (right-to-left accumulation; default_left = true): the
+    // reference prefers the LARGEST threshold among equal gains -> descend.
+    float g_neg = kNegInf;
+    int32_t t_neg = B - 1;
+    {
+      const int32_t t_hi = nb - 2 - (use_na ? 1 : 0);
+      for (int32_t t = (t_hi < B - 1 ? t_hi : B - 1); t >= 0; --t) {
+        if (skip_def && t == db - 1) continue;
+        const float rg_raw = tg - pg[t];
+        const float rh_raw = th - ph[t];
+        const float rc = tc - pc[t];
+        const float rh = rh_raw + kEps;
+        const float lg = sum_grad - rg_raw;
+        const float lh = sum_hess_eff - rh;
+        const float lc = num_data - rc;
+        const float g = cand_gain(lg, lh, rg_raw, rh, lc, rc);
+        if (g > g_neg) {
+          g_neg = g;
+          t_neg = t;
+        }
+      }
+    }
+
+    // dir = +1 (left-to-right; default_left = false): only the missing-value
+    // scans; smallest threshold wins ties -> ascend; must STRICTLY beat neg.
+    float g_pos = kNegInf;
+    int32_t t_pos = 0;
+    if (two_way && !single_scan) {
+      for (int32_t t = 0; t <= nb - 2 && t < B; ++t) {
+        if (skip_def && t == db) continue;
+        const float lg = pg[t];
+        const float lh = ph[t] + kEps;
+        const float lc = pc[t];
+        const float rg = sum_grad - lg;
+        const float rh = sum_hess_eff - lh;
+        const float rc = num_data - lc;
+        const float g = cand_gain(lg, lh, rg, rh, lc, rc);
+        if (g > g_pos) {
+          g_pos = g;
+          t_pos = t;
+        }
+      }
+    }
+
+    const bool use_pos = g_pos > g_neg;
+    const float gf = use_pos ? g_pos : g_neg;
+    // cross-feature: strict > keeps the FIRST maximum (feature index order)
+    if (gf > best_gain) {
+      best_gain = gf;
+      best_f = static_cast<int32_t>(f);
+      best_t = use_pos ? t_pos : t_neg;
+      best_use_pos = use_pos;
+      // default_left = (dir == -1); 2-bin NaN features keep false
+      best_dl = !use_pos && !((mt == 2) && !multi);
+    }
+  }
+
+  // recover the chosen candidate's side sums (find_best_split pick())
+  float lsg = 0.0f, lsh = kEps, lcn = 0.0f;
+  if (best_f >= 0) {
+    const int32_t nb = num_bin[best_f];
+    const int32_t mt = missing[best_f];
+    const int32_t db = dbin[best_f];
+    const bool multi = nb > 2;
+    const bool use_na = (mt == 2) && multi;
+    const bool skip_def = (mt == 1) && multi;
+    const float* h = hist + static_cast<int64_t>(best_f) * B * 3;
+    float ag = 0.0f, ah = 0.0f, ac = 0.0f;
+    float pgt = 0.0f, pht = 0.0f, pct = 0.0f;
+    float tgf = 0.0f, thf = 0.0f, tcf = 0.0f;
+    for (int32_t b = 0; b < B; ++b) {
+      const bool excl =
+          (b >= nb) || (skip_def && b == db) || (use_na && b == nb - 1);
+      ag += excl ? 0.0f : h[b * 3 + 0];
+      ah += excl ? 0.0f : h[b * 3 + 1];
+      ac += excl ? 0.0f : h[b * 3 + 2];
+      if (b == best_t) {
+        pgt = ag;
+        pht = ah;
+        pct = ac;
+      }
+    }
+    tgf = ag;
+    thf = ah;
+    tcf = ac;
+    if (best_use_pos) {
+      lsg = pgt;
+      lsh = pht + kEps;
+      lcn = pct;
+    } else {
+      const float rg_raw = tgf - pgt;
+      const float rh = (thf - pht) + kEps;
+      lsg = sum_grad - rg_raw;
+      lsh = sum_hess_eff - rh;
+      lcn = num_data - (tcf - pct);
+    }
+  }
+  const float rsg = sum_grad - lsg;
+  const float rsh = sum_hess_eff - lsh;
+  const float rcn = num_data - lcn;
+  const float lout = Clip(LeafOut(lsg, lsh, l1, l2, mds), min_c, max_c);
+  const float rout = Clip(LeafOut(rsg, rsh, l1, l2, mds), min_c, max_c);
+  const bool has_split = best_gain > kNegInf;
+
+  out_f[0] = has_split ? best_gain - min_gain_shift : kNegInf;
+  out_f[1] = lsg;
+  out_f[2] = lsh - kEps;
+  out_f[3] = lcn;
+  out_f[4] = rsg;
+  out_f[5] = rsh - kEps;
+  out_f[6] = rcn;
+  out_f[7] = lout;
+  out_f[8] = rout;
+  out_i[0] = has_split ? best_f : -1;
+  out_i[1] = best_t;
+  out_i[2] = 0;  // num_cat
+  out_b[0] = best_dl ? 1 : 0;
+  for (int32_t b = 0; b < B; ++b) out_b[1 + b] = (b == best_t) ? 1 : 0;
 }
 
 int lgbt_num_threads() {

@@ -1,0 +1,454 @@
+"""Native host tree learner — the ``device_type=cpu`` growth path.
+
+The reference's CPU tree learner is native C++ with OpenMP
+(/root/reference/src/treelearner/serial_tree_learner.cpp:173-237); its two
+RAM-latency-bound inner loops — per-leaf ordered histograms
+(src/io/dense_bin.hpp:71-167) and the stable leaf partition
+(src/io/data_partition.hpp:111) — are exactly what XLA's CPU backend lowers
+poorly (serial scatter-adds, no software prefetch). This module is the
+TPU-framework analogue of that CPU path: a host Python split loop driving the
+native kernels in ``native/lgbt_native.cpp`` (``lgbt_hist_segment`` /
+``lgbt_partition_segment``), with best-split *selection* delegated to the same
+jitted ``find_best_split`` scan the device learner uses — one semantics for
+split math everywhere, two implementations only for the memory-bound loops.
+
+Semantics match ops/grow.py's bucketed grower:
+ * same DataPartition row-permutation layout (order / leaf_begin / leaf_phys),
+ * same smaller-child histogram + parent-subtraction trick,
+ * same split-decision routing (missing_type / categorical bitsets) — the C++
+   partition mirrors ``_decision_go_left``,
+ * same tree wiring (TreeArrays encoding, monotone windows, depth gate).
+Differences are float-accumulation order only: the native histogram
+accumulates sequentially in f32 (the same single-precision trade the device
+paths make — XLA's f32 scatter and the Pallas kernel's f32 accumulator; the
+reference GPU path validates the AUC parity of that trade,
+docs/GPU-Performance.rst:131-145). tests/test_grow_native.py pins
+tree-for-tree equality against the device grower on quantized gradients where
+every sum is exact in both.
+
+Routing (models/gbdt.py): ``device_type=cpu`` + serial learner + CPU backend,
+with automatic fallback to the device grower for the features this path does
+not serve (EFB bundles, CEGB, forced splits, masked hist mode).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import native
+from .grow import TreeArrays, _pack_best, _BEST_F, _BEST_I
+from .split import SplitParams, find_best_split
+
+_F32 = np.float32
+
+
+def supported(
+    config, feature_meta: Dict, forced_splits: Tuple, cegb, num_bins: int,
+) -> bool:
+    """True when the native host learner can serve this training setup."""
+    if config.device_type != "cpu":
+        return False
+    try:
+        if jax.default_backend() != "cpu":
+            return False  # grad/hess live on an accelerator; keep growth there
+    except Exception:
+        return False
+    if native.get_lib() is None:
+        return False
+    if "group_id" in feature_meta:  # EFB bundles: group decode not implemented
+        return False
+    if forced_splits:
+        return False
+    if cegb is not None and cegb.enabled:
+        return False
+    if config.tpu_hist_mode != "bucketed":
+        return False  # masked mode is the device differential oracle
+    if num_bins > 256:
+        return False
+    # full [M, F, B, 3] hist carry (no LRU pool on the host — RAM is the
+    # pool); bail out to the device learner's pooled carry past 2GB
+    F = len(feature_meta["num_bin"])
+    if config.num_leaves * F * num_bins * 12 > 2 << 30:
+        return False
+    return config.num_leaves > 1
+
+
+@functools.lru_cache(maxsize=None)
+def _split_fns(params: SplitParams, two_way: bool):
+    """Jitted (root, child-pair) best-split entry points returning packed
+    (f [*,9], i [*,3], b [*,1+B]) arrays — 3 host copies per call instead of
+    15 per-field device reads."""
+
+    def root(hist, sg, sh, nd, feature_meta, feature_mask):
+        res = find_best_split(
+            hist, sg, sh, nd, -jnp.inf, jnp.inf, feature_meta, feature_mask,
+            params, two_way=two_way,
+        )
+        pb = _pack_best(res)
+        return pb.f, pb.i, pb.b
+
+    def pair(hist2, sg2, sh2, nd2, mn2, mx2, feature_meta, feature_mask):
+        res = jax.vmap(
+            lambda h, sg, sh, nd, mn, mx: find_best_split(
+                h, sg, sh, nd, mn, mx, feature_meta, feature_mask, params,
+                two_way=two_way,
+            )
+        )(hist2, sg2, sh2, nd2, mn2, mx2)
+        pb = _pack_best(res)
+        return pb.f, pb.i, pb.b
+
+    return jax.jit(root), jax.jit(pair)
+
+
+_IDX = {n: k for k, n in enumerate(_BEST_F)}
+_GAIN, _LSG, _LSH, _LCN = _IDX["gain"], _IDX["left_sum_grad"], _IDX["left_sum_hess"], _IDX["left_count"]
+_RSG, _RSH, _RCN = _IDX["right_sum_grad"], _IDX["right_sum_hess"], _IDX["right_count"]
+_LOUT, _ROUT = _IDX["left_output"], _IDX["right_output"]
+_FEAT, _THR, _NCAT = (_BEST_I.index("feature"), _BEST_I.index("threshold"),
+                      _BEST_I.index("num_cat"))
+
+
+class _HostState:
+    """Reusable per-booster buffers (bins copy + kernel scratch + carries)."""
+
+    def __init__(
+        self, bins_fn: np.ndarray, num_leaves: int, num_bins: int,
+        bins_nf: Optional[np.ndarray] = None,
+    ):
+        # hugepage-backed random-access arrays (records, bin matrix, hist
+        # carry): a TLB-resident backing measured 3-5x on the histogram pass.
+        # NOTE: these arrays must not outlive `self` (self._huge owns the
+        # mappings), which holds because they live on self.
+        self._huge = native.HugeArrays()
+        F, N = bins_fn.shape
+        self.bins_fn = self._huge.empty((F, N), np.uint8)  # [F, N]
+        np.copyto(self.bins_fn, bins_fn)
+        # [N, 64] cache-line row records (bin strip + per-tree g/h/c): the
+        # histogram row pass costs one line fill per row. F > 48 can't host
+        # the vals slots — skip the transpose copy too.
+        if F <= 48:
+            bins_nf_c = (
+                np.ascontiguousarray(bins_nf, np.uint8)
+                if bins_nf is not None
+                else np.ascontiguousarray(self.bins_fn.T)
+            )
+            self.rowrec = native.rowrec_build(bins_nf_c, self._huge)
+        else:
+            self.rowrec = None
+        self.og = np.empty((native.hist_scratch_size(N, F, num_bins),), np.float32)
+        self.tmp = np.empty((N,), np.int32)
+        self.order = np.empty((N,), np.int32)
+        self.vals = np.empty((N, 3), np.float32)
+        self.hist = self._huge.empty((num_leaves, F, num_bins, 3), np.float32)
+        self.parent_hist = np.empty((F, num_bins, 3), np.float32)
+        self.scan_meta = None  # lazily-built native.SplitScanMeta
+        # histogram pass crossover: row-record pass for segments at least
+        # this many rows, column pass below (see lgbt_hist_segment);
+        # LIGHTGBM_TPU_ROWPASS_MIN overrides for tuning
+        import os
+
+        env = os.environ.get("LIGHTGBM_TPU_ROWPASS_MIN", "")
+        try:
+            self.row_pass_min = int(env) if env else 512
+        except ValueError:
+            import warnings
+
+            warnings.warn(
+                "LIGHTGBM_TPU_ROWPASS_MIN=%r is not an integer; using 512"
+                % env
+            )
+            self.row_pass_min = 512
+
+
+def grow_tree_native(
+    state: _HostState,
+    grad: np.ndarray,  # [N] f32
+    hess: np.ndarray,  # [N] f32
+    bag_mask: np.ndarray,  # [N] f32
+    feature_mask,  # [F] bool (jax or numpy)
+    feature_meta: Dict,  # jnp arrays (shared with the device path)
+    feature_meta_np: Dict,  # numpy copies for host decisions
+    num_leaves: int,
+    max_depth: int,
+    num_bins: int,
+    params: SplitParams,
+    two_way: bool = True,
+):
+    """Grow one tree on the host; returns (TreeArrays, leaf_id [N] int32 np)."""
+    bins_fn = state.bins_fn
+    F, N = bins_fn.shape
+    M, B = num_leaves, num_bins
+    root_fn, pair_fn = _split_fns(params, two_way)
+
+    num_bin_a = feature_meta_np["num_bin"].astype(np.int32)
+    missing_a = feature_meta_np["missing_type"].astype(np.int32)
+    default_a = feature_meta_np["default_bin"].astype(np.int32)
+    mono_a = feature_meta_np["monotone"].astype(np.int32)
+    is_cat_a = feature_meta_np.get("is_categorical")
+    if is_cat_a is None:
+        is_cat_a = np.zeros((F,), bool)
+
+    # All-numerical datasets use the native split scan (bit-identical to the
+    # jitted one, tests/test_grow_native.py); categorical split search (CTR
+    # sort + bitsets) stays on the jitted path.
+    use_native_scan = not is_cat_a.any()
+    if use_native_scan:
+        scan_meta = state.scan_meta
+        if scan_meta is None or scan_meta.params != params or \
+                scan_meta.two_way != int(bool(two_way)):
+            scan_meta = native.SplitScanMeta(
+                num_bin_a, missing_a, default_a, mono_a, params, two_way
+            )
+            state.scan_meta = scan_meta
+        fmask_u8 = np.ascontiguousarray(np.asarray(feature_mask), np.uint8)
+        scratch_b = np.empty((1 + B,), np.uint8)
+
+        def scan_into(leaf, mn, mx):
+            native.best_split_numerical(
+                hist[leaf], laux[leaf, 0], laux[leaf, 1], laux[leaf, 2],
+                mn, mx, scan_meta, fmask_u8,
+                best_f[leaf], best_i[leaf], scratch_b,
+            )
+            best_b[leaf] = scratch_b
+
+    # [N, 3] (grad*bag, hess*bag, bag) — the bagged accumulands
+    vals = state.vals
+    np.multiply(grad, bag_mask, out=vals[:, 0])
+    np.multiply(hess, bag_mask, out=vals[:, 1])
+    vals[:, 2] = bag_mask
+    if state.rowrec is not None:
+        native.rowrec_set_vals(state.rowrec, vals)
+
+    order = state.order
+    order[:] = np.arange(N, dtype=np.int32)
+    leaf_begin = np.zeros((M,), np.int64)
+    leaf_phys = np.zeros((M,), np.int64)
+    leaf_phys[0] = N
+
+    hist = state.hist
+    native.hist_segment(order, 0, N, bins_fn, state.rowrec, vals, B,
+                        state.og, out=hist[0], row_pass_min=state.row_pass_min)
+
+    # root totals in f64 (exact for the quantized-grad differential tests,
+    # and the reference's CPU accumulate precision)
+    root_g = _F32(np.sum(vals[:, 0], dtype=np.float64))
+    root_h = _F32(np.sum(vals[:, 1], dtype=np.float64))
+    root_n = _F32(np.sum(vals[:, 2], dtype=np.float64))
+
+    # per-leaf state
+    laux = np.zeros((M, 3), np.float32)  # sum_grad, sum_hess, bagged count
+    laux[0] = (root_g, root_h, root_n)
+    con_min = np.full((M,), -np.inf, np.float32)
+    con_max = np.full((M,), np.inf, np.float32)
+    depth = np.zeros((M,), np.int32)
+
+    # per-leaf best-split cache (packed rows)
+    best_f = np.full((M, len(_BEST_F)), -np.inf, np.float32)
+    best_i = np.zeros((M, len(_BEST_I)), np.int32)
+    best_b = np.zeros((M, 1 + B), bool)
+
+    if use_native_scan:
+        scan_into(0, -np.inf, np.inf)
+    else:
+        f0, i0, b0 = root_fn(
+            hist[0], root_g, root_h, root_n, feature_meta, feature_mask
+        )
+        best_f[0], best_i[0], best_b[0] = (
+            np.asarray(f0), np.asarray(i0), np.asarray(b0),
+        )
+
+    # tree arrays (TreeArrays layout)
+    split_feature = np.zeros((M - 1,), np.int32)
+    threshold_bin = np.zeros((M - 1,), np.int32)
+    default_left = np.zeros((M - 1,), bool)
+    left_child = np.zeros((M - 1,), np.int32)
+    right_child = np.zeros((M - 1,), np.int32)
+    split_gain = np.zeros((M - 1,), np.float32)
+    internal_count = np.zeros((M - 1,), np.float32)
+    parent_sg = np.zeros((M - 1,), np.float32)  # for end-batch internal_value
+    parent_sh = np.zeros((M - 1,), np.float32)
+    leaf_value = np.zeros((M,), np.float32)
+    leaf_count = np.zeros((M,), np.float32)
+    leaf_weight = np.zeros((M,), np.float32)
+    leaf_parent = np.full((M,), -1, np.int32)
+    leaf_depth = np.zeros((M,), np.int32)
+    cat_member = np.zeros((M - 1, B), bool)
+
+    # root-only tree (mirrors grow.py tree0)
+    lv0, lc0, lw0 = _leaf_output_f32(root_g, root_h, params), root_n, root_h
+    leaf_value[0], leaf_count[0], leaf_weight[0] = lv0, lc0, lw0
+
+    member_u8 = np.empty((B,), np.uint8)
+    it = 0
+    while it < M - 1:
+        best_leaf = int(np.argmax(best_f[:, _GAIN]))
+        if not (best_f[best_leaf, _GAIN] > 0.0):
+            break
+        rec_f, rec_i, rec_b = best_f[best_leaf], best_i[best_leaf], best_b[best_leaf]
+        f = int(rec_i[_FEAT])
+        thr = int(rec_i[_THR])
+        is_cat = bool(rec_i[_NCAT] > 0)
+        dl = bool(rec_b[0])
+        node, new_leaf = it, it + 1  # new_leaf == current num_leaves
+
+        # ---- partition (native, stable, in place) ---------------------
+        pbegin, pphys = int(leaf_begin[best_leaf]), int(leaf_phys[best_leaf])
+        np.copyto(member_u8, rec_b[1:], casting="unsafe")
+        left_phys = int(
+            native.partition_segment(
+                order, pbegin, pphys, bins_fn[f], thr, dl,
+                int(missing_a[f]), int(default_a[f]), int(num_bin_a[f] - 1),
+                is_cat, member_u8, state.tmp,
+            )
+        )
+        right_phys = pphys - left_phys
+        leaf_begin[new_leaf] = pbegin + left_phys
+        leaf_phys[best_leaf] = left_phys
+        leaf_phys[new_leaf] = right_phys
+
+        # ---- wire the tree -------------------------------------------
+        parent = int(leaf_parent[best_leaf])
+        if parent >= 0:
+            enc = -(best_leaf + 1)
+            if left_child[parent] == enc:
+                left_child[parent] = node
+            elif right_child[parent] == enc:
+                right_child[parent] = node
+        split_feature[node] = f
+        threshold_bin[node] = thr
+        default_left[node] = dl
+        left_child[node] = -(best_leaf + 1)
+        right_child[node] = -(new_leaf + 1)
+        split_gain[node] = rec_f[_GAIN]
+        internal_count[node] = laux[best_leaf, 2]
+        parent_sg[node] = laux[best_leaf, 0]
+        parent_sh[node] = laux[best_leaf, 1]
+        cat_member[node] = rec_b[1:]
+
+        d_child = depth[best_leaf] + 1
+        leaf_value[best_leaf] = rec_f[_LOUT]
+        leaf_value[new_leaf] = rec_f[_ROUT]
+        leaf_count[best_leaf] = rec_f[_LCN]
+        leaf_count[new_leaf] = rec_f[_RCN]
+        leaf_weight[best_leaf] = rec_f[_LSH]
+        leaf_weight[new_leaf] = rec_f[_RSH]
+        leaf_parent[best_leaf] = node
+        leaf_parent[new_leaf] = node
+        leaf_depth[best_leaf] = d_child
+        leaf_depth[new_leaf] = d_child
+        depth[best_leaf] = d_child
+        depth[new_leaf] = d_child
+
+        # ---- monotone windows (serial_tree_learner.cpp:841-850) -------
+        pmin, pmax = con_min[best_leaf], con_max[best_leaf]
+        mono_f = int(mono_a[f])
+        if mono_f != 0:
+            mid = _F32(_F32(rec_f[_LOUT] + rec_f[_ROUT]) / _F32(2.0))
+            if mono_f > 0:
+                con_min[best_leaf], con_max[best_leaf] = pmin, mid
+                con_min[new_leaf], con_max[new_leaf] = mid, pmax
+            else:
+                con_min[best_leaf], con_max[best_leaf] = mid, pmax
+                con_min[new_leaf], con_max[new_leaf] = pmin, mid
+        else:
+            con_min[new_leaf], con_max[new_leaf] = pmin, pmax
+
+        laux[best_leaf] = (rec_f[_LSG], rec_f[_LSH], rec_f[_LCN])
+        laux[new_leaf] = (rec_f[_RSG], rec_f[_RSH], rec_f[_RCN])
+
+        # ---- histograms: smaller child direct + subtraction -----------
+        left_smaller = rec_f[_LCN] <= rec_f[_RCN]
+        if left_smaller:
+            s_leaf, l_leaf = best_leaf, new_leaf
+            s_begin, s_cnt = pbegin, left_phys
+            # the smaller pass writes the parent's slot: save the minuend
+            np.copyto(state.parent_hist, hist[best_leaf])
+            parent_hist = state.parent_hist
+        else:
+            s_leaf, l_leaf = new_leaf, best_leaf
+            s_begin, s_cnt = pbegin + left_phys, right_phys
+            parent_hist = hist[best_leaf]
+        native.hist_segment(
+            order, s_begin, s_cnt, bins_fn, state.rowrec, vals, B, state.og,
+            out=hist[s_leaf], row_pass_min=state.row_pass_min,
+        )
+        np.subtract(parent_hist, hist[s_leaf], out=hist[l_leaf])
+
+        # ---- children best splits -------------------------------------
+        if use_native_scan:
+            scan_into(best_leaf, con_min[best_leaf], con_max[best_leaf])
+            scan_into(new_leaf, con_min[new_leaf], con_max[new_leaf])
+        else:
+            f2, i2, b2 = pair_fn(
+                hist[[best_leaf, new_leaf]],
+                laux[[best_leaf, new_leaf], 0],
+                laux[[best_leaf, new_leaf], 1],
+                laux[[best_leaf, new_leaf], 2],
+                con_min[[best_leaf, new_leaf]],
+                con_max[[best_leaf, new_leaf]],
+                feature_meta, feature_mask,
+            )
+            pair_rows = [best_leaf, new_leaf]
+            best_f[pair_rows] = np.asarray(f2)
+            best_i[pair_rows] = np.asarray(i2)
+            best_b[pair_rows] = np.asarray(b2)
+        if max_depth > 0 and d_child >= max_depth:
+            best_f[[best_leaf, new_leaf], _GAIN] = -np.inf
+
+        it += 1
+
+    num_grown = it + 1
+
+    # internal_value batch: same jitted f32 formula as the device grower
+    if it > 0:
+        from .split import calculate_leaf_output
+
+        internal_value = np.zeros((M - 1,), np.float32)
+        internal_value[:it] = np.asarray(
+            calculate_leaf_output(
+                jnp.asarray(parent_sg[:it]), jnp.asarray(parent_sh[:it]), params
+            )
+        )
+    else:
+        internal_value = np.zeros((M - 1,), np.float32)
+
+    # per-row leaf ids from the final segment layout
+    leaf_id = np.zeros((N,), np.int32)
+    for l in range(num_grown):
+        b, c = int(leaf_begin[l]), int(leaf_phys[l])
+        if c > 0 and l > 0:
+            leaf_id[order[b : b + c]] = l
+
+    tree = TreeArrays(
+        num_leaves=jnp.int32(num_grown),
+        split_feature=jnp.asarray(split_feature),
+        threshold_bin=jnp.asarray(threshold_bin),
+        default_left=jnp.asarray(default_left),
+        left_child=jnp.asarray(left_child),
+        right_child=jnp.asarray(right_child),
+        split_gain=jnp.asarray(split_gain),
+        internal_value=jnp.asarray(internal_value),
+        internal_count=jnp.asarray(internal_count),
+        leaf_value=jnp.asarray(leaf_value),
+        leaf_count=jnp.asarray(leaf_count),
+        leaf_weight=jnp.asarray(leaf_weight),
+        leaf_parent=jnp.asarray(leaf_parent),
+        leaf_depth=jnp.asarray(leaf_depth),
+        cat_member=jnp.asarray(cat_member),
+    )
+    return tree, leaf_id
+
+
+def _leaf_output_f32(sum_grad, sum_hess, p: SplitParams) -> np.float32:
+    """CalculateSplittedLeafOutput in strict f32 (matches the jitted formula)."""
+    sg = _F32(sum_grad)
+    if p.lambda_l1 != 0.0:
+        sg = _F32(np.sign(sg)) * _F32(np.maximum(np.abs(sg) - _F32(p.lambda_l1), _F32(0.0)))
+    ret = _F32(-sg / _F32(sum_hess + _F32(p.lambda_l2)))
+    if p.max_delta_step > 0.0:
+        ret = _F32(np.clip(ret, -p.max_delta_step, p.max_delta_step))
+    return ret

@@ -1,0 +1,326 @@
+"""Native host learner (device_type=cpu) differential tests.
+
+Three layers, mirroring how the reference validates its GPU learner against
+the CPU one (gpu_tree_learner.cpp's GPU_DEBUG_COMPARE blocks):
+
+ 1. kernel oracles — the native histogram/partition kernels against numpy
+    replications of the semantics in ops/grow.py;
+ 2. the native C++ split scan against the jitted find_best_split on random
+    histograms (choice + side-sum equality — gains may differ by FMA ulps);
+ 3. whole-tree equality: device_type=cpu vs the device grower with a custom
+    objective whose gradients are 2^-8-quantized, so every histogram sum is
+    exact in both f32 and f64 and the trees must match split for split.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import native
+from lightgbm_tpu.ops.histogram import histogram_reference
+
+pytestmark = pytest.mark.skipif(
+    native.get_lib() is None, reason="native library unavailable"
+)
+
+
+def _quantized_fobj(seed: int):
+    """Deterministic per-iteration gradients quantized to 2^-8 — exact sums
+    in f32 and f64, so native and device histograms are bit-identical."""
+    state = {"it": 0}
+
+    def fobj(preds, ds):
+        rng = np.random.RandomState(seed + state["it"])
+        state["it"] += 1
+        n = len(preds)
+        grad = np.round(rng.randn(n) * 256) / 256.0
+        hess = np.round(rng.rand(n) * 128 + 32) / 256.0
+        return grad, hess
+
+    return fobj
+
+
+# ---------------------------------------------------------------------------
+# 1. kernel oracles
+# ---------------------------------------------------------------------------
+
+
+def test_hist_segment_matches_numpy_oracle():
+    rng = np.random.RandomState(0)
+    N, F, B = 6000, 12, 64
+    bins_fn = rng.randint(0, B, size=(F, N)).astype(np.uint8)
+    vals = (np.round(rng.randn(N, 3) * 256) / 256).astype(np.float32)
+    order = rng.permutation(N).astype(np.int32)
+    og = np.empty(native.hist_scratch_size(N, F, B), np.float32)
+    rec = native.rowrec_build(np.ascontiguousarray(bins_fn.T))
+    native.rowrec_set_vals(rec, np.ascontiguousarray(vals))
+    for begin, cnt, rp_min in ((0, N, 0), (123, 2000, 0), (123, 2000, 1 << 62), (N - 7, 7, 0)):
+        seg = order[begin : begin + cnt]
+        want = histogram_reference(bins_fn[:, seg], vals[seg], B)
+        got = native.hist_segment(
+            order, begin, cnt, bins_fn, rec, vals, B, og, row_pass_min=rp_min
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+def test_partition_segment_matches_decision_semantics():
+    rng = np.random.RandomState(1)
+    N, B = 5000, 32
+    col = rng.randint(0, B, N).astype(np.uint8)
+    tmp = np.empty(N, np.int32)
+    for missing_type, default_bin, is_cat in (
+        (0, 0, False), (1, 7, False), (2, 3, False), (0, 0, True),
+    ):
+        order = rng.permutation(N).astype(np.int32)
+        begin, cnt = 500, 3000
+        order_before = order.copy()
+        seg_before = order[begin : begin + cnt].copy()
+        member = (rng.rand(B) > 0.5).astype(np.uint8)
+        thr, dl, nanb = 11, True, B - 1
+        # oracle: _decision_go_left semantics
+        c = col[seg_before].astype(int)
+        go_left = c <= thr
+        if missing_type == 1:
+            go_left[c == default_bin] = dl
+        if missing_type == 2:
+            go_left[c == nanb] = dl
+        if is_cat:
+            go_left = member[c].astype(bool)
+        want = np.concatenate([seg_before[go_left], seg_before[~go_left]])
+        nl = native.partition_segment(
+            order, begin, cnt, col, thr, dl, missing_type, default_bin, nanb,
+            is_cat, member, tmp,
+        )
+        assert nl == int(go_left.sum())
+        np.testing.assert_array_equal(order[begin : begin + cnt], want)
+        # outside the segment untouched
+        np.testing.assert_array_equal(order[:begin], order_before[:begin])
+        np.testing.assert_array_equal(order[begin + cnt :], order_before[begin + cnt :])
+
+
+# ---------------------------------------------------------------------------
+# 2. native split scan vs jitted find_best_split
+# ---------------------------------------------------------------------------
+
+
+def test_best_split_matches_jitted_scan():
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.grow import _pack_best
+    from lightgbm_tpu.ops.split import SplitParams, find_best_split
+
+    rng = np.random.RandomState(42)
+    F, B = 14, 128
+    cfgs = [
+        SplitParams(0.0, 0.0, 0.0, 20, 1e-3, 0.0),
+        SplitParams(0.5, 1.0, 0.3, 5, 1e-3, 0.1),
+    ]
+    for trial in range(60):
+        p = cfgs[trial % 2]
+        two_way = trial % 3 != 0
+        nb = rng.randint(2, B + 1, F).astype(np.int32)
+        mt = rng.randint(0, 3, F).astype(np.int32)
+        db = np.array([rng.randint(0, max(n - 1, 1)) for n in nb], np.int32)
+        mono = rng.choice([-1, 0, 0, 1], F).astype(np.int32)
+        hist = np.zeros((F, B, 3), np.float32)
+        for f in range(F):
+            k = nb[f]
+            hist[f, :k, 0] = rng.randn(k).astype(np.float32) * 10
+            hist[f, :k, 1] = rng.rand(k).astype(np.float32) * 5
+            hist[f, :k, 2] = rng.randint(0, 50, k).astype(np.float32)
+        sg = np.float32(hist[0, :, 0].sum())
+        sh = np.float32(hist[0, :, 1].sum())
+        nd = np.float32(hist[0, :, 2].sum())
+        if trial % 4 == 0:
+            mn, mx = np.float32(-0.5), np.float32(0.7)
+        else:
+            mn, mx = np.float32(-np.inf), np.float32(np.inf)
+        fmask = rng.rand(F) > 0.2
+        fm = {
+            "num_bin": jnp.asarray(nb), "missing_type": jnp.asarray(mt),
+            "default_bin": jnp.asarray(db), "monotone": jnp.asarray(mono),
+        }
+        res = find_best_split(
+            jnp.asarray(hist), sg, sh, nd, mn, mx, fm, jnp.asarray(fmask), p,
+            two_way=two_way,
+        )
+        pb = _pack_best(res)
+        jf, ji, jb = np.asarray(pb.f), np.asarray(pb.i), np.asarray(pb.b)
+
+        of = np.empty(9, np.float32)
+        oi = np.empty(3, np.int32)
+        ob = np.empty(1 + B, np.uint8)
+        meta = native.SplitScanMeta(nb, mt, db, mono, p, two_way)
+        native.best_split_numerical(
+            hist, sg, sh, nd, mn, mx, meta, fmask.astype(np.uint8), of, oi, ob
+        )
+        assert oi[0] == ji[0], trial  # feature
+        if ji[0] >= 0:  # a split exists: full equality of the packed row
+            assert oi[1] == ji[1], trial  # threshold
+            assert ob[0] == jb[0], trial  # default_left
+            # side sums / outputs are the same f32 ops in the same order
+            np.testing.assert_array_equal(of[1:], jf[1:], err_msg=str(trial))
+            # gains may differ by XLA FMA-contraction ulps only
+            np.testing.assert_allclose(of[0], jf[0], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 3. whole-tree equality, native learner vs device grower
+# ---------------------------------------------------------------------------
+
+
+def _tree_lines(model_str: str):
+    """Structural tree lines of a model file (skips float-noise-free check of
+    gains: split_gain carries FMA-contraction ulps between the two learners)."""
+    keep = (
+        "split_feature=", "threshold=", "decision_type=", "left_child=",
+        "right_child=", "leaf_value=", "leaf_count=", "internal_value=",
+        "internal_count=", "num_leaves=", "num_cat=",
+    )
+    return [l for l in model_str.splitlines() if l.startswith(keep)]
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        {},
+        {"bagging_fraction": 0.7, "bagging_freq": 1},
+        {"feature_fraction": 0.6},
+        {"max_depth": 4},
+        {"lambda_l1": 0.4, "lambda_l2": 2.0, "min_gain_to_split": 0.05},
+    ],
+    ids=["plain", "bagging", "feat-frac", "max-depth", "regularized"],
+)
+def test_native_tree_equals_device_tree(extra):
+    rng = np.random.RandomState(7)
+    n = 4000
+    X = rng.randn(n, 8).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    base = {"objective": "none", "verbosity": -1, "num_leaves": 24,
+            "min_data_in_leaf": 20, "seed": 5}
+    base.update(extra)
+
+    def run(device_type):
+        ds = lgb.Dataset(X.copy(), label=y.copy())
+        bst = lgb.train(
+            dict(base, device_type=device_type), ds, num_boost_round=4,
+            fobj=_quantized_fobj(11),
+        )
+        took_native = hasattr(bst._gbdt, "_native_state")
+        assert took_native == (device_type == "cpu")
+        return bst
+
+    s_dev = run("tpu").model_to_string()
+    s_nat = run("cpu").model_to_string()
+    assert _tree_lines(s_dev) == _tree_lines(s_nat)
+
+
+def test_native_tree_equals_device_tree_missing_values():
+    rng = np.random.RandomState(9)
+    n = 3000
+    X = rng.randn(n, 6).astype(np.float64)
+    X[rng.rand(n, 6) < 0.15] = np.nan  # NaN missing
+    X[:, 2] = np.where(rng.rand(n) < 0.6, 0.0, X[:, 2])  # sparse zero column
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(np.float32)
+    base = {"objective": "none", "verbosity": -1, "num_leaves": 16, "seed": 3}
+
+    def run(device_type):
+        ds = lgb.Dataset(X.copy(), label=y.copy())
+        return lgb.train(
+            dict(base, device_type=device_type), ds, num_boost_round=3,
+            fobj=_quantized_fobj(23),
+        )
+
+    assert _tree_lines(run("tpu").model_to_string()) == _tree_lines(
+        run("cpu").model_to_string()
+    )
+
+
+def test_native_tree_equals_device_tree_monotone():
+    rng = np.random.RandomState(13)
+    n = 3000
+    X = rng.randn(n, 5).astype(np.float32)
+    y = (X[:, 0] - X[:, 1] > 0).astype(np.float32)
+    base = {
+        "objective": "none", "verbosity": -1, "num_leaves": 12, "seed": 1,
+        "monotone_constraints": [1, -1, 0, 0, 0],
+    }
+
+    def run(device_type):
+        ds = lgb.Dataset(X.copy(), label=y.copy())
+        return lgb.train(
+            dict(base, device_type=device_type), ds, num_boost_round=3,
+            fobj=_quantized_fobj(31),
+        )
+
+    assert _tree_lines(run("tpu").model_to_string()) == _tree_lines(
+        run("cpu").model_to_string()
+    )
+
+
+# ---------------------------------------------------------------------------
+# routing / fallback
+# ---------------------------------------------------------------------------
+
+
+def test_native_learner_real_objective_close_to_device():
+    """End-to-end with a real objective: predictions agree to float noise."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(3000, 10).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] + rng.randn(3000) * 0.3 > 0).astype(np.float32)
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 31}
+    p1 = lgb.train(dict(base, device_type="tpu"), lgb.Dataset(X, label=y),
+                   num_boost_round=8).predict(X)
+    p2 = lgb.train(dict(base, device_type="cpu"), lgb.Dataset(X, label=y),
+                   num_boost_round=8).predict(X)
+    np.testing.assert_allclose(p1, p2, atol=2e-4)
+
+
+def test_native_falls_back_for_categoricals_and_stays_correct():
+    """Categorical split search stays on the jitted scan; the native learner
+    still drives partition/histograms — results must match the device path."""
+    rng = np.random.RandomState(4)
+    n = 2500
+    Xc = rng.randint(0, 12, size=(n, 1)).astype(np.float64)
+    Xn = rng.randn(n, 4)
+    X = np.column_stack([Xc, Xn])
+    y = ((Xc[:, 0] % 3 == 0) ^ (Xn[:, 0] > 0)).astype(np.float32)
+    base = {"objective": "none", "verbosity": -1, "num_leaves": 12, "seed": 2,
+            "categorical_feature": [0], "min_data_per_group": 10}
+
+    def run(device_type):
+        ds = lgb.Dataset(X.copy(), label=y.copy(),
+                         categorical_feature=[0])
+        bst = lgb.train(
+            dict(base, device_type=device_type), ds, num_boost_round=3,
+            fobj=_quantized_fobj(17),
+        )
+        if device_type == "cpu":
+            # the native learner ran (jit split scan + native bitset partition)
+            assert hasattr(bst._gbdt, "_native_state")
+        return bst
+
+    assert _tree_lines(run("tpu").model_to_string()) == _tree_lines(
+        run("cpu").model_to_string()
+    )
+
+
+def test_device_type_cpu_with_unsupported_features_falls_back():
+    """Forced splits route back to the device grower under device_type=cpu."""
+    import json
+    import tempfile
+
+    rng = np.random.RandomState(6)
+    X = rng.randn(1500, 4).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    with tempfile.NamedTemporaryFile("w", suffix=".json", delete=False) as f:
+        json.dump({"feature": 0, "threshold": 0.0}, f)
+        forced = f.name
+    base = {"objective": "binary", "verbosity": -1, "num_leaves": 8,
+            "forcedsplits_filename": forced}
+    p1 = lgb.train(dict(base, device_type="tpu"), lgb.Dataset(X, label=y),
+                   num_boost_round=3).predict(X)
+    p2 = lgb.train(dict(base, device_type="cpu"), lgb.Dataset(X, label=y),
+                   num_boost_round=3).predict(X)
+    np.testing.assert_allclose(p1, p2, atol=1e-6)
