@@ -529,15 +529,16 @@ class GBDT:
 
         bins_s, grad_s, hess_s, bag_s = self._shard_rows(grad_k, hess_k)
         if learner == "voting":
-            if cegb_on:
-                log.fatal(
-                    "CEGB penalties are not supported with tree_learner=voting "
-                    "(the top-k vote bypasses the penalized full scan)"
-                )
-            tree, leaf_id = grow_tree_voting_parallel(
+            out = grow_tree_voting_parallel(
                 mesh, bins_s, grad_s, hess_s, bag_s, fmask, self.feature_meta,
-                top_k=cfg.top_k, forced_splits=self._forced_splits, **common,
+                top_k=cfg.top_k, forced_splits=self._forced_splits,
+                cegb=self.cegb_params,
+                cegb_state=self._cegb_state_sharded(mesh), **common,
             )
+            if cegb_on:
+                tree, leaf_id, self._cegb_state = out
+            else:
+                tree, leaf_id = out
         else:
             out = grow_tree_data_parallel(
                 mesh, bins_s, grad_s, hess_s, bag_s, fmask, self.feature_meta,
@@ -580,15 +581,6 @@ class GBDT:
         (SerialTreeLearner ctor, serial_tree_learner.cpp:56-69)."""
         cfg = self.config
         if cfg.histogram_pool_size <= 0:
-            return None
-        if self.cegb_params.enabled:
-            if not getattr(self, "_warned_pool_cegb", False):
-                self._warned_pool_cegb = True
-                log.warning(
-                    "histogram_pool_size is ignored with CEGB penalties: the "
-                    "CEGB rescan re-ranks every leaf from its resident "
-                    "histogram, so the full carry stays allocated"
-                )
             return None
         if self._learner_kind() != "serial":
             if not getattr(self, "_warned_pool_parallel", False):

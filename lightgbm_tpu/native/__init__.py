@@ -32,15 +32,24 @@ c_uint8_p = ctypes.POINTER(ctypes.c_uint8)
 
 
 def _build() -> bool:
+    # per-pid temp target: concurrent first-use builds (parallel pytest
+    # workers, bench worker + CLI) must not interleave writes into one shared
+    # .tmp — a corrupted published .so would pass the mtime freshness check
+    # forever after and silently disable every native path
+    tmp = "%s.%d.tmp" % (_SO, os.getpid())
     cmd = [
         "g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-std=c++17",
-        _SRC, "-o", _SO + ".tmp",
+        _SRC, "-o", tmp,
     ]
     try:
         subprocess.check_call(cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
-        os.replace(_SO + ".tmp", _SO)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.CalledProcessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -381,7 +381,8 @@ void lgbt_predict_leaf(const double* X, int64_t n, int64_t F,
 //   bins_fn: [F, N] feature-major bin matrix (uint8; B <= 256)
 //   bins_nf: [N, F] row-major copy (may be null: column path only)
 //   vals:    [N, 3] f32 (grad*bag, hess*bag, bag) — bag-zeroed rows add 0
-//   og:      caller scratch, >= max(cnt*3 floats, F*B*3 doubles)
+//   og:      caller scratch, >= cnt*3 floats (ordered-gradient columns; the
+//            row-record pass does not touch it — see hist_scratch_size())
 //   out:     [F, B, 3] f32
 //
 // Two pass shapes:

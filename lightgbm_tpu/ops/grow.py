@@ -216,7 +216,7 @@ MIN_BUCKET_LOG2 = 8  # smallest gathered-segment bucket (256 rows)
     static_argnames=(
         "num_leaves", "max_depth", "num_bins", "params", "num_group_bins",
         "chunk", "axis_name", "split_fn", "psum_hist", "forced_splits", "cegb",
-        "hist_mode", "hist_dtype", "two_way", "feature_sharded",
+        "cegb_rescan", "hist_mode", "hist_dtype", "two_way", "feature_sharded",
         "hist_pool_slots", "use_subtract",
     ),
     donate_argnames=("hist_buf",),
@@ -240,6 +240,7 @@ def grow_tree(
     forced_splits: Tuple = (),
     cegb: CegbParams = CegbParams(),
     cegb_state: Optional[Tuple[jax.Array, jax.Array]] = None,
+    cegb_rescan=None,
     hist_mode: str = "bucketed",
     hist_dtype: str = "float32",
     two_way: bool = True,
@@ -306,10 +307,12 @@ def grow_tree(
         split_fn = find_best_split
     hist_axis = axis_name if psum_hist else None
     cegb_on = cegb.enabled
-    if cegb_on and split_fn is not find_best_split:
+    if cegb_on and split_fn is not find_best_split and cegb_rescan is None:
         raise NotImplementedError(
-            "CEGB penalties are only supported with the serial/data-parallel "
-            "split search (the reference implements them in SerialTreeLearner)"
+            "CEGB with a custom split_fn needs a matching batched "
+            "cegb_rescan(hist, lsg, lsh, lnd, mn, mx, pen, feature_meta, "
+            "feature_mask, params) -> SplitResult[M] (the voting learner "
+            "supplies one; see parallel/voting_parallel.py)"
         )
     if hist_mode not in ("bucketed", "masked"):
         raise ValueError(
@@ -327,10 +330,11 @@ def grow_tree(
     P = int(hist_pool_slots) if pooled else M
     if pooled and P < 2:
         raise ValueError("histogram pool needs at least 2 slots, got %d" % P)
-    if pooled and cegb_on:
+    if pooled and cegb_on and cegb_rescan is not None:
         raise NotImplementedError(
-            "histogram_pool_size with CEGB is unsupported: the CEGB rescan "
-            "re-ranks every leaf from its resident histogram"
+            "histogram_pool_size with CEGB under a custom split search is "
+            "unsupported: the batched rescan needs per-leaf histograms, but "
+            "the pool keeps only resident slots"
         )
     if pooled and forced_splits and P < len(forced_splits) + 2:
         raise ValueError(
@@ -570,18 +574,73 @@ def grow_tree(
         The reference keeps splits_per_leaf_ cached and patches gains when a
         coupled feature first gets used (Split, serial_tree_learner.cpp:757-775);
         re-scanning from the (resident) histograms reaches the same fixpoint.
+        A custom ``cegb_rescan`` (the voting learner's batched vote+elect) takes
+        over when the split search itself is custom.
         """
         pen = leaf_penalties(lnd, feature_used, unused_cnt)
-        res = jax.vmap(
-            lambda h, sg, sh, nd, mn1, mx1, pr: find_best_split(
-                h, sg, sh, nd, mn1, mx1, feature_meta, feature_mask, params, pr,
-                two_way=two_way,
+        if cegb_rescan is not None:
+            res = cegb_rescan(
+                hist, lsg, lsh, lnd, mn, mx, pen, feature_meta, feature_mask,
+                params,
             )
-        )(hist, lsg, lsh, lnd, mn, mx, pen)
+        else:
+            res = jax.vmap(
+                lambda h, sg, sh, nd, mn1, mx1, pr: find_best_split(
+                    h, sg, sh, nd, mn1, mx1, feature_meta, feature_mask, params,
+                    pr, two_way=two_way,
+                )
+            )(hist, lsg, lsh, lnd, mn, mx, pen)
         exists = jnp.arange(M, dtype=jnp.int32) < tree.num_leaves
         gain = jnp.where(exists, res.gain, neg_inf)
         gain = depth_gate(gain, tree.leaf_i[:, 1])
         return res._replace(gain=gain)
+
+    def rescan_resident(
+        tree, hist, slot_leaf, slot_age, laux, feature_used, unused_cnt,
+        old_best, prev_feature_used, split_f,
+    ):
+        """Pooled CEGB: re-rank only slot-RESIDENT leaves from their resident
+        histograms; evicted leaves keep their cached candidate, gain-patched
+        when this split newly paid a coupled feature — exactly the staleness
+        the reference's cached splits_per_leaf_ has (Split,
+        serial_tree_learner.cpp:757-775: only the gain of cached splits on the
+        newly-used feature is adjusted, no re-argmax)."""
+        pen = leaf_penalties(laux[:, _LAUX_ND], feature_used, unused_cnt)
+        lv = jnp.maximum(slot_leaf, 0)  # [P] leaf of each slot (0 for free)
+        res = jax.vmap(
+            lambda h, sg, sh, nd, mn1, mx1, pr: find_best_split(
+                h, sg, sh, nd, mn1, mx1, feature_meta, feature_mask, params,
+                pr, two_way=two_way,
+            )
+        )(
+            hist, laux[lv, _LAUX_SG], laux[lv, _LAUX_SH], laux[lv, _LAUX_ND],
+            laux[lv, _LAUX_MIN], laux[lv, _LAUX_MAX], pen[lv],
+        )
+        occupied = (slot_leaf >= 0) & (slot_age > 0) & (lv < tree.num_leaves)
+        gain = jnp.where(occupied, res.gain, neg_inf)
+        gain = depth_gate(gain, tree.leaf_i[lv, 1])
+        pk = _pack_best(res._replace(gain=gain))  # [P, ...]
+        base = old_best
+        if cegb.has_coupled and split_f is not None:
+            # the split just paid for split_f: cached candidates on that
+            # feature are no longer charged its acquisition penalty
+            newly = ~prev_feature_used[split_f]
+            patch = jnp.where(
+                newly
+                & (old_best.i[:, 0] == split_f)
+                & (old_best.f[:, 0] > neg_inf),
+                cegb.tradeoff * coupled_arr[split_f],
+                jnp.float32(0.0),
+            )
+            base = old_best._replace(f=old_best.f.at[:, 0].add(patch))
+        # scatter resident results into their leaf rows; row M (out of range)
+        # drops the write for free slots (JAX scatter OOB-drop semantics)
+        rows = jnp.where(occupied, slot_leaf, M)
+        return PackedBest(
+            base.f.at[rows].set(pk.f),
+            base.i.at[rows].set(pk.i),
+            base.b.at[rows].set(pk.b),
+        )
 
     # ---- root ----------------------------------------------------------
     root_vals = masked_values(jnp.ones((N,), f32))
@@ -685,7 +744,17 @@ def grow_tree(
         axis=-1,
     )
 
-    if cegb_on:
+    if cegb_on and pooled:
+        empty = PackedBest(
+            jnp.zeros((M, len(_BEST_F)), f32).at[:, 0].set(-jnp.inf),
+            jnp.zeros((M, len(_BEST_I)), jnp.int32),
+            jnp.zeros((M, 1 + B), bool),
+        )
+        best0 = rescan_resident(
+            tree0, hist0, slot_leaf0, slot_age0, laux0, feature_used0, unused0,
+            empty, feature_used0, None,
+        )
+    elif cegb_on:
         root_best = rescan_all(
             tree0, hist0,
             laux0[:, _LAUX_SG], laux0[:, _LAUX_SH], laux0[:, _LAUX_ND],
@@ -994,7 +1063,12 @@ def grow_tree(
             child_rows = None  # hist rows ARE leaf rows; set below
 
         # ---- next-round candidate refresh --------------------------------
-        if cegb_on:
+        if cegb_on and pooled:
+            best = rescan_resident(
+                tree, hist, slot_leaf, slot_age, laux, feature_used,
+                unused_cnt, s.best, s.feature_used, f,
+            )
+        elif cegb_on:
             best = _pack_best(
                 rescan_all(
                     tree, hist,

@@ -69,6 +69,15 @@ def supported(
         return False  # masked mode is the device differential oracle
     if num_bins > 256:
         return False
+    F_cap = len(feature_meta["num_bin"])
+    if (
+        config.histogram_pool_size > 0
+        and config.histogram_pool_size * (1 << 20)
+        < config.num_leaves * F_cap * num_bins * 12
+    ):
+        # a configured pool cap below the full carry must be honored — the
+        # host learner has no LRU pool, so defer to the device grower's
+        return False
     # full [M, F, B, 3] hist carry (no LRU pool on the host — RAM is the
     # pool); bail out to the device learner's pooled carry past 2GB
     F = len(feature_meta["num_bin"])

@@ -47,6 +47,30 @@ from jax.experimental.pallas import tpu as pltpu
 
 LO = 8  # low-radix width: RHS one-hot lanes
 
+# Scoped-VMEM budget for one grid step. Mosaic's hard limit is 16MB; first
+# real-TPU contact (2026-07-31) measured ~1068 B/row of scoped allocation for
+# the f32 kernel at C=16384 — 17.5MB, a compile-time OOM. The model below
+# reproduces that measurement (est. 1007 B/row) from the live intermediates,
+# and _max_chunk caps C so the estimate stays under this budget with a
+# ~6MB margin for Mosaic's own stack.
+_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _max_chunk(hi_n: int, k_n: int, dtype) -> int:
+    """Largest row-chunk C whose per-step VMEM footprint fits the budget."""
+    d = jnp.dtype(dtype).itemsize
+    per_row = (
+        1 + 2 * (1 + 4 * k_n)  # double-buffered bins [1,C] u8 + vt [K,C] f32
+        + 8  # hi/lo int32 vectors
+        + d * (hi_n + hi_n * k_n + LO + k_n)  # oh_hi, lhs, oh_lo, vt cast
+    )
+    if d == 4:
+        # Precision.HIGHEST decomposes each f32 operand into bf16 hi/lo
+        # shadows: two bf16 copies of lhs and of oh_lo
+        per_row += 2 * 2 * (hi_n * k_n + LO)
+    c = _VMEM_BUDGET // per_row
+    return max(512, (c // 512) * 512)
+
 
 def _hi_for(num_bins: int) -> int:
     hi = -(-num_bins // LO)
@@ -63,14 +87,17 @@ def _kernel(bins_ref, vt_ref, out_ref, *, hi_n: int, dtype):
         out_ref[:] = jnp.zeros_like(out_ref)
 
     b = bins_ref[0, 0, :].astype(jnp.int32)  # [C]
-    vt = vt_ref[:]  # [K, C] f32
+    # rounding vt to the operand dtype BEFORE the one-hot product equals
+    # rounding the product (one-hot entries are exact 0/1) and keeps the
+    # [HI*K, C] intermediate in the narrow dtype — half the VMEM for bf16
+    vt = vt_ref[:].astype(dtype)  # [K, C]
     k_n, C = vt.shape
 
     hi = b // LO
     lo = b - hi * LO
 
     hi_iota = jax.lax.broadcasted_iota(jnp.int32, (hi_n, C), 0)
-    oh_hi = (hi[None, :] == hi_iota).astype(jnp.float32)  # [HI, C]
+    oh_hi = (hi[None, :] == hi_iota).astype(dtype)  # [HI, C]
     # LHS row (h, k) = onehot_hi[h, i] * values[k, i]
     lhs = (oh_hi[:, None, :] * vt[None, :, :]).reshape(hi_n * k_n, C)
 
@@ -78,7 +105,7 @@ def _kernel(bins_ref, vt_ref, out_ref, *, hi_n: int, dtype):
     oh_lo = (lo[:, None] == lo_iota).astype(dtype)  # [C, LO]
 
     out_ref[0] += jax.lax.dot_general(
-        lhs.astype(dtype),
+        lhs,
         oh_lo,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -115,7 +142,7 @@ def histogram_pallas(
     # multiple of 512, and bins gets a singleton middle axis so its block's
     # last-two dims are (1, C) against array dims (1, N) — the feature axis
     # becomes a leading grid axis, which has no tiling constraint.
-    C = min(max(chunk, 512), max(512, N))
+    C = min(max(chunk, 512), max(512, N), _max_chunk(HI, K, dtype))
     C = max(512, (C // 512) * 512)
     if N % C != 0:
         pad = (-N) % C

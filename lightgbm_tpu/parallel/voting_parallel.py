@@ -22,7 +22,13 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.grow import grow_tree
-from ..ops.split import SplitParams, SplitResult, find_best_split, per_feature_best_gain
+from ..ops.split import (
+    CegbParams,
+    SplitParams,
+    SplitResult,
+    find_best_split,
+    per_feature_best_gain,
+)
 from .data_parallel import shard_map
 
 
@@ -71,6 +77,70 @@ def _voting_split_fn(top_k: int, axis_name: str, two_way: bool = True):
     return split_fn
 
 
+@functools.lru_cache(maxsize=None)
+def _voting_rescan_fn(top_k: int, axis_name: str, two_way: bool = True):
+    """Batched CEGB rescan for the voting learner: the per-leaf vote+elect of
+    ``_voting_split_fn`` vectorized over ALL leaves at once, with exactly two
+    collectives per call — a psum of the whole [M, F] vote tensor and a psum
+    of the [M, 2k, B, 3] elected slices. The per-leaf math is vmapped (pure),
+    which sidesteps the no-vmap-of-collectives restriction that keeps the
+    non-CEGB path's split_fn unrolled (grow.py split2). CEGB penalties join
+    the LOCAL ranking before the vote (the penalized analogue of
+    voting_parallel_tree_learner.cpp:337's LightSplitInfo gains) and the
+    final elected scan, so penalty-shifted gains steer feature election too.
+    With ``top_k >= F`` every feature is elected and the psum'd slices equal
+    the global histogram — the rescan then matches the serial CEGB scan
+    bit-for-bit (the oracle tests/test_forced_cegb.py relies on)."""
+
+    def rescan(hist, lsg, lsh, lnd, mn, mx, pen, feature_meta, feature_mask,
+               params):
+        M, F = hist.shape[0], hist.shape[1]
+        k = min(top_k, F)
+        k2 = min(2 * k, F)
+        # local leaf sums from feature 0's bins (every row lands in exactly
+        # one bin of every feature — see _voting_split_fn's invariant note)
+        local_g = jnp.sum(hist[:, 0, :, 0], axis=-1)  # [M]
+        local_h = jnp.sum(hist[:, 0, :, 1], axis=-1)
+        local_n = jnp.sum(hist[:, 0, :, 2], axis=-1)
+        lg = jax.vmap(
+            lambda h, sg, sh, nd, mn1, mx1: per_feature_best_gain(
+                h, sg, sh, nd, mn1, mx1, feature_meta, feature_mask, params,
+                two_way=two_way,
+            )
+        )(hist, local_g, local_h, local_n, mn, mx)  # [M, F]
+        lg = lg - pen
+        _, top_idx = jax.lax.top_k(lg, k)  # [M, k]
+        votes = jnp.zeros((M, F), jnp.float32).at[
+            jnp.arange(M, dtype=jnp.int32)[:, None], top_idx
+        ].add(1.0)
+        votes = jax.lax.psum(votes, axis_name)
+        elected = jax.lax.top_k(votes, k2)[1]  # [M, k2], replicated
+        hist_sel = jnp.take_along_axis(
+            hist, elected[:, :, None, None], axis=1
+        )  # [M, k2, B, 3]
+        hist_sel = jax.lax.psum(hist_sel, axis_name)
+        meta_sel = {key: v[elected] for key, v in feature_meta.items()}
+        mask_sel = feature_mask[elected]  # [M, k2]
+        pen_sel = jnp.take_along_axis(pen, elected, axis=1)
+        res = jax.vmap(
+            lambda h, sg, sh, nd, mn1, mx1, meta, fm, pr: find_best_split(
+                h, sg, sh, nd, mn1, mx1, meta, fm, params, pr, two_way=two_way,
+            )
+        )(hist_sel, lsg, lsh, lnd, mn, mx, meta_sel, mask_sel, pen_sel)
+        real_f = jnp.where(
+            res.feature >= 0,
+            jnp.take_along_axis(
+                elected, jnp.maximum(res.feature, 0)[:, None], axis=1
+            )[:, 0],
+            -1,
+        )
+        return SplitResult(
+            *((res.gain, real_f.astype(jnp.int32)) + tuple(res[2:]))
+        )
+
+    return rescan
+
+
 def grow_tree_voting_parallel(
     mesh: Mesh,
     bins: jax.Array,  # [F, N] sharded P(None, 'data')
@@ -89,14 +159,29 @@ def grow_tree_voting_parallel(
     hist_mode: str = "bucketed",
     forced_splits=(),
     num_group_bins=None,
+    cegb: CegbParams = CegbParams(),
+    cegb_state=None,
     two_way: bool = True,
 ):
-    """Voting-parallel growth; returns (TreeArrays replicated, leaf_id sharded)."""
+    """Voting-parallel growth; returns (TreeArrays replicated, leaf_id sharded).
+
+    With CEGB enabled, also returns the carried (feature_used, used_in_data)
+    state like the data-parallel learner; per-leaf candidate refresh then runs
+    through the batched ``_voting_rescan_fn`` (vote + elected-slice psum over
+    all leaves at once) instead of the per-child split_fn."""
     meta_keys = sorted(feature_meta.keys())
     meta_vals = tuple(feature_meta[k] for k in meta_keys)
     split_fn = _voting_split_fn(top_k, "data", two_way)
+    cegb_on = cegb.enabled
+    rescan_fn = _voting_rescan_fn(top_k, "data", two_way) if cegb_on else None
+    if cegb_on and cegb_state is None:
+        F, N = bins.shape
+        cegb_state = (
+            jnp.zeros((F,), bool),
+            jnp.zeros((F, N) if cegb.has_lazy else (1, 1), bool),
+        )
 
-    def local(bins_l, grad_l, hess_l, bag_l, fmask, *meta_flat):
+    def local(bins_l, grad_l, hess_l, bag_l, fmask, fu, uid, *meta_flat):
         meta = dict(zip(meta_keys, meta_flat))
         return grow_tree(
             bins_l,
@@ -118,15 +203,27 @@ def grow_tree_voting_parallel(
             psum_hist=False,  # histograms stay local; split_fn psums elected slice
             forced_splits=forced_splits,
             num_group_bins=num_group_bins,
+            cegb=cegb,
+            cegb_state=(fu, uid) if cegb_on else None,
+            cegb_rescan=rescan_fn,
         )
 
     row = P("data")
     rep = P()
+    uid_spec = P(None, "data") if cegb.has_lazy else rep
+    state_out = ((rep, uid_spec),) if cegb_on else ()
     fn = shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(None, "data"), row, row, row, rep) + (rep,) * len(meta_vals),
-        out_specs=(rep, row),
+        in_specs=(P(None, "data"), row, row, row, rep, rep, uid_spec)
+        + (rep,) * len(meta_vals),
+        out_specs=(rep, row) + state_out,
         check_vma=False,
     )
-    return jax.jit(fn)(bins, grad, hess, bag_mask, feature_mask, *meta_vals)
+    if cegb_on:
+        fu_in, uid_in = cegb_state
+    else:
+        fu_in, uid_in = jnp.zeros((1,), bool), jnp.zeros((1, 1), bool)
+    return jax.jit(fn)(
+        bins, grad, hess, bag_mask, feature_mask, fu_in, uid_in, *meta_vals
+    )

@@ -207,6 +207,75 @@ class TestCEGB:
         for t in bst._gbdt.trees():
             assert t.split_feature[0] == 5
 
+    def test_cegb_voting_matches_serial_when_topk_covers(self):
+        """With top_k >= F the voting learner's batched CEGB rescan elects
+        every feature and psums full histograms — bit-identical to serial
+        CEGB (the voting analogue of test_cegb_data_parallel_matches_serial)."""
+        X, y = make_data(n=1024, seed=11)
+        F = X.shape[1]
+        for pen_kw in (
+            {"cegb_penalty_split": 0.5},
+            {"cegb_penalty_feature_coupled": [1.0] * F},
+            {"cegb_penalty_feature_lazy": [0.05] * F},
+        ):
+            ds_params = dict(BASE, objective="binary", **pen_kw)
+            serial = lgb.train(
+                dict(ds_params, tree_learner="serial"), lgb.Dataset(X, label=y), 3
+            )
+            vp = lgb.train(
+                dict(ds_params, tree_learner="voting", top_k=F),
+                lgb.Dataset(X, label=y),
+                3,
+            )
+            # structure (features, thresholds, leaf counts) must match
+            # exactly; float values only to ULP tolerance — the voting carry
+            # accumulates shard-local subtractions that one final psum
+            # combines, a different summation order than serial's
+            # chunked-global scan (same splits, last-digit drift)
+            for a, b in zip(
+                serial.model_to_string().splitlines(),
+                vp.model_to_string().splitlines(),
+            ):
+                if a == b or a.startswith(("[", "tree_sizes")):
+                    continue
+                ka, va = a.split("=", 1)
+                kb, vb = b.split("=", 1)
+                assert ka == kb, (pen_kw, a, b)
+                if ka in ("split_feature", "threshold", "decision_type",
+                          "num_leaves", "split_indices", "num_cat"):
+                    assert va == vb, (pen_kw, a, b)
+                else:
+                    fa = np.asarray([float(t) for t in va.split()])
+                    fb = np.asarray([float(t) for t in vb.split()])
+                    np.testing.assert_allclose(
+                        fa, fb, rtol=2e-5, atol=1e-6, err_msg=str((pen_kw, ka))
+                    )
+
+    def test_cegb_voting_small_topk_prunes(self):
+        """top_k < F: the penalized vote still trains and the split penalty
+        still prunes relative to penalty-free voting."""
+        X, y = make_data(n=1024, seed=12)
+        # num_leaves above BASE so the free tree reaches low-gain deep splits
+        # the penalty can prune (at 15 leaves both trees max out)
+        free = lgb.train(
+            dict(BASE, objective="binary", tree_learner="voting", top_k=2,
+                 num_leaves=63),
+            lgb.Dataset(X, label=y),
+            3,
+        )
+        # penalty_split charges tradeoff * pen * count of the split leaf:
+        # 0.1 * 1024 ~= 102 at the root, below the root gain (~196), but a
+        # ~16-row deep leaf pays ~1.6 against sub-unit gains — pruned
+        pen = lgb.train(
+            dict(BASE, objective="binary", tree_learner="voting", top_k=2,
+                 num_leaves=63, cegb_penalty_split=0.1),
+            lgb.Dataset(X, label=y),
+            3,
+        )
+        n_free = sum(t.num_leaves for t in free._gbdt.trees())
+        n_pen = sum(t.num_leaves for t in pen._gbdt.trees())
+        assert 3 <= n_pen < n_free
+
     def test_coupled_penalty_focuses_features(self):
         """Heavy coupled penalty on noise features concentrates splits."""
         X, y = make_data(seed=6)

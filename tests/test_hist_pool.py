@@ -139,12 +139,87 @@ def test_histogram_pool_size_config_end_to_end():
     assert abs(acc2 - auc_ok) < 0.02
 
 
-def test_pool_rejects_cegb():
-    ds, meta, grad, hess = _setup(n=500)
+def _grow_cegb(ds, meta, grad, hess, leaves, cegb, **kw):
+    n = ds.num_data
+    ones = jnp.ones((n,), jnp.float32)
+    fmask = jnp.ones((meta["num_bin"].shape[0],), bool)
+    tree, leaf_id, state = grow_tree(
+        jnp.asarray(ds.bins), grad, hess, ones, fmask, meta,
+        num_leaves=leaves, max_depth=kw.pop("max_depth", -1),
+        num_bins=ds.max_num_bin, params=PARAMS, cegb=cegb, **kw,
+    )
+    return tree, leaf_id, state
+
+
+def test_pool_cegb_exact_when_all_resident():
+    """Pooled CEGB == unpooled CEGB, tree-for-tree, while no slot is ever
+    evicted (depth-limited growth keeps every leaf resident): the
+    rescan-from-resident-slots path covers exactly the rescan-all set."""
     from lightgbm_tpu.ops.split import CegbParams
 
-    with pytest.raises(NotImplementedError):
-        _grow(
-            ds, meta, grad, hess, 15, hist_pool_slots=4,
-            cegb=CegbParams(tradeoff=1.0, penalty_split=0.1),
-        )
+    ds, meta, grad, hess = _setup(seed=7)
+    F = meta["num_bin"].shape[0]
+    meta = dict(meta)
+    meta["cegb_coupled"] = jnp.asarray(np.full(F, 0.5, np.float32))
+    cegb = CegbParams(tradeoff=1.0, penalty_split=0.2, has_coupled=True)
+    # max_depth=3 -> at most 8 leaves; 15 slots < 31 leaves engages the pool
+    # but no eviction ever happens
+    ta, la, sa = _grow_cegb(ds, meta, grad, hess, 31, cegb, max_depth=3)
+    tb, lb, sb = _grow_cegb(
+        ds, meta, grad, hess, 31, cegb, max_depth=3, hist_pool_slots=15
+    )
+    _assert_trees_equal(ta, tb)
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    np.testing.assert_array_equal(np.asarray(sa[0]), np.asarray(sb[0]))
+
+
+def test_pool_cegb_eviction_still_prunes_and_trains():
+    """A tiny pool under CEGB (heavy eviction: cached candidates carry the
+    reference's coupled-penalty gain patch) still grows a valid tree, and the
+    split penalty still prunes it relative to penalty-free growth."""
+    from lightgbm_tpu.ops.split import CegbParams
+
+    ds, meta, grad, hess = _setup(seed=9)
+    F = meta["num_bin"].shape[0]
+    cmeta = dict(meta)
+    cmeta["cegb_coupled"] = jnp.asarray(np.full(F, 0.5, np.float32))
+    # penalty_split charges per row of the split leaf (tradeoff * pen * count):
+    # keep it small enough that the root (4000 rows) still splits
+    cegb = CegbParams(tradeoff=1.0, penalty_split=0.01, has_coupled=True)
+    t_free, _ = _grow(ds, meta, grad, hess, 63, hist_pool_slots=4)
+    t_pen, _, state = _grow_cegb(
+        ds, cmeta, grad, hess, 63, cegb, hist_pool_slots=4
+    )
+    n_free, n_pen = int(t_free.num_leaves), int(t_pen.num_leaves)
+    assert 1 < n_pen <= n_free  # penalties only ever prune
+    counts = np.asarray(t_pen.leaf_count)
+    assert counts[:n_pen].sum() == ds.num_data
+    # every feature the tree used is recorded as bought
+    used = np.asarray(state[0])
+    for f in np.asarray(t_pen.split_feature)[: n_pen - 1]:
+        assert used[int(f)]
+
+
+def test_pool_cegb_end_to_end_booster():
+    """histogram_pool_size + CEGB through the public API: the carry is
+    capped AND penalties apply."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(6000, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    base = {
+        "objective": "binary", "num_leaves": 255, "min_data_in_leaf": 3,
+        "verbosity": -1,
+    }
+    # per-leaf bytes = 8 * 256 * 3 * 4 = 24KB; 0.5MB ~= 21 slots
+    bst = lgb.train(
+        dict(base, histogram_pool_size=0.5, cegb_penalty_split=2.0), ds, 2
+    )
+    gbdt = bst._gbdt
+    slots = gbdt._hist_pool_slots()
+    assert slots is not None and slots < 255
+    assert gbdt._hist_buf.shape[0] == slots
+    free = lgb.train(dict(base, histogram_pool_size=0.5), ds, 2)
+    n_pen = sum(t.num_leaves for t in bst._gbdt.trees())
+    n_free = sum(t.num_leaves for t in free._gbdt.trees())
+    assert n_pen < n_free  # the split penalty pruned under the pool
