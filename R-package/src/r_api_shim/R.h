@@ -1,0 +1,6 @@
+// Fake <R.h> for compiling the .Call bridge without an R installation —
+// everything lives in the fake Rinternals.h. See that header's banner.
+#ifndef LGBT_FAKE_R_H_
+#define LGBT_FAKE_R_H_
+#include "Rinternals.h"
+#endif

@@ -1,0 +1,229 @@
+// Minimal fake of the R C API — just enough to compile AND RUN the .Call
+// bridge (../lightgbm_tpu_R.cpp) without an R installation.
+//
+// Purpose (mirrors the reference shipping R_object_helper.h, a hand-rolled
+// SEXP layout layer, so its bridge can be exercised outside a full R build):
+// this environment cannot install r-base, so tests/test_r_bridge_c.py
+// compiles the real bridge against THIS header plus a plain C++ driver that
+// fakes the SEXP layer, and drives Dataset-create -> train -> eval ->
+// predict -> save/load through the exact .Call signatures R would use.
+//
+// Fidelity notes:
+//  * SEXPs are heap structs, never freed (driver processes are short-lived);
+//    PROTECT/UNPROTECT are identity/no-op.
+//  * R_NilValue is the null pointer so nil identity holds across translation
+//    units without shared state.
+//  * Rf_error prints and exits 90 — the bridge treats it as noreturn, and
+//    the test treats exit 90 as "an R error was raised".
+//  * Numeric vectors are REALSXP doubles and INTSXP int32 like real R;
+//    STRSXP holds CHARSXP elements; matrices are column-major doubles,
+//    matching the bridge's is_row_major=0 calls.
+#ifndef LGBT_FAKE_RINTERNALS_H_
+#define LGBT_FAKE_RINTERNALS_H_
+
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef long long R_xlen_t;
+
+enum {
+  NILSXP = 0,
+  SYMSXP = 1,
+  LGLSXP = 10,
+  INTSXP = 13,
+  REALSXP = 14,
+  STRSXP = 16,
+  VECSXP = 19,
+  EXTPTRSXP = 22,
+  CHARSXP = 9,
+};
+
+typedef struct LGBT_FakeSexp {
+  int sxp_type;
+  R_xlen_t length;
+  double* reals;              /* REALSXP */
+  int* ints;                  /* INTSXP / LGLSXP */
+  char* chars;                /* CHARSXP payload (NUL-terminated) */
+  struct LGBT_FakeSexp** vec; /* STRSXP/VECSXP elements */
+  /* EXTPTRSXP */
+  void* extptr;
+  struct LGBT_FakeSexp* tag;
+  void (*finalizer)(struct LGBT_FakeSexp*);
+  /* SYMSXP */
+  const char* sym_name;
+} LGBT_FakeSexp;
+
+typedef LGBT_FakeSexp* SEXP;
+
+#define R_NilValue ((SEXP)0)
+typedef int Rboolean;
+#ifndef TRUE
+#define TRUE 1
+#define FALSE 0
+#endif
+
+static inline SEXP lgbt_fake_new(int type, R_xlen_t n) {
+  SEXP s = (SEXP)calloc(1, sizeof(LGBT_FakeSexp));
+  s->sxp_type = type;
+  s->length = n;
+  if (type == REALSXP) s->reals = (double*)calloc(n > 0 ? n : 1, sizeof(double));
+  if (type == INTSXP || type == LGLSXP)
+    s->ints = (int*)calloc(n > 0 ? n : 1, sizeof(int));
+  if (type == STRSXP || type == VECSXP)
+    s->vec = (LGBT_FakeSexp**)calloc(n > 0 ? n : 1, sizeof(SEXP));
+  return s;
+}
+
+static inline int TYPEOF(SEXP x) { return x ? x->sxp_type : NILSXP; }
+static inline R_xlen_t XLENGTH(SEXP x) { return x ? x->length : 0; }
+static inline double* REAL(SEXP x) { return x->reals; }
+static inline int* INTEGER(SEXP x) { return x->ints; }
+static inline int* LOGICAL(SEXP x) { return x->ints; }
+static inline const char* CHAR(SEXP x) { return x->chars; }
+static inline SEXP STRING_ELT(SEXP x, R_xlen_t i) { return x->vec[i]; }
+static inline void SET_STRING_ELT(SEXP x, R_xlen_t i, SEXP v) { x->vec[i] = v; }
+
+#define PROTECT(x) (x)
+static inline void UNPROTECT(int n) { (void)n; }
+
+#if defined(__GNUC__)
+__attribute__((noreturn, format(printf, 1, 2)))
+#endif
+static inline void
+Rf_error(const char* fmt, ...) {
+  va_list ap;
+  va_start(ap, fmt);
+  fprintf(stderr, "Rf_error: ");
+  vfprintf(stderr, fmt, ap);
+  fprintf(stderr, "\n");
+  va_end(ap);
+  exit(90);
+}
+
+static inline SEXP Rf_install(const char* name) {
+  SEXP s = lgbt_fake_new(SYMSXP, 0);
+  s->sym_name = name;
+  return s;
+}
+
+static inline int Rf_isNull(SEXP x) { return x == R_NilValue; }
+
+static inline SEXP Rf_mkCharLen(const char* p, int n) {
+  SEXP s = lgbt_fake_new(CHARSXP, n);
+  s->chars = (char*)malloc((size_t)n + 1);
+  memcpy(s->chars, p, (size_t)n);
+  s->chars[n] = '\0';
+  return s;
+}
+
+static inline SEXP Rf_mkChar(const char* p) {
+  return Rf_mkCharLen(p, (int)strlen(p));
+}
+
+static inline SEXP Rf_mkString(const char* p) {
+  SEXP s = lgbt_fake_new(STRSXP, 1);
+  s->vec[0] = Rf_mkChar(p);
+  return s;
+}
+
+static inline SEXP Rf_allocVector(int type, R_xlen_t n) {
+  return lgbt_fake_new(type, n);
+}
+
+static inline SEXP Rf_asChar(SEXP x) {
+  if (TYPEOF(x) == CHARSXP) return x;
+  if (TYPEOF(x) == STRSXP && x->length > 0) return x->vec[0];
+  Rf_error("asChar on a non-string");
+}
+
+static inline int Rf_asInteger(SEXP x) {
+  if (TYPEOF(x) == INTSXP || TYPEOF(x) == LGLSXP) return x->ints[0];
+  if (TYPEOF(x) == REALSXP) return (int)x->reals[0];
+  Rf_error("asInteger on a non-number");
+}
+
+static inline int Rf_asLogical(SEXP x) { return Rf_asInteger(x) != 0; }
+
+static inline SEXP Rf_ScalarInteger(int v) {
+  SEXP s = lgbt_fake_new(INTSXP, 1);
+  s->ints[0] = v;
+  return s;
+}
+
+static inline SEXP Rf_ScalarLogical(int v) {
+  SEXP s = lgbt_fake_new(LGLSXP, 1);
+  s->ints[0] = v;
+  return s;
+}
+
+static inline SEXP Rf_ScalarReal(double v) {
+  SEXP s = lgbt_fake_new(REALSXP, 1);
+  s->reals[0] = v;
+  return s;
+}
+
+static inline SEXP Rf_setAttrib(SEXP x, SEXP sym, SEXP v) {
+  (void)sym;
+  (void)v;
+  return x; /* attributes are not read back by the bridge */
+}
+
+/* ---- external pointers ------------------------------------------------ */
+static inline SEXP R_MakeExternalPtr(void* p, SEXP tag, SEXP prot) {
+  (void)prot;
+  SEXP s = lgbt_fake_new(EXTPTRSXP, 1);
+  s->extptr = p;
+  s->tag = tag;
+  return s;
+}
+static inline void* R_ExternalPtrAddr(SEXP x) { return x->extptr; }
+static inline SEXP R_ExternalPtrTag(SEXP x) { return x->tag; }
+static inline void R_ClearExternalPtr(SEXP x) { x->extptr = 0; }
+static inline void R_RegisterCFinalizerEx(SEXP x, void (*fin)(SEXP),
+                                          Rboolean onexit) {
+  (void)onexit;
+  x->finalizer = fin;
+}
+
+/* ---- routine registration (R_ext/Rdynload.h subset) ------------------- */
+typedef void* (*DL_FUNC)(void);
+typedef struct {
+  const char* name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+typedef struct {
+  const R_CallMethodDef* call_methods;
+  int n_call_methods;
+} DllInfo;
+
+static inline void R_registerRoutines(DllInfo* dll, const void* croutines,
+                                      const R_CallMethodDef* call,
+                                      const void* fortran,
+                                      const void* external) {
+  (void)croutines;
+  (void)fortran;
+  (void)external;
+  int n = 0;
+  while (call && call[n].name) ++n;
+  if (dll) {
+    dll->call_methods = call;
+    dll->n_call_methods = n;
+  }
+}
+static inline void R_useDynamicSymbols(DllInfo* dll, Rboolean v) {
+  (void)dll;
+  (void)v;
+}
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* LGBT_FAKE_RINTERNALS_H_ */

@@ -373,18 +373,87 @@ def _run() -> None:
     t0 = time.time()
     for _ in range(bench_iters):
         booster.update()
-    # force completion of the last device work
-    jax.block_until_ready(booster._gbdt.scores)
+    # force completion of the last device work. A literal element fetch, not
+    # just block_until_ready: on the tunneled TPU backend block_until_ready
+    # can return before the enqueued work has executed (measured), and since
+    # the per-iter num_leaves sync was removed the loop above is fully async
+    # — without the fetch this would time enqueue rate, not execution.
+    float(np.asarray(jax.numpy.ravel(booster._gbdt.scores)[0]))
     bench_time = time.time() - t0
 
     iters_per_sec = bench_iters / bench_time / scaled
 
+    # AUC of the model whose throughput was just measured — BEFORE the phase
+    # breakdown below advances the booster by 3 more iterations
     score = booster._gbdt._train_score_np()
     auc_metric = AUCMetric(booster.config)
     auc_metric.init(ds._binned.metadata, ds.num_data())
     auc = auc_metric.eval(score, booster._gbdt.objective)[0][1]
 
+    # ---- phase breakdown + roofline model (VERDICT r3 item 4) -----------
+    # Phases from 3 extra TIMETAG'd iterations (TIMETAG serializes phases
+    # with blocking waits, so it runs OUTSIDE the headline timing loop).
+    phases = {}
+    try:
+        gbdt = booster._gbdt
+        gbdt.timers.enabled = True
+        gbdt.timers.seconds.clear()
+        gbdt.timers.counts.clear()
+        for _ in range(3):
+            booster.update()
+        phases = {k: round(v / 3, 4) for k, v in gbdt.timers.seconds.items()}
+        gbdt.timers.enabled = False
+    except Exception as e:
+        print("bench: phase breakdown failed: %s" % e, file=sys.stderr)
+    # Work model per boosting iteration, from the actually-grown trees:
+    # histogram rows = sum over splits of the smaller child (subtraction
+    # trick), flops = rows x F x K x 2 (multiply-add per bin entry), bytes =
+    # hist rows x (F bins u8 + K f32 values) + one partition gather pass.
+    mfu_estimate = None
+    roofline = {}
+    try:
+        gbdt._materialize()
+        trees = [t for t in gbdt.models if t is not None and t.num_leaves > 1]
+        if trees:
+            t = trees[-1]
+            import numpy as _np
+
+            counts = _np.asarray(t.internal_count, _np.float64)
+            left, right = _np.asarray(t.left_child), _np.asarray(t.right_child)
+            leaf_counts = _np.asarray(t.leaf_count, _np.float64)
+            nsplit = t.num_leaves - 1
+
+            def child_count(c):
+                return leaf_counts[-(c + 1)] if c < 0 else counts[c]
+
+            small_rows = sum(
+                min(child_count(left[i]), child_count(right[i]))
+                for i in range(nsplit)
+            )
+            F, K, Bn = N_FEATURES, 3, MAX_BIN + 1
+            hist_flops = small_rows * F * K * 2
+            scan_flops = nsplit * 2 * F * Bn * 20  # two-direction cumsum scans
+            hist_bytes = small_rows * (F + K * 4) + n_rows * (F + 8)
+            # v5e-1: ~197 TFLOP/s bf16 / ~99 TFLOP/s f32 MXU, ~819 GB/s HBM
+            peak_flops = 99e12 if platform in ("tpu", "axon") else 1e11
+            peak_bw = 819e9 if platform in ("tpu", "axon") else 2e10
+            iter_s = 1.0 / max(iters_per_sec, 1e-9)
+            mfu_estimate = round((hist_flops + scan_flops) / iter_s / peak_flops, 6)
+            roofline = {
+                "hist_small_rows_per_iter": int(small_rows),
+                "model_flops_per_iter": float(hist_flops + scan_flops),
+                "model_bytes_per_iter": float(hist_bytes),
+                "hbm_utilization": round(hist_bytes / iter_s / peak_bw, 4),
+            }
+    except Exception as e:
+        print("bench: roofline model failed: %s" % e, file=sys.stderr)
+
     extra = {"platform": platform, "train_auc": round(float(auc), 6)}
+    if phases:
+        extra["phases_s"] = phases
+    if mfu_estimate is not None:
+        extra["mfu_estimate"] = mfu_estimate
+        extra.update(roofline)
     if scaled != 1.0:
         extra["cpu_fallback_measured_rows"] = n_rows
         extra["cpu_fallback_scale"] = scaled

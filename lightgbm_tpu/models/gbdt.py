@@ -156,6 +156,10 @@ class GBDT:
         self._feat_rng = np.random.RandomState(cfg.feature_fraction_seed & 0x7FFFFFFF)
         self._bag_mask = jnp.ones((self.num_data,), jnp.float32)
         self._bagging_active = False
+        self._finish_fns = {}  # jitted renew+shrink+score-update steps per class
+        self._pending_stop = None  # last iteration's device num_leaves scalars
+        self._stopped = False
+        self._fmask_all = jnp.ones((self.train_set.num_features or 1,), bool)
         self.class_need_train = [
             self.objective.class_need_train(k) if self.objective is not None else True
             for k in range(K)
@@ -367,7 +371,7 @@ class GBDT:
         cfg = self.config
         F = self.train_set.num_features
         if cfg.feature_fraction >= 1.0:
-            return jnp.ones((F,), bool)
+            return self._fmask_all  # cached: no per-iter host->device upload
         k = max(1, int(cfg.feature_fraction * F))
         idx = self._feat_rng.choice(F, size=k, replace=False)
         mask = np.zeros(F, bool)
@@ -379,9 +383,21 @@ class GBDT:
         self, gradients: Optional[np.ndarray] = None, hessians: Optional[np.ndarray] = None
     ) -> bool:
         """One boosting iteration; returns True if training should stop
-        (TrainOneIter, gbdt.cpp:332-413)."""
+        (TrainOneIter, gbdt.cpp:332-413).
+
+        The no-more-splits stop check is DEFERRED by one call: reading the
+        grown tree's num_leaves on the host costs a full device->host
+        round-trip (~66ms over the TPU tunnel) that would serialize every
+        iteration. Instead the num_leaves scalar starts an async host copy
+        and is inspected at the START of the next call, by which time it has
+        long arrived; the iteration that failed to split contributed exactly
+        zero to the scores (the score update masks on num_leaves > 1 on
+        device), and its K placeholder trees are popped on detection — the
+        same end state as the reference's immediate check."""
         cfg = self.config
         K = self.num_tree_per_iteration
+        if self._consume_pending_stop() or self._stopped:
+            return True
         timers = self.timers
         init_scores = [0.0] * K
         if gradients is None or hessians is None:
@@ -397,7 +413,7 @@ class GBDT:
         with timers.phase("bagging"):
             grad, hess = self._bagging(self.iter_, grad, hess)
 
-        should_continue = False
+        pending = []
         for k in range(K):
             tree_arrays = None
             leaf_id = None
@@ -406,13 +422,11 @@ class GBDT:
                     tree_arrays, leaf_id = self._train_tree(grad[k], hess[k])
                     if timers.enabled:
                         jax.block_until_ready(tree_arrays)
-            num_leaves = int(tree_arrays.num_leaves) if tree_arrays is not None else 1
-            if num_leaves > 1:
-                should_continue = True
+            if tree_arrays is not None:
+                nl_dev = tree_arrays.num_leaves
                 with timers.phase("renew+score update"):
-                    tree_arrays = self._renew_and_shrink(tree_arrays, leaf_id, k)
-                    # score update by leaf gather (all rows incl. out-of-bag)
-                    self.scores = self.scores.at[k].add(tree_arrays.leaf_value[leaf_id])
+                    # one jitted dispatch: renew + shrink + masked score add
+                    tree_arrays = self._finish_tree(tree_arrays, leaf_id, k, nl_dev)
                     if timers.enabled:
                         jax.block_until_ready(self.scores)
                 with timers.phase("valid scores"):
@@ -423,6 +437,11 @@ class GBDT:
                     )
                 self._device_trees.append((tree_arrays, k))
                 self.models.append(None)  # lazily converted
+                try:
+                    nl_dev.copy_to_host_async()
+                except Exception:
+                    pass
+                pending.append((nl_dev, k, init_scores[k]))
             else:
                 if len(self.models) < K:
                     output = 0.0
@@ -448,18 +467,106 @@ class GBDT:
                     self.models.append(t)
                     self._device_trees.append((None, k))
 
-        if not should_continue:
+        if pending:
+            self._pending_stop = pending
+        else:
+            # no class trained at all (e.g. zero usable features): the
+            # deferred check has nothing to inspect — stop immediately with
+            # the constant trees this iteration appended (gbdt.cpp:375-400)
             log.warning(
-                "Stopped training because there are no more leaves that meet the split requirements"
+                "Stopped training because there are no more leaves that meet"
+                " the split requirements"
             )
             if len(self.models) > K:
                 for _ in range(K):
                     self.models.pop()
                     self._device_trees.pop()
+            self._stopped = True
             return True
         self._after_train_iter()
         self.iter_ += 1
         return False
+
+    def _consume_pending_stop(self) -> bool:
+        """Inspect the previous iteration's (async-copied) num_leaves scalars;
+        roll back that iteration and stop if no class managed a split —
+        the deferred twin of gbdt.cpp:375-400."""
+        # getattr: model-string-loaded boosters skip the training __init__
+        pend = getattr(self, "_pending_stop", None)
+        if not pend:
+            return False
+        self._pending_stop = None
+        if any(int(nl) > 1 for nl, _, _ in pend):
+            return False
+        K = self.num_tree_per_iteration
+        log.warning(
+            "Stopped training because there are no more leaves that meet the split requirements"
+        )
+        self.iter_ -= 1  # the rolled-back iteration does not count
+        if len(self.models) > K:
+            for _ in range(K):
+                self.models.pop()
+                self._device_trees.pop()
+        else:
+            # first iteration: the kept 1-leaf trees carry the init score in
+            # their leaf (reference keeps constant trees AND re-adds the
+            # output to the scores, gbdt.cpp:375-395) — only for the classes
+            # that actually TRAINED; untrained classes' constant-tree branch
+            # already added its own output
+            for _, k, init in pend:
+                if abs(init) > K_EPSILON:
+                    self.scores = self.scores.at[k].add(np.float32(init))
+                    if hasattr(self, "valid_scores"):
+                        for i in range(len(self.valid_scores)):
+                            self.valid_scores[i] = (
+                                self.valid_scores[i].at[k].add(np.float32(init))
+                            )
+        self._stopped = True
+        return True
+
+    def _finish_tree(self, tree_arrays, leaf_id, k: int, nl_dev):
+        """Renew + shrinkage + num_leaves-masked score update as ONE jitted
+        dispatch. The previous eager chain (np scalar uploads + 4 separate
+        dispatches) cost a device round-trip per op over the TPU tunnel;
+        fusing makes the whole post-grow step a single async launch. The
+        mask keeps a splitless tree's contribution at exactly zero so the
+        deferred stop check (train_one_iter) can run an iteration behind."""
+        obj = self.objective
+        renew = (
+            obj.renew_leaf_outputs_device
+            if (obj is not None and obj.is_renew_tree_output)
+            else None
+        )
+        use_bag = self._bagging_active
+        key = (k, renew is not None, use_bag)
+        fn = self._finish_fns.get(key)
+        if fn is None:
+            M = self.config.num_leaves
+
+            def step(scores, leaf_value, internal_value, lid, bag, nl, rate):
+                if renew is not None:
+                    leaf_value = renew(
+                        scores[k], lid, bag if use_bag else None, M, leaf_value
+                    )
+                leaf_value = jnp.where(nl > 1, leaf_value * rate, jnp.float32(0.0))
+                internal_value = internal_value * rate
+                scores = scores.at[k].add(leaf_value[lid])
+                return scores, leaf_value, internal_value
+
+            fn = jax.jit(step, donate_argnums=(0,))
+            self._finish_fns[key] = fn
+        self.scores, leaf_value, internal_value = fn(
+            self.scores,
+            tree_arrays.leaf_value,
+            tree_arrays.internal_value,
+            leaf_id,
+            self._bag_mask,
+            nl_dev,
+            np.float32(self.shrinkage_rate),
+        )
+        return tree_arrays._replace(
+            leaf_value=leaf_value, internal_value=internal_value
+        )
 
     def _train_tree(self, grad_k: jax.Array, hess_k: jax.Array):
         cfg = self.config
@@ -662,30 +769,6 @@ class GBDT:
             jax.device_put(bag, row),
         )
 
-    def _renew_and_shrink(self, tree_arrays, leaf_id, class_id: int):
-        """RenewTreeOutput (serial_tree_learner.cpp:854) + Shrinkage.
-
-        Runs fully on device via segment percentiles (segment_percentile) —
-        the per-leaf host percentile loop remains as the differential oracle
-        (tests/test_renew_device.py)."""
-        obj = self.objective
-        if obj is not None and obj.is_renew_tree_output:
-            new_out = obj.renew_leaf_outputs_device(
-                self.scores[class_id],
-                leaf_id,
-                self._bag_mask if self._bagging_active else None,
-                self.config.num_leaves,
-                tree_arrays.leaf_value,
-            )
-            tree_arrays = tree_arrays._replace(
-                leaf_value=jnp.asarray(new_out, jnp.float32)
-            )
-        rate = np.float32(self.shrinkage_rate)
-        return tree_arrays._replace(
-            leaf_value=tree_arrays.leaf_value * rate,
-            internal_value=tree_arrays.internal_value * rate,
-        )
-
     def _update_valid_scores(self, tree_arrays, class_id: int) -> None:
         if not hasattr(self, "valid_scores"):
             return
@@ -707,6 +790,9 @@ class GBDT:
     # ------------------------------------------------------------------
 
     def _materialize(self) -> None:
+        # a deferred no-split iteration must roll back before its placeholder
+        # trees can leak into model output (train_one_iter's deferred check)
+        self._consume_pending_stop()
         for i, (ta, k) in enumerate(self._device_trees):
             if self.models[i] is None:
                 self.models[i] = Tree.from_device(ta, self.train_set)

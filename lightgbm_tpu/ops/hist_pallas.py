@@ -72,6 +72,29 @@ def _max_chunk(hi_n: int, k_n: int, dtype) -> int:
     return max(512, (c // 512) * 512)
 
 
+FB = 8  # features per grid step in the feature-batched kernel (sublane-aligned
+# i8 block: Mosaic cannot load a single dynamic u8 row, but an [8, C] block
+# starting at a multiple of 8 is provably aligned)
+
+
+def _max_chunk_fb(hi_n: int, k_n: int, dtype) -> int:
+    """Chunk cap for the feature-batched (v2) kernel: an [FB, C] bins block
+    plus one values block per step; per-feature intermediates are reused
+    across the static in-kernel unroll."""
+    d = jnp.dtype(dtype).itemsize
+    per_row = (
+        2 * FB  # double-buffered [FB, C] u8 bins block
+        + 2 * 4 * k_n  # double-buffered [K, C] f32 values block
+        + 8 * FB  # hi/lo int32 [FB, C]
+        + 32 + 4 * hi_n  # hoisted lo/hi iotas (i32)
+        + d * (LO + LO * k_n + hi_n)  # oh_lo, lhs, oh_hi (reused per feature)
+    )
+    if d == 4:
+        per_row += 2 * 2 * (LO * k_n + hi_n)  # HIGHEST bf16 operand shadows
+    c = _VMEM_BUDGET // per_row
+    return max(512, (c // 512) * 512)
+
+
 def _hi_for(num_bins: int) -> int:
     hi = -(-num_bins // LO)
     if hi * 3 > 128:
@@ -119,9 +142,101 @@ def _kernel(bins_ref, vt_ref, out_ref, *, hi_n: int, dtype):
     )
 
 
+def _kernel_fb(bins_ref, vt_ref, out_ref, *, hi_n: int, dtype):
+    """Feature-batched kernel body: one grid step consumes an [FB, C] bins
+    block + ONE [K, C] values block and unrolls the FB features in VMEM. The
+    v1 grid (F, chunks) re-streamed the values block once per feature — 9x
+    the HBM traffic at F=28 — and measured DMA-bound on silicon (bf16 == f32
+    time, 34.8ms for 1Mx28x255). The factor orientation also flips vs v1:
+    lhs = onehot_lo (x) values [LO*K, C] (24 rows of VPU build work per row
+    instead of 96), rhs = onehot_hi [C, HI]."""
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    vt = vt_ref[:].astype(dtype)  # [K, C]
+    k_n, C = vt.shape
+    b_all = bins_ref[:, :].astype(jnp.int32)  # [FB, C]
+    hi_all = b_all // LO
+    lo_all = b_all - hi_all * LO
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (LO, C), 0)
+    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (C, hi_n), 1)
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+    for j in range(FB):  # static unroll: register slices, no dynamic u8 rows
+        oh_lo = (lo_all[j][None, :] == lo_iota).astype(dtype)  # [LO, C]
+        lhs = (oh_lo[:, None, :] * vt[None, :, :]).reshape(LO * k_n, C)
+        oh_hi = (hi_all[j][:, None] == hi_iota).astype(dtype)  # [C, HI]
+        out_ref[j] += jax.lax.dot_general(
+            lhs, oh_hi,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )
+
+
 @functools.partial(
     jax.jit, static_argnames=("num_bins", "chunk", "dtype_name", "interpret")
 )
+def _histogram_pallas_fb(
+    bins: jax.Array,  # [F, N]
+    values: jax.Array,  # [N, K]
+    num_bins: int,
+    chunk: int = 8192,
+    dtype_name: str = "float32",
+    interpret: bool = False,
+) -> jax.Array:
+    """[F, B, K] f32 histogram via the feature-batched radix MXU kernel."""
+    F, N = bins.shape
+    K = values.shape[1]
+    B = num_bins
+    HI = _hi_for(B)
+    dtype = jnp.dtype(dtype_name)
+
+    C = min(max(chunk, 512), max(512, N), _max_chunk_fb(HI, K, dtype))
+    C = max(512, (C // 512) * 512)
+    if N % C != 0:
+        pad = (-N) % C
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        values = jnp.pad(values, ((0, pad), (0, 0)))
+        N += pad
+    n_chunks = N // C
+    Fp = -(-F // FB) * FB
+    if Fp != F:
+        # padded feature rows histogram the padded bins (all zero) against
+        # real values; their rows are sliced off below
+        bins = jnp.pad(bins, ((0, Fp - F), (0, 0)))
+
+    vt = values.T  # [K, N]
+    kernel = functools.partial(_kernel_fb, hi_n=HI, dtype=dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Fp // FB, n_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f8, c: (f8, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, C), lambda f8, c: (0, c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (FB, LO * K, HI), lambda f8, c: (f8, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((Fp, LO * K, HI), jnp.float32),
+        interpret=interpret,
+    )(bins, vt)
+
+    # out[f, lo*K + k, hi] -> hist[f, hi*LO + lo, k]
+    hist = (
+        out.reshape(Fp, LO, K, HI)
+        .transpose(0, 3, 1, 2)
+        .reshape(Fp, HI * LO, K)
+    )
+    return hist[:F, :B, :]
+
+
 def histogram_pallas(
     bins: jax.Array,  # [F, N] uint8/int32
     values: jax.Array,  # [N, K] f32 (mask pre-applied; out-of-leaf rows are 0)
@@ -130,7 +245,29 @@ def histogram_pallas(
     dtype_name: str = "bfloat16",
     interpret: bool = False,
 ) -> jax.Array:
-    """[F, B, K] f32 histogram via the radix-packed MXU kernel."""
+    """[F, B, K] f32 histogram via the radix-packed MXU kernel.
+
+    Dispatches to the feature-batched kernel (the on-silicon winner); the
+    per-feature-grid v1 below remains as its differential oracle
+    (tests/test_hist_pallas.py)."""
+    return _histogram_pallas_fb(
+        bins, values, num_bins, chunk=max(chunk, 4096),
+        dtype_name=dtype_name, interpret=interpret,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "chunk", "dtype_name", "interpret")
+)
+def histogram_pallas_v1(
+    bins: jax.Array,  # [F, N] uint8/int32
+    values: jax.Array,  # [N, K] f32 (mask pre-applied; out-of-leaf rows are 0)
+    num_bins: int,
+    chunk: int = 2048,
+    dtype_name: str = "bfloat16",
+    interpret: bool = False,
+) -> jax.Array:
+    """[F, B, K] f32 histogram via the per-feature-grid radix kernel (v1)."""
     F, N = bins.shape
     K = values.shape[1]
     B = num_bins

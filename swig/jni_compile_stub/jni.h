@@ -1,0 +1,47 @@
+// Compile-only <jni.h> stub: just the JNI surface the SWIG-generated
+// wrapper uses (6 JNIEnv methods + primitive typedefs), so
+// tests/test_swig.py can PROVE the generated C++ compiles against
+// lgbt_c_api.h even though this image ships no JDK. Declarations only —
+// nothing here runs; linking a loadable JNI library still requires a real
+// JDK (reference analogue: the USE_SWIG CMake branch compiles the same
+// wrapper against the real jni.h).
+#ifndef LGBT_FAKE_JNI_H_
+#define LGBT_FAKE_JNI_H_
+
+typedef signed char jbyte;
+typedef unsigned char jboolean;
+typedef unsigned short jchar;
+typedef short jshort;
+typedef int jint;
+typedef long long jlong;
+typedef float jfloat;
+typedef double jdouble;
+typedef jint jsize;
+
+#ifdef __cplusplus
+class _jobject {};
+typedef _jobject* jobject;
+typedef jobject jclass;
+typedef jobject jstring;
+typedef jobject jthrowable;
+typedef jobject jarray;
+
+struct JNIEnv_;
+typedef JNIEnv_ JNIEnv;
+
+// declaration-only method set (everything the SWIG wrapper calls)
+struct JNIEnv_ {
+  jclass FindClass(const char* name);
+  void ExceptionClear();
+  jint ThrowNew(jclass clazz, const char* msg);
+  jstring NewStringUTF(const char* utf);
+  const char* GetStringUTFChars(jstring str, jboolean* isCopy);
+  void ReleaseStringUTFChars(jstring str, const char* chars);
+};
+#endif  /* __cplusplus */
+
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNIIMPORT
+#define JNICALL
+
+#endif  /* LGBT_FAKE_JNI_H_ */

@@ -98,6 +98,83 @@ def _launch_world(worker, data, tmp_path, attempt):
             fh.close()
 
 
+TRAIN_WORKER = textwrap.dedent(
+    """
+    import os, sys, json, hashlib
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    rank, world, port, data = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                               num_processes=world, process_id=rank)
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    sys.path.insert(0, "@REPO@")
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.dataset import construct_dataset
+    from lightgbm_tpu.ops.grow import grow_tree
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.parallel.data_parallel import grow_tree_data_parallel
+
+    raw = np.load(data)
+    X, y = raw["X"], raw["y"]
+    cfg = Config.from_params({"max_bin": 63, "objective": "binary"})
+    ds = construct_dataset(X, cfg, label=y.astype(np.float32))
+    F, N = ds.bins.shape
+    grad = (0.5 - y).astype(np.float32)
+    hess = np.full(N, 0.25, np.float32)
+    ones = np.ones(N, np.float32)
+    sp = SplitParams(0.0, 0.0, 0.0, 5, 1e-3, 0.0)
+    meta_np = ds.feature_meta_arrays()
+    kw = dict(num_leaves=15, max_depth=-1, num_bins=ds.max_num_bin, params=sp)
+
+    # ---- global 2-process mesh; every rank contributes its row shard ----
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    assert len(jax.devices()) == world and len(jax.local_devices()) == 1
+    row_s = NamedSharding(mesh, P("data"))
+    col_s = NamedSharding(mesh, P(None, "data"))
+    rep_s = NamedSharding(mesh, P())
+    shard = slice(rank * N // world, (rank + 1) * N // world)
+    bins_g = jax.make_array_from_process_local_data(col_s, np.asarray(ds.bins)[:, shard])
+    def row(a):
+        return jax.make_array_from_process_local_data(row_s, a[shard])
+    def rep(a):
+        return jax.make_array_from_process_local_data(rep_s, np.asarray(a))
+    meta_g = {k: rep(v) for k, v in meta_np.items()}
+    tree, leaf_id = grow_tree_data_parallel(
+        mesh, bins_g, row(grad), row(hess), row(ones),
+        rep(np.ones(F, bool)), meta_g, **kw,
+    )
+    tree_np = [np.asarray(x) for x in jax.device_get(tree)]
+    blob = json.dumps([t.tolist() for t in tree_np], sort_keys=True)
+    lid_local = np.asarray(
+        [s.data for s in leaf_id.addressable_shards][0]
+    )
+
+    # ---- single-process serial oracle on this rank's own device --------
+    meta_l = {k: jnp.asarray(v) for k, v in meta_np.items()}
+    tree_s, leaf_s = grow_tree(
+        jnp.asarray(ds.bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(ones), jnp.ones((F,), bool), meta_l, **kw,
+    )
+    blob_s = json.dumps(
+        [np.asarray(x).tolist() for x in jax.device_get(tree_s)], sort_keys=True
+    )
+    lid_match = bool(
+        (np.asarray(leaf_s)[shard] == lid_local).all()
+    )
+    print("RESULT " + json.dumps({
+        "rank": rank,
+        "digest_dp": hashlib.sha256(blob.encode()).hexdigest(),
+        "digest_serial": hashlib.sha256(blob_s.encode()).hexdigest(),
+        "num_leaves": int(tree_np[0]),
+        "leaf_id_match": lid_match,
+    }), flush=True)
+    """
+).replace("@REPO@", REPO)
+
+
 def test_two_process_mapper_exchange(tmp_path):
     rng = np.random.RandomState(0)
     X = rng.randn(2000, 5)
@@ -121,3 +198,34 @@ def test_two_process_mapper_exchange(tmp_path):
     )
     assert all(r["rows_mod_ok"] for r in results)
     assert sum(r["num_data"] for r in results) == 2000
+
+
+def test_two_process_data_parallel_training(tmp_path):
+    """grow_tree_data_parallel across TWO real jax.distributed processes
+    forming one global mesh: the tree must be identical on both ranks AND
+    identical to single-process serial growth — the in-anger multi-host
+    proof of the DP collective path (the analogue of training over
+    data_parallel_tree_learner.cpp:149-257 + linkers_socket.cpp:165-211;
+    here the cross-process psum rides jax.distributed's CPU collectives)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(2000, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    data = tmp_path / "mp_train.npz"
+    np.savez(data, X=X, y=y)
+    worker = tmp_path / "train_worker.py"
+    worker.write_text(TRAIN_WORKER)
+
+    results = None
+    for attempt in range(2):
+        results = _launch_world(worker, data, tmp_path, 10 + attempt)
+        if results is not None:
+            break
+    assert results is not None, "coordinator port bind failed twice"
+
+    r0, r1 = sorted(results, key=lambda r: r["rank"])
+    assert r0["digest_dp"] == r1["digest_dp"], "ranks grew different trees"
+    assert r0["digest_dp"] == r0["digest_serial"], (
+        "distributed tree differs from single-process serial"
+    )
+    assert r0["num_leaves"] > 2
+    assert r0["leaf_id_match"] and r1["leaf_id_match"]

@@ -59,3 +59,42 @@ def test_swig_generates_jni_binding(tmp_path):
     consts = (out / "lightgbmtpulibConstants.java").read_text()
     assert "C_API_PREDICT_CONTRIB" in consts
     assert "C_API_DTYPE_FLOAT64" in consts
+
+
+@pytest.mark.skipif(
+    shutil.which("swig") is None or shutil.which("g++") is None,
+    reason="swig/g++ not installed",
+)
+def test_swig_wrapper_compiles(tmp_path):
+    """The generated JNI C++ must COMPILE against lgbt_c_api.h (VERDICT r3
+    item 7). No JDK ships in this image, so <jni.h> is satisfied by the
+    compile-only stub in swig/jni_compile_stub/ — type errors between the
+    wrapper's marshalling code and the real C ABI header still fail here;
+    only the link step needs a real JDK. Java sources are additionally
+    compiled when a javac exists."""
+    out = tmp_path / "gen"
+    out.mkdir()
+    wrap = out / "lightgbm_tpu_wrap.cxx"
+    subprocess.run(
+        ["swig", "-java", "-c++", "-outdir", str(out), "-o", str(wrap), SWIG_I],
+        check=True, capture_output=True,
+    )
+    stub = os.path.join(REPO, "swig", "jni_compile_stub")
+    native = os.path.join(REPO, "lightgbm_tpu", "native")
+    r = subprocess.run(
+        [
+            "g++", "-std=c++17", "-c", str(wrap),
+            "-I", stub, "-I", native, "-I", os.path.join(REPO, "swig"),
+            "-o", str(out / "wrap.o"),
+        ],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert (out / "wrap.o").stat().st_size > 0
+    javac = shutil.which("javac")
+    if javac:  # pragma: no cover - image has no JDK
+        r = subprocess.run(
+            [javac, "-d", str(out)] + [str(p) for p in out.glob("*.java")],
+            capture_output=True, text=True,
+        )
+        assert r.returncode == 0, r.stderr[-3000:]
