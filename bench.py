@@ -437,7 +437,10 @@ def _run() -> None:
             # v5e-1: ~197 TFLOP/s bf16 / ~99 TFLOP/s f32 MXU, ~819 GB/s HBM
             peak_flops = 99e12 if platform in ("tpu", "axon") else 1e11
             peak_bw = 819e9 if platform in ("tpu", "axon") else 2e10
-            iter_s = 1.0 / max(iters_per_sec, 1e-9)
+            # MEASURED per-iteration time at the MEASURED n_rows — the
+            # scaled (1M-equivalent) rate would mismatch the tree's work
+            # model when the sliced CPU fallback ran (scaled != 1)
+            iter_s = bench_time / bench_iters
             mfu_estimate = round((hist_flops + scan_flops) / iter_s / peak_flops, 6)
             roofline = {
                 "hist_small_rows_per_iter": int(small_rows),

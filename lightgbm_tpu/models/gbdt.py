@@ -159,6 +159,9 @@ class GBDT:
         self._finish_fns = {}  # jitted renew+shrink+score-update steps per class
         self._pending_stop = None  # last iteration's device num_leaves scalars
         self._stopped = False
+        # variants with state-mutating _after_train_iter hooks set this False
+        # to run the no-split stop check synchronously (see train_one_iter)
+        self._defer_stop_check = type(self)._after_train_iter is GBDT._after_train_iter
         self._fmask_all = jnp.ones((self.train_set.num_features or 1,), bool)
         self.class_need_train = [
             self.objective.class_need_train(k) if self.objective is not None else True
@@ -467,7 +470,17 @@ class GBDT:
                     self.models.append(t)
                     self._device_trees.append((None, k))
 
-        if pending:
+        if pending and not self._defer_stop_check:
+            # boosting variants whose _after_train_iter mutates model state
+            # (DART's Normalize rescales dropped trees) cannot defer the
+            # stop check: rolling the iteration back later would leave that
+            # mutation behind. Pay the host sync here instead.
+            self._pending_stop = pending
+            self.iter_ += 1  # _consume_pending_stop un-counts it on stop
+            if self._consume_pending_stop():
+                return True
+            self.iter_ -= 1  # not stopped: recounted below
+        elif pending:
             self._pending_stop = pending
         else:
             # no class trained at all (e.g. zero usable features): the
@@ -530,29 +543,12 @@ class GBDT:
         dispatches) cost a device round-trip per op over the TPU tunnel;
         fusing makes the whole post-grow step a single async launch. The
         mask keeps a splitless tree's contribution at exactly zero so the
-        deferred stop check (train_one_iter) can run an iteration behind."""
-        obj = self.objective
-        renew = (
-            obj.renew_leaf_outputs_device
-            if (obj is not None and obj.is_renew_tree_output)
-            else None
-        )
-        use_bag = self._bagging_active
-        key = (k, renew is not None, use_bag)
+        deferred stop check (train_one_iter) can run an iteration behind.
+        Boosting variants customize only the step body + scalar via
+        _finish_step/_finish_scalar (rf.py)."""
+        key, step = self._finish_step(k)
         fn = self._finish_fns.get(key)
         if fn is None:
-            M = self.config.num_leaves
-
-            def step(scores, leaf_value, internal_value, lid, bag, nl, rate):
-                if renew is not None:
-                    leaf_value = renew(
-                        scores[k], lid, bag if use_bag else None, M, leaf_value
-                    )
-                leaf_value = jnp.where(nl > 1, leaf_value * rate, jnp.float32(0.0))
-                internal_value = internal_value * rate
-                scores = scores.at[k].add(leaf_value[lid])
-                return scores, leaf_value, internal_value
-
             fn = jax.jit(step, donate_argnums=(0,))
             self._finish_fns[key] = fn
         self.scores, leaf_value, internal_value = fn(
@@ -562,11 +558,37 @@ class GBDT:
             leaf_id,
             self._bag_mask,
             nl_dev,
-            np.float32(self.shrinkage_rate),
+            self._finish_scalar(k),
         )
         return tree_arrays._replace(
             leaf_value=leaf_value, internal_value=internal_value
         )
+
+    def _finish_step(self, k: int):
+        """(cache key, step fn) for _finish_tree's jitted post-grow step."""
+        obj = self.objective
+        renew = (
+            obj.renew_leaf_outputs_device
+            if (obj is not None and obj.is_renew_tree_output)
+            else None
+        )
+        use_bag = self._bagging_active
+        M = self.config.num_leaves
+
+        def step(scores, leaf_value, internal_value, lid, bag, nl, rate):
+            if renew is not None:
+                leaf_value = renew(
+                    scores[k], lid, bag if use_bag else None, M, leaf_value
+                )
+            leaf_value = jnp.where(nl > 1, leaf_value * rate, jnp.float32(0.0))
+            internal_value = internal_value * rate
+            scores = scores.at[k].add(leaf_value[lid])
+            return scores, leaf_value, internal_value
+
+        return (k, renew is not None, use_bag), step
+
+    def _finish_scalar(self, k: int):
+        return np.float32(self.shrinkage_rate)
 
     def _train_tree(self, grad_k: jax.Array, hess_k: jax.Array):
         cfg = self.config
@@ -1002,6 +1024,9 @@ class GBDT:
         """RollbackOneIter (gbdt.cpp:415-431)."""
         if self.iter_ <= 0:
             return
+        # a pending deferred stop check refers to the iteration being rolled
+        # back — consuming it later would pop a SECOND (healthy) iteration
+        self._pending_stop = None
         K = self.num_tree_per_iteration
         for k in range(K):
             idx = len(self._device_trees) - K + k

@@ -56,15 +56,13 @@ class RandomForest(GBDT):
             grad, hess = grad[None, :], hess[None, :]
         return grad, hess
 
-    def _finish_tree(self, tree_arrays, leaf_id, class_id, nl_dev):
-        """RF's post-grow step (one jitted dispatch, like the base class):
-        renew at the CONSTANT init score (not the accumulated sum — RF
-        gradients always start from it, rf.hpp:82-103), no shrinkage, and
-        the init bias folded into every tree's leaves (rf.hpp:139-143) so
-        the averaged output keeps it. The num_leaves mask preserves the
-        deferred no-split stop contract of train_one_iter."""
-        import jax
-
+    def _finish_step(self, k):
+        """RF's post-grow step body (the jit/donate/dispatch scaffolding
+        lives in GBDT._finish_tree): renew at the CONSTANT init score — not
+        the accumulated sum, RF gradients always start from it
+        (rf.hpp:82-103) — no shrinkage, and the init bias folded into every
+        tree's leaves (rf.hpp:139-143) so the averaged output keeps it. The
+        num_leaves mask preserves the deferred no-split stop contract."""
         obj = self.objective
         renew = (
             obj.renew_leaf_outputs_device
@@ -72,42 +70,22 @@ class RandomForest(GBDT):
             else None
         )
         use_bag = self._bagging_active
-        key = ("rf", class_id, renew is not None, use_bag)
-        fn = self._finish_fns.get(key)
-        if fn is None:
-            M = self.config.num_leaves
-            k = class_id
+        M = self.config.num_leaves
 
-            def step(scores, leaf_value, internal_value, lid, bag, nl, init_s):
-                if renew is not None:
-                    const_score = jnp.full(
-                        scores.shape[1:], 0.0, jnp.float32
-                    ) + init_s
-                    leaf_value = renew(
-                        const_score, lid, bag if use_bag else None, M,
-                        leaf_value,
-                    )
-                leaf_value = jnp.where(
-                    nl > 1, leaf_value + init_s, jnp.float32(0.0)
+        def step(scores, leaf_value, internal_value, lid, bag, nl, init_s):
+            if renew is not None:
+                const_score = jnp.full(scores.shape[1:], 0.0, jnp.float32) + init_s
+                leaf_value = renew(
+                    const_score, lid, bag if use_bag else None, M, leaf_value
                 )
-                scores = scores.at[k].add(leaf_value[lid])
-                return scores, leaf_value, internal_value
+            leaf_value = jnp.where(nl > 1, leaf_value + init_s, jnp.float32(0.0))
+            scores = scores.at[k].add(leaf_value[lid])
+            return scores, leaf_value, internal_value
 
-            fn = jax.jit(step, donate_argnums=(0,))
-            self._finish_fns[key] = fn
-        init = float(self._rf_init()[class_id])
-        self.scores, leaf_value, internal_value = fn(
-            self.scores,
-            tree_arrays.leaf_value,
-            tree_arrays.internal_value,
-            leaf_id,
-            self._bag_mask,
-            nl_dev,
-            np.float32(init),
-        )
-        return tree_arrays._replace(
-            leaf_value=leaf_value, internal_value=internal_value
-        )
+        return ("rf", k, renew is not None, use_bag), step
+
+    def _finish_scalar(self, k):
+        return np.float32(float(self._rf_init()[k]))
 
     # scores hold the SUM of tree outputs; metrics see the average
     def _train_score_np(self):

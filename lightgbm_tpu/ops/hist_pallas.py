@@ -312,6 +312,115 @@ def histogram_pallas_v1(
     return hist[:, :B, :]
 
 
+def _kernel_p4(bins_ref, vt_ref, out_ref, *, num_bins: int, dtype):
+    """Nibble-packed kernel body (measurement for the 4-bit-bin question,
+    dense_nbits_bin.hpp:42): each u8 carries TWO rows' bins (even | odd<<4),
+    halving the bin-matrix HBM stream; the values block carries the two
+    rows' channels stacked ([2K, C2]). B <= 16 needs no radix split — one
+    one-hot dot per half: [K, C2] @ [C2, B]."""
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    vt = vt_ref[:].astype(dtype)  # [2K, C2]
+    k2, C2 = vt.shape
+    k_n = k2 // 2
+    b_all = bins_ref[:, :].astype(jnp.int32)  # [FB, C2]
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (C2, num_bins), 1)
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+    for j in range(FB):
+        b_even = b_all[j] & 15
+        b_odd = b_all[j] >> 4
+        oh_e = (b_even[:, None] == b_iota).astype(dtype)  # [C2, B]
+        oh_o = (b_odd[:, None] == b_iota).astype(dtype)
+        out_ref[j] += jax.lax.dot_general(
+            vt[:k_n], oh_e, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        ) + jax.lax.dot_general(
+            vt[k_n:], oh_o, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+
+
+def pack4(bins, values):
+    """Pack [F, N] u8 bins (all < 16) + [N, K] values into the nibble layout
+    histogram_pallas_packed4 consumes: ([F, N/2] u8, [N/2, 2K] f32)."""
+    F, N = bins.shape
+    if N % 2:
+        bins = jnp.pad(bins, ((0, 0), (0, 1)))
+        values = jnp.pad(values, ((0, 1), (0, 0)))
+        N += 1
+    even = bins[:, 0::2].astype(jnp.uint8)
+    odd = bins[:, 1::2].astype(jnp.uint8)
+    packed = even | (odd << 4)
+    K = values.shape[1]
+    v2 = jnp.concatenate([values[0::2], values[1::2]], axis=1)  # [N/2, 2K]
+    return packed, v2
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "chunk", "dtype_name", "interpret")
+)
+def histogram_pallas_packed4(
+    bins_packed: jax.Array,  # [F, N2] u8: two 4-bit bins per byte
+    values_packed: jax.Array,  # [N2, 2K] f32 (even rows' K ++ odd rows' K)
+    num_bins: int,
+    chunk: int = 8192,
+    dtype_name: str = "float32",
+    interpret: bool = False,
+) -> jax.Array:
+    """[F, B, K] f32 histogram from nibble-packed bins (B <= 16)."""
+    if num_bins > 16:
+        raise ValueError("packed4 kernel requires num_bins <= 16")
+    F, N2 = bins_packed.shape
+    K2 = values_packed.shape[1]
+    K = K2 // 2
+    dtype = jnp.dtype(dtype_name)
+    # VMEM footprint cap, same discipline as _max_chunk_fb: blocks (bins,
+    # values, both double-buffered) + b_all i32 + bin iota + two one-hots
+    # (+ f32 HIGHEST operand shadows) per packed column
+    d = jnp.dtype(dtype).itemsize
+    per_col = (
+        2 * FB + 2 * 4 * K2 + 4 * FB + 4 * num_bins
+        + d * (2 * num_bins + K2)
+        + (2 * 2 * (num_bins + K) if d == 4 else 0)
+    )
+    C = min(max(chunk, 512), max(512, N2), max(512, _VMEM_BUDGET // per_col))
+    C = max(512, (C // 512) * 512)
+    if N2 % C != 0:
+        pad = (-N2) % C
+        bins_packed = jnp.pad(bins_packed, ((0, 0), (0, pad)))
+        values_packed = jnp.pad(values_packed, ((0, pad), (0, 0)))
+        N2 += pad
+    n_chunks = N2 // C
+    Fp = -(-F // FB) * FB
+    if Fp != F:
+        bins_packed = jnp.pad(bins_packed, ((0, Fp - F), (0, 0)))
+
+    vt = values_packed.T  # [2K, N2]
+    kernel = functools.partial(_kernel_p4, num_bins=num_bins, dtype=dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Fp // FB, n_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f8, c: (f8, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K2, C), lambda f8, c: (0, c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (FB, K, num_bins), lambda f8, c: (f8, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((Fp, K, num_bins), jnp.float32),
+        interpret=interpret,
+    )(bins_packed, vt)
+    return out[:F].transpose(0, 2, 1)  # [F, B, K]
+
+
 def supported(
     num_bins: int, backend: Optional[str] = None, ignore_backend: bool = False
 ) -> bool:

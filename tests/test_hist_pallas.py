@@ -92,6 +92,24 @@ def test_feature_batched_many_features(rng):
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
 
 
+def test_packed4_matches_oracle(rng):
+    """Nibble-packed kernel (B <= 16) against the numpy oracle — the
+    measurement vehicle for the 4-bit-bin question (dense_nbits_bin.hpp)."""
+    from lightgbm_tpu.ops.hist_pallas import histogram_pallas_packed4, pack4
+
+    F, n, B = 9, 3001, 16  # odd n exercises the pad row
+    bins = rng.randint(0, B, (F, n)).astype(np.uint8)
+    vals = rng.randn(n, 3).astype(np.float32)
+    ref = histogram_reference(bins, vals, B)
+    bp, vp = pack4(jnp.asarray(bins), jnp.asarray(vals))
+    out = np.asarray(
+        histogram_pallas_packed4(
+            bp, vp, B, chunk=512, dtype_name="float32", interpret=True
+        )
+    )
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+
+
 def test_xla_fallback_selected_on_cpu(rng):
     # on the CPU test platform, impl="auto" must route to the XLA contraction
     assert not supported(256, backend="cpu")
