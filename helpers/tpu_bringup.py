@@ -42,6 +42,7 @@ STAGE_TIMEOUTS = {
     "pallas": 900,     # first Mosaic lowering can be slow
     "pack4": 900,      # nibble-packing measurement (VERDICT r3 item 8)
     "smoke": 1800,     # bucket-lattice switch compile at 100k rows
+    "smoke_xla": 1800,  # same smoke, XLA histogram impl (routing question)
     "bench": 3600,
 }
 
@@ -196,6 +197,20 @@ print(json.dumps({"ok": auc > 0.70, "first_iter_s": round(compile_s, 1),
 """ % (REPO, REPO)
 
 
+# same 100k training smoke with the XLA one-hot histogram impl instead of
+# the Pallas kernel: on-silicon r4 measurements had XLA at 16.8ms vs pallas
+# v1's 34.8ms for a full-N pass — this stage answers the routing question at
+# the real workload (iters_per_sec side by side with the 'smoke' stage)
+SMOKE_XLA = SMOKE.replace(
+    'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"',
+    'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"\n'
+    'os.environ["LIGHTGBM_TPU_HIST_IMPL"] = "xla"',
+)
+# .replace on an exact anchor: fail loudly if the anchor drifts, or this
+# stage would silently re-measure the Pallas impl under an "xla" label
+assert "LIGHTGBM_TPU_HIST_IMPL" in SMOKE_XLA
+
+
 def log_line(stage: str, payload: dict) -> None:
     with open(LOG, "a") as f:
         f.write(json.dumps({"t": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -271,7 +286,8 @@ def run_bench() -> dict:
 def main() -> int:
     summary = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {}}
     for stage, src in (("matmul", MATMUL), ("pallas", PALLAS),
-                       ("pack4", PACK4), ("smoke", SMOKE)):
+                       ("pack4", PACK4), ("smoke", SMOKE),
+                       ("smoke_xla", SMOKE_XLA)):
         print("bringup: stage %s ..." % stage, flush=True)
         result = run_stage(stage, src)
         summary["stages"][stage] = result

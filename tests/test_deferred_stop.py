@@ -1,0 +1,121 @@
+"""Deferred no-more-splits stop check (async training loop).
+
+The per-iteration int(num_leaves) host sync was removed in round 4: the
+stop check runs one call behind on an async-copied device scalar
+(gbdt.py train_one_iter docstring). These tests pin the reference-parity
+contract of that machinery (gbdt.cpp:375-431):
+
+ * a splitless iteration contributes exactly zero and is rolled back,
+ * first-iteration stops keep K constant trees carrying the init score,
+ * DART (state-mutating _after_train_iter) takes the synchronous path,
+ * rollback_one_iter clears a pending check (no double rollback),
+ * model output paths never leak placeholder trees.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _sep_data(n=1000, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] > 0).astype(float)
+    return X, y
+
+
+def test_first_iteration_stop_keeps_constant_tree():
+    """Impossible gain: training stops at iteration 0 with one constant
+    tree whose leaf carries the boost-from-average score."""
+    X, y = _sep_data()
+    y[:] = 0.0
+    y[:200] = 1.0
+    bst = lgb.train(
+        {"objective": "binary", "verbosity": -1, "min_gain_to_split": 1e9},
+        lgb.Dataset(X, label=y),
+        10,
+    )
+    assert bst.num_trees() == 1
+    np.testing.assert_allclose(bst.predict(X[:5]), 0.2, atol=1e-6)
+
+
+def test_mid_training_stop_rolls_back_splitless_iteration():
+    """A gain threshold the data outgrows: the final splitless iteration
+    must not appear in the model, and its score contribution is zero."""
+    X, y = _sep_data(seed=1)
+    bst = lgb.train(
+        {"objective": "binary", "verbosity": -1, "min_gain_to_split": 120.0},
+        lgb.Dataset(X, label=y),
+        50,
+    )
+    n = bst.num_trees()
+    assert 1 <= n < 50
+    # every kept tree really split (no 1-leaf placeholders leaked)
+    for t in bst._gbdt.trees():
+        assert t.num_leaves > 1
+    # model round-trips and predicts consistently after the rollback
+    clone = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(clone.predict(X), bst.predict(X))
+
+
+def test_manual_update_loop_past_stop():
+    """Booster.update() called past the stop keeps returning finished and
+    does not grow the model (the bench loop's calling pattern)."""
+    X, y = _sep_data(n=400, seed=2)
+    bst = lgb.Booster(
+        params={"objective": "binary", "verbosity": -1,
+                "min_gain_to_split": 1e9},
+        train_set=lgb.Dataset(X, label=y),
+    )
+    rets = [bst.update() for _ in range(5)]
+    assert True in rets  # stop reported (one call after the fact)
+    stop_at = rets.index(True)
+    assert all(rets[stop_at:]), "updates after the stop must keep reporting it"
+    assert bst.num_trees() == 1  # the kept constant tree only
+
+
+def test_rollback_clears_pending_stop():
+    """rollback_one_iter on a splitless iteration must not poison the next
+    update (a stale pending check would pop a healthy iteration)."""
+    X, y = _sep_data(n=600, seed=3)
+    gbdt = lgb.Booster(
+        params={"objective": "binary", "verbosity": -1,
+                "min_gain_to_split": 1e9},
+        train_set=lgb.Dataset(X, label=y),
+    )._gbdt
+    gbdt.train_one_iter()  # splitless; pending armed
+    gbdt.rollback_one_iter()
+    assert gbdt.current_iteration == 0
+    # next iteration trains from scratch without a spurious stop
+    assert gbdt.train_one_iter() is False
+    assert len(gbdt.models) == 1
+
+
+def test_dart_stop_is_synchronous():
+    """DART's _after_train_iter mutates dropped trees, so its no-split stop
+    cannot defer — the stop must land in the SAME call, before Normalize."""
+    X, y = _sep_data(n=500, seed=4)
+    bst = lgb.Booster(
+        params={"objective": "binary", "boosting": "dart", "verbosity": -1,
+                "min_gain_to_split": 1e9},
+        train_set=lgb.Dataset(X, label=y),
+    )
+    assert bst._gbdt._defer_stop_check is False
+    assert bst.update() is True  # immediate, not one call later
+    assert bst.num_trees() == 1
+
+
+def test_model_string_mid_training_excludes_pending_iteration():
+    """model_to_string between update() calls must not leak a pending
+    splitless iteration's placeholder trees."""
+    X, y = _sep_data(n=500, seed=5)
+    bst = lgb.Booster(
+        params={"objective": "binary", "verbosity": -1,
+                "min_gain_to_split": 1e9},
+        train_set=lgb.Dataset(X, label=y),
+    )
+    bst.update()  # splitless; stop still pending
+    s = bst.model_to_string()
+    assert "tree" in s and "Tree=0" in s  # the constant tree is serialized
+    clone = lgb.Booster(model_str=s)
+    assert clone.num_trees() == 1  # constant tree, no placeholders
