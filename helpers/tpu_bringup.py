@@ -43,6 +43,7 @@ STAGE_TIMEOUTS = {
     "pack4": 900,      # nibble-packing measurement (VERDICT r3 item 8)
     "smoke": 1800,     # bucket-lattice switch compile at 100k rows
     "smoke_xla": 1800,  # same smoke, XLA histogram impl (routing question)
+    "smoke_bf16": 1800,  # same smoke, bf16 MXU operands (AUC delta record)
     "bench": 3600,
 }
 
@@ -210,6 +211,15 @@ SMOKE_XLA = SMOKE.replace(
 # stage would silently re-measure the Pallas impl under an "xla" label
 assert "LIGHTGBM_TPU_HIST_IMPL" in SMOKE_XLA
 
+# bf16 MXU operands (the reference GPU path's single-precision trade,
+# GPU-Performance.rst:131-145): same smoke, records the AUC delta vs the
+# f32 'smoke' stage — the judged bf16-vs-f32 number (VERDICT r3 item 1)
+SMOKE_BF16 = SMOKE.replace(
+    '"learning_rate": 0.1,',
+    '"learning_rate": 0.1, "tpu_hist_dtype": "bfloat16",',
+)
+assert "bfloat16" in SMOKE_BF16
+
 
 def log_line(stage: str, payload: dict) -> None:
     with open(LOG, "a") as f:
@@ -287,7 +297,7 @@ def main() -> int:
     summary = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {}}
     for stage, src in (("matmul", MATMUL), ("pallas", PALLAS),
                        ("pack4", PACK4), ("smoke", SMOKE),
-                       ("smoke_xla", SMOKE_XLA)):
+                       ("smoke_xla", SMOKE_XLA), ("smoke_bf16", SMOKE_BF16)):
         print("bringup: stage %s ..." % stage, flush=True)
         result = run_stage(stage, src)
         summary["stages"][stage] = result
