@@ -643,7 +643,10 @@ def grow_tree(
         )
 
     # ---- root ----------------------------------------------------------
-    root_vals = masked_values(jnp.ones((N,), f32))
+    # with a bucketed partition the [N, 3] values tensor already exists
+    # (vals_all); masked_values(ones) would rebuild the identical array
+    # (ones * bag_mask == bag_mask) — ~6ms/tree on TPU at 1M
+    root_vals = vals_all if bucketed else masked_values(jnp.ones((N,), f32))
     root_hist = leaf_histogram(
         bins, root_vals, B_hist, chunk=chunk, axis_name=hist_axis,
         hist_dtype=hist_dtype, feature_sharded=feature_sharded,
