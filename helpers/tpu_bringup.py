@@ -44,6 +44,7 @@ STAGE_TIMEOUTS = {
     "smoke": 1800,     # bucket-lattice switch compile at 100k rows
     "smoke_xla": 1800,  # same smoke, XLA histogram impl (routing question)
     "smoke_bf16": 1800,  # same smoke, bf16 MXU operands (AUC delta record)
+    "smoke_psplit": 1800,  # opt-in Pallas split-scan kernel (first lowering)
     "bench": 3600,
 }
 
@@ -220,6 +221,16 @@ SMOKE_BF16 = SMOKE.replace(
 )
 assert "bfloat16" in SMOKE_BF16
 
+# single-launch Pallas split-scan kernel (ops/split_pallas.py, opt-in):
+# first Mosaic lowering AND its per-split fixed-cost effect, measured at
+# the same 100k workload
+SMOKE_PSPLIT = SMOKE.replace(
+    'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"',
+    'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"\n'
+    'os.environ["LIGHTGBM_TPU_SPLIT_IMPL"] = "pallas"',
+)
+assert "SPLIT_IMPL" in SMOKE_PSPLIT
+
 
 def log_line(stage: str, payload: dict) -> None:
     with open(LOG, "a") as f:
@@ -297,7 +308,8 @@ def main() -> int:
     summary = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {}}
     for stage, src in (("matmul", MATMUL), ("pallas", PALLAS),
                        ("pack4", PACK4), ("smoke", SMOKE),
-                       ("smoke_xla", SMOKE_XLA), ("smoke_bf16", SMOKE_BF16)):
+                       ("smoke_xla", SMOKE_XLA), ("smoke_bf16", SMOKE_BF16),
+                       ("smoke_psplit", SMOKE_PSPLIT)):
         print("bringup: stage %s ..." % stage, flush=True)
         result = run_stage(stage, src)
         summary["stages"][stage] = result

@@ -64,6 +64,11 @@ from .split import (
 # (first TPU contact). Unknown values fall back to the full lattice, loudly.
 _ENV_LATTICE = env_choice("LIGHTGBM_TPU_LATTICE", ("pow2", "coarse"))
 
+# Opt-in single-launch Pallas kernel for the two-child split scan
+# (ops/split_pallas.py) — experimental until its Mosaic lowering and timing
+# are measured on silicon (bringup smoke_psplit stage). Default: XLA scan.
+_ENV_SPLIT_IMPL = env_choice("LIGHTGBM_TPU_SPLIT_IMPL", ("pallas",))
+
 
 class TreeArrays(NamedTuple):
     """Flat-array decision tree (bin-space thresholds), mirroring tree.h:58-522."""
@@ -523,6 +528,17 @@ def grow_tree(
         the plain scan; custom split_fns stay unrolled (they may contain
         collectives, which don't vmap under shard_map)."""
         if split_fn is find_best_split:
+            if _ENV_SPLIT_IMPL == "pallas":
+                from .histogram import _default_backend
+                from .split_pallas import find_best_split_pair_pallas, supported
+
+                backend = _default_backend()
+                if supported(feature_meta, backend):
+                    return find_best_split_pair_pallas(
+                        hist2, sg2, sh2, nd2, mn2, mx2, feature_meta,
+                        feature_mask, params, two_way=two_way,
+                        interpret=backend != "tpu",
+                    )
             return jax.vmap(
                 lambda h, sg, sh, nd, mn, mx: find_best_split(
                     h, sg, sh, nd, mn, mx, feature_meta, feature_mask, params,

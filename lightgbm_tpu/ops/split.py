@@ -158,6 +158,63 @@ def _bin_prefix(contrib: jax.Array) -> jax.Array:
     return jnp.moveaxis(ys, 0, 1)
 
 
+def missing_flags(num_bin, missing):
+    """(multi_bin, use_na, skip_def, single_scan) per feature — the
+    missing-direction scan selectors shared by the XLA scan and the Pallas
+    split kernel (split_pallas.py)."""
+    multi_bin = num_bin > 2
+    use_na = (missing == MISSING_NAN) & multi_bin
+    skip_def = (missing == MISSING_ZERO) & multi_bin
+    return multi_bin, use_na, skip_def, ~(use_na | skip_def)
+
+
+def excluded_bins(bins, num_bin, default_bin, use_na, skip_def):
+    """[F, B] mask of bins excluded from explicit accumulation (padding,
+    the zero bin under missing=Zero, the NaN bin under missing=NaN)."""
+    nan_bin = (num_bin - 1)[:, None]
+    excl = bins >= num_bin[:, None]
+    excl |= skip_def[:, None] & (bins == default_bin[:, None])
+    excl |= use_na[:, None] & (bins == nan_bin)
+    return excl
+
+
+def candidate_gains(
+    lg, lh, rg, rh, lc, rc, valid, mono_b, min_c, max_c, min_gain_shift, p
+):
+    """Masked split gains for one scan direction. Broadcast-polymorphic:
+    the XLA scan calls it at [F, B] with scalar constraints, the Pallas
+    kernel at [2, F, B] with [2, 1, 1] constraints — all reference gates
+    (min_data/min_hess/monotone/min_gain, feature_histogram.hpp:91-650)
+    live HERE exactly once."""
+    ok = (
+        valid
+        & (lc >= p.min_data_in_leaf)
+        & (rc >= p.min_data_in_leaf)
+        & (lh >= p.min_sum_hessian_in_leaf)
+        & (rh >= p.min_sum_hessian_in_leaf)
+    )
+    lo = _leaf_output_constrained(lg, lh, p, min_c, max_c)
+    ro = _leaf_output_constrained(rg, rh, p, min_c, max_c)
+    g = _gain_given_output(lg, lh, lo, p) + _gain_given_output(rg, rh, ro, p)
+    mono_bad = ((mono_b > 0) & (lo > ro)) | ((mono_b < 0) & (lo < ro))
+    g = jnp.where(mono_bad, 0.0, g)
+    ok &= g > min_gain_shift
+    return jnp.where(ok, g, K_MIN_SCORE)
+
+
+def valid_pos_mask(thresholds, num_bin_b, default_bin_b, skip_def_b, not_single_b):
+    """dir=+1 candidate validity (runs only for missing-handling scans)."""
+    v = thresholds <= (num_bin_b - 2)
+    v &= ~(skip_def_b & (thresholds == default_bin_b))
+    return v & not_single_b
+
+
+def valid_neg_mask(thresholds, num_bin_b, default_bin_b, skip_def_b, use_na_b):
+    """dir=-1 candidate validity (excludes the NaN bin's threshold)."""
+    v = thresholds <= (num_bin_b - 2 - use_na_b.astype(jnp.int32))
+    return v & ~(skip_def_b & (thresholds == default_bin_b - 1))
+
+
 class _ScanOut(NamedTuple):
     """Per-feature best candidates + side-sum arrays for recovery."""
 
@@ -213,16 +270,10 @@ def _scan_candidates(
     gain_shift = leaf_split_gain(sum_grad, sum_hess_eff, p)
     min_gain_shift = gain_shift + p.min_gain_to_split
 
-    multi_bin = num_bin > 2
-    use_na = (missing == MISSING_NAN) & multi_bin  # [F]
-    skip_def = (missing == MISSING_ZERO) & multi_bin
-    single_scan = ~(use_na | skip_def)
+    multi_bin, use_na, skip_def, single_scan = missing_flags(num_bin, missing)
 
     bins = jnp.arange(B, dtype=jnp.int32)[None, :]  # [1, B]
-    nan_bin = (num_bin - 1)[:, None]
-    excl = (bins >= num_bin[:, None])
-    excl |= skip_def[:, None] & (bins == default_bin[:, None])
-    excl |= use_na[:, None] & (bins == nan_bin)
+    excl = excluded_bins(bins, num_bin, default_bin, use_na, skip_def)
     contrib = hist * (~excl)[:, :, None].astype(hist.dtype)  # [F, B, 3]
 
     prefix = _bin_prefix(contrib)
@@ -238,22 +289,10 @@ def _scan_candidates(
         return left_h, right_g, right_h, right_c
 
     def gains_for(left_g, left_h, right_g, right_h, left_c, right_c, valid):
-        ok = (
-            valid
-            & (left_c >= p.min_data_in_leaf)
-            & (right_c >= p.min_data_in_leaf)
-            & (left_h >= p.min_sum_hessian_in_leaf)
-            & (right_h >= p.min_sum_hessian_in_leaf)
+        return candidate_gains(
+            left_g, left_h, right_g, right_h, left_c, right_c, valid,
+            mono[:, None], min_constraint, max_constraint, min_gain_shift, p,
         )
-        lo = _leaf_output_constrained(left_g, left_h, p, min_constraint, max_constraint)
-        ro = _leaf_output_constrained(right_g, right_h, p, min_constraint, max_constraint)
-        g = _gain_given_output(left_g, left_h, lo, p) + _gain_given_output(
-            right_g, right_h, ro, p
-        )
-        mono_bad = ((mono[:, None] > 0) & (lo > ro)) | ((mono[:, None] < 0) & (lo < ro))
-        g = jnp.where(mono_bad, 0.0, g)
-        ok &= g > min_gain_shift
-        return jnp.where(ok, g, K_MIN_SCORE)
 
     # ---- dir = +1 (left-to-right; default_left = False) ------------------
     lg_pos = prefix[:, :, 0]
@@ -261,10 +300,10 @@ def _scan_candidates(
     lc_pos = prefix[:, :, 2]
     lh_pos, rg_pos, rh_pos, rc_pos = side_stats(lg_pos, lh_pos_raw, lc_pos)
     if two_way:
-        valid_pos = thresholds <= (num_bin[:, None] - 2)
-        valid_pos &= ~(skip_def[:, None] & (thresholds == default_bin[:, None]))
-        # dir=+1 runs only for the missing-handling scans
-        valid_pos &= (~single_scan)[:, None]
+        valid_pos = valid_pos_mask(
+            thresholds, num_bin[:, None], default_bin[:, None],
+            skip_def[:, None], (~single_scan)[:, None],
+        )
         gains_pos = gains_for(lg_pos, lh_pos, rg_pos, rh_pos, lc_pos, rc_pos, valid_pos)
     else:
         gains_pos = None  # every candidate would be masked invalid
@@ -277,8 +316,10 @@ def _scan_candidates(
     lg_neg = sum_grad - rg_neg_raw
     lh_neg = sum_hess_eff - rh_neg
     lc_neg = num_data - rc_neg
-    valid_neg = thresholds <= (num_bin[:, None] - 2 - use_na[:, None].astype(jnp.int32))
-    valid_neg &= ~(skip_def[:, None] & (thresholds == default_bin[:, None] - 1))
+    valid_neg = valid_neg_mask(
+        thresholds, num_bin[:, None], default_bin[:, None],
+        skip_def[:, None], use_na[:, None],
+    )
     gains_neg = gains_for(lg_neg, lh_neg, rg_neg_raw, rh_neg, lc_neg, rc_neg, valid_neg)
 
     # ---- categorical candidates -----------------------------------------
