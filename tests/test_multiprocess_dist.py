@@ -152,24 +152,47 @@ TRAIN_WORKER = textwrap.dedent(
         [s.data for s in leaf_id.addressable_shards][0]
     )
 
+    # ---- voting-parallel across the same two-process mesh --------------
+    # top_k >= F elects every feature; the elected-slice psum then equals
+    # the full data-parallel combine, so structure must match serial
+    # exactly (values to ULP: shard-local subtraction chains re-order f32)
+    from lightgbm_tpu.parallel.voting_parallel import grow_tree_voting_parallel
+    tree_vp, _ = grow_tree_voting_parallel(
+        mesh, bins_g, row(grad), row(hess), row(ones),
+        rep(np.ones(F, bool)), meta_g, top_k=F, **kw,
+    )
+    vp_np = [np.asarray(x) for x in jax.device_get(tree_vp)]
+
     # ---- single-process serial oracle on this rank's own device --------
     meta_l = {k: jnp.asarray(v) for k, v in meta_np.items()}
     tree_s, leaf_s = grow_tree(
         jnp.asarray(ds.bins), jnp.asarray(grad), jnp.asarray(hess),
         jnp.asarray(ones), jnp.ones((F,), bool), meta_l, **kw,
     )
-    blob_s = json.dumps(
-        [np.asarray(x).tolist() for x in jax.device_get(tree_s)], sort_keys=True
-    )
+    s_np = [np.asarray(x) for x in jax.device_get(tree_s)]
+    blob_s = json.dumps([t.tolist() for t in s_np], sort_keys=True)
     lid_match = bool(
         (np.asarray(leaf_s)[shard] == lid_local).all()
     )
+    # voting vs serial: structure exact, float fields to tolerance
+    fields = tree_s._fields
+    vp_struct_ok = True
+    vp_close_ok = True
+    for name, sv, vv in zip(fields, s_np, vp_np):
+        if sv.dtype.kind in "iub":
+            vp_struct_ok &= bool(np.array_equal(sv, vv))
+        else:
+            vp_close_ok &= bool(
+                np.allclose(sv, vv, rtol=2e-4, atol=1e-5)
+            )
     print("RESULT " + json.dumps({
         "rank": rank,
         "digest_dp": hashlib.sha256(blob.encode()).hexdigest(),
         "digest_serial": hashlib.sha256(blob_s.encode()).hexdigest(),
         "num_leaves": int(tree_np[0]),
         "leaf_id_match": lid_match,
+        "vp_struct_ok": vp_struct_ok,
+        "vp_close_ok": vp_close_ok,
     }), flush=True)
     """
 ).replace("@REPO@", REPO)
@@ -229,3 +252,9 @@ def test_two_process_data_parallel_training(tmp_path):
     )
     assert r0["num_leaves"] > 2
     assert r0["leaf_id_match"] and r1["leaf_id_match"]
+    # voting-parallel over the same two-process mesh (top_k = F): identical
+    # structure to serial, float fields to ULP tolerance
+    assert r0["vp_struct_ok"] and r1["vp_struct_ok"], (
+        "multi-process voting tree structure differs from serial"
+    )
+    assert r0["vp_close_ok"] and r1["vp_close_ok"]

@@ -96,7 +96,6 @@ def test_pair_kernel_matches_xla_scan(seed, pi):
 def test_env_routed_training_matches_default(monkeypatch):
     """End-to-end: a grower with LIGHTGBM_TPU_SPLIT_IMPL=pallas (interpret on
     CPU) must train the same model as the XLA scan on tie-free data."""
-    import importlib
     import lightgbm_tpu.ops.grow as grow_mod
 
     rng = np.random.RandomState(7)
