@@ -43,6 +43,7 @@ STAGE_TIMEOUTS = {
     "pack4": 900,      # nibble-packing measurement (VERDICT r3 item 8)
     "smoke": 1800,     # bucket-lattice switch compile at 100k rows
     "smoke_xla": 1800,  # same smoke, XLA histogram impl (routing question)
+    "smoke_xla_radix": 1800,  # same smoke, plain-XLA radix factorization
     "smoke_bf16": 1800,  # same smoke, bf16 MXU operands (AUC delta record)
     "smoke_psplit": 1800,  # opt-in Pallas split-scan kernel (first lowering)
     "bench": 3600,
@@ -124,6 +125,9 @@ res["v1_per_call_ms"] = timeloop(
 from lightgbm_tpu.ops.histogram import leaf_histogram
 res["xla_per_call_ms"] = timeloop(
     lambda i: leaf_histogram(bins, vals * scales[i], B, impl="xla"), scales)
+res["xla_radix_per_call_ms"] = timeloop(
+    lambda i: leaf_histogram(bins, vals * scales[i], B, impl="xla_radix"),
+    scales)
 # f32 accumulates in chunk order: 1e-4 rel absorbs summation-order ULP at
 # 2^18 rows (measured 1.8e-5 on first contact); bf16 rounds operands to
 # ~2^-8 — record it, gate loosely, judge by the smoke AUC
@@ -208,6 +212,13 @@ SMOKE_XLA = SMOKE.replace(
     'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"\n'
     'os.environ["LIGHTGBM_TPU_HIST_IMPL"] = "xla"',
 )
+
+SMOKE_XLA_RADIX = SMOKE.replace(
+    'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"',
+    'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"\n'
+    'os.environ["LIGHTGBM_TPU_HIST_IMPL"] = "xla_radix"',
+)
+assert "xla_radix" in SMOKE_XLA_RADIX
 # .replace on an exact anchor: fail loudly if the anchor drifts, or this
 # stage would silently re-measure the Pallas impl under an "xla" label
 assert "LIGHTGBM_TPU_HIST_IMPL" in SMOKE_XLA
@@ -308,7 +319,9 @@ def main() -> int:
     summary = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {}}
     for stage, src in (("matmul", MATMUL), ("pallas", PALLAS),
                        ("pack4", PACK4), ("smoke", SMOKE),
-                       ("smoke_xla", SMOKE_XLA), ("smoke_bf16", SMOKE_BF16),
+                       ("smoke_xla", SMOKE_XLA),
+                       ("smoke_xla_radix", SMOKE_XLA_RADIX),
+                       ("smoke_bf16", SMOKE_BF16),
                        ("smoke_psplit", SMOKE_PSPLIT)):
         print("bringup: stage %s ..." % stage, flush=True)
         result = run_stage(stage, src)

@@ -30,6 +30,14 @@ import numpy as np
 from . import hist_pallas
 
 
+def _combine(hist, axis_name):
+    """Shared cross-shard combine tail of every leaf_histogram impl — the
+    data-parallel ReduceScatter analogue lives in exactly one place."""
+    if axis_name is not None:
+        hist = jax.lax.psum(hist, axis_name)
+    return hist
+
+
 def _default_backend() -> str:
     try:
         return jax.default_backend()
@@ -45,7 +53,9 @@ def _default_backend() -> str:
 # reason).
 from ..utils.platform import env_choice
 
-_ENV_IMPL = env_choice("LIGHTGBM_TPU_HIST_IMPL", ("xla", "scatter", "pallas"))
+_ENV_IMPL = env_choice(
+    "LIGHTGBM_TPU_HIST_IMPL", ("xla", "xla_radix", "scatter", "pallas")
+)
 
 
 def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:
@@ -86,8 +96,9 @@ def leaf_histogram(
         ReduceScatter path of data_parallel_tree_learner.cpp:161 collapsed into
         one XLA collective).
       impl: "auto" (pallas on TPU, chunked scatter-add on CPU, one-hot
-        contraction elsewhere), "pallas", "scatter", or "xla" (the one-hot
-        contraction — also the differential oracle for the other two).
+        contraction elsewhere), "pallas", "scatter", "xla" (the one-hot
+        contraction — also the differential oracle for the others), or
+        "xla_radix" (the radix factorization in plain XLA).
       hist_dtype: MXU operand dtype for the pallas kernel — "float32" (exact,
         matches the XLA fallback) or "bfloat16" (rounds grad/hess operands;
         accumulation stays f32 — the reference GPU path's single-precision
@@ -114,9 +125,7 @@ def leaf_histogram(
         hist = hist_pallas.histogram_pallas(
             bins, values, num_bins, chunk=max(chunk, 512), dtype_name=hist_dtype
         )
-        if axis_name is not None:
-            hist = jax.lax.psum(hist, axis_name)
-        return hist
+        return _combine(hist, axis_name)
     if impl == "scatter" or (impl == "auto" and _default_backend() == "cpu"):
         # CPU: a scatter-add is the dense_bin.hpp:71 loop XLA can actually run
         # well — F*N adds instead of the one-hot contraction's 2*F*N*B flops
@@ -161,9 +170,7 @@ def leaf_histogram(
             init = jnp.zeros((F * num_bins, K), jnp.float32)
             hist, _ = jax.lax.scan(body, init, (bins_c, vals_c))
             hist = hist.reshape(F, num_bins, K)
-        if axis_name is not None:
-            hist = jax.lax.psum(hist, axis_name)
-        return hist
+        return _combine(hist, axis_name)
     F, N = bins.shape
     K = values.shape[1]
     B = num_bins
@@ -177,6 +184,45 @@ def leaf_histogram(
 
     bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
     vals_c = values.reshape(n_chunks, C, K)  # [n, C, K]
+
+    if impl == "xla_radix":
+        # The Pallas kernel's radix factorization (hist_pallas.py module
+        # banner) expressed in plain XLA for the routing bake-off: the
+        # [F, C, B] one-hot operand shrinks to [F, C, LO*K] (x) [F, C, HI],
+        # an ~8x better MXU row fill and ~5x less one-hot build work, with
+        # XLA free to fuse/layout. Same default-precision behavior as the
+        # plain one-hot contraction below (bf16 operand rounding on TPU).
+        LO = 8
+        HI = -(-B // LO)
+        lo_iota = jnp.arange(LO, dtype=jnp.int32)
+        hi_iota = jnp.arange(HI, dtype=jnp.int32)
+
+        def body_rx(acc, inputs):
+            b, v = inputs  # [F, C], [C, K]
+            bi = b.astype(jnp.int32)
+            hi = bi // LO
+            lo = bi - hi * LO
+            oh_lo = (lo[:, :, None] == lo_iota[None, None, :]).astype(jnp.float32)
+            lhs = (oh_lo[:, :, :, None] * v[None, :, None, :]).reshape(
+                F, C, LO * K
+            )
+            oh_hi = (hi[:, :, None] == hi_iota[None, None, :]).astype(jnp.float32)
+            part = jax.lax.dot_general(
+                lhs, oh_hi,
+                dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+            )  # [F, LO*K, HI]
+            return acc + part, None
+
+        init = jnp.zeros((F, LO * K, HI), jnp.float32)
+        out, _ = jax.lax.scan(body_rx, init, (bins_c, vals_c))
+        # out[f, lo*K + k, hi] -> hist[f, hi*LO + lo, k]
+        hist = (
+            out.reshape(F, LO, K, HI)
+            .transpose(0, 3, 1, 2)
+            .reshape(F, HI * LO, K)[:, :B, :]
+        )
+        return _combine(hist, axis_name)
 
     iota = jnp.arange(B, dtype=jnp.int32)
 
@@ -195,9 +241,7 @@ def leaf_histogram(
 
     init = jnp.zeros((F, B, K), dtype=jnp.float32)
     hist, _ = jax.lax.scan(body, init, (bins_c, vals_c))
-    if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name)
-    return hist
+    return _combine(hist, axis_name)
 
 
 def leaf_values(

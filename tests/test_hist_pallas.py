@@ -110,6 +110,26 @@ def test_packed4_matches_oracle(rng):
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
 
 
+@pytest.mark.parametrize("num_bins", [16, 63, 255])
+def test_xla_radix_matches_oracle(rng, num_bins):
+    """The plain-XLA radix factorization against the numpy oracle and the
+    one-hot contraction (the routing bake-off's third contender)."""
+    F, n = 6, 3000
+    bins = rng.randint(0, num_bins, (F, n)).astype(np.uint8)
+    vals = rng.randn(n, 3).astype(np.float32)
+    ref = histogram_reference(bins, vals, num_bins)
+    out = np.asarray(
+        leaf_histogram(jnp.asarray(bins), jnp.asarray(vals), num_bins,
+                       impl="xla_radix", chunk=512)
+    )
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+    base = np.asarray(
+        leaf_histogram(jnp.asarray(bins), jnp.asarray(vals), num_bins,
+                       impl="xla", chunk=512)
+    )
+    np.testing.assert_allclose(out, base, rtol=1e-6, atol=1e-5)
+
+
 def test_xla_fallback_selected_on_cpu(rng):
     # on the CPU test platform, impl="auto" must route to the XLA contraction
     assert not supported(256, backend="cpu")
