@@ -174,16 +174,6 @@ def leaf_histogram(
     F, N = bins.shape
     K = values.shape[1]
     B = num_bins
-    C = _pick_chunk(F, B, chunk)
-    if N % C != 0:
-        pad = (-N) % C
-        bins = jnp.pad(bins, ((0, 0), (0, pad)))
-        values = jnp.pad(values, ((0, pad), (0, 0)))
-        N += pad
-    n_chunks = N // C
-
-    bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
-    vals_c = values.reshape(n_chunks, C, K)  # [n, C, K]
 
     if impl == "xla_radix":
         # The Pallas kernel's radix factorization (hist_pallas.py module
@@ -194,6 +184,18 @@ def leaf_histogram(
         # plain one-hot contraction below (bf16 operand rounding on TPU).
         LO = 8
         HI = -(-B // LO)
+        # chunk sized for THIS path's transients ([F, C, LO*K+HI], not the
+        # one-hot's [F, C, B]) — the B-based budget would undersize C ~4x
+        # and handicap the very contender this branch exists to race
+        C = _pick_chunk(F, LO * K + HI, chunk)
+        if N % C != 0:
+            pad = (-N) % C
+            bins = jnp.pad(bins, ((0, 0), (0, pad)))
+            values = jnp.pad(values, ((0, pad), (0, 0)))
+            N += pad
+        n_chunks = N // C
+        bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
+        vals_c = values.reshape(n_chunks, C, K)  # [n, C, K]
         lo_iota = jnp.arange(LO, dtype=jnp.int32)
         hi_iota = jnp.arange(HI, dtype=jnp.int32)
 
@@ -223,6 +225,17 @@ def leaf_histogram(
             .reshape(F, HI * LO, K)[:, :B, :]
         )
         return _combine(hist, axis_name)
+
+    C = _pick_chunk(F, B, chunk)
+    if N % C != 0:
+        pad = (-N) % C
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        values = jnp.pad(values, ((0, pad), (0, 0)))
+        N += pad
+    n_chunks = N // C
+
+    bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
+    vals_c = values.reshape(n_chunks, C, K)  # [n, C, K]
 
     iota = jnp.arange(B, dtype=jnp.int32)
 
