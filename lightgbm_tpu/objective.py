@@ -28,6 +28,16 @@ from .utils import log
 K_EPSILON = 1e-15
 
 
+def _stable_expish(fn):
+    """Run an exp-based host-side output converter with numpy's overflow
+    warning suppressed: the reference's C++ converters compute the same
+    expressions where overflow silently saturates to +inf (e.g. sigmoid
+    1/(1+exp(-kx)) -> 0, exp(x) -> inf) — values are bit-identical either
+    way, errstate only drops the warning noise."""
+    with np.errstate(over="ignore"):
+        return fn()
+
+
 # ---------------------------------------------------------------------------
 # percentile helpers (regression_objective.hpp:18-75, replicated exactly)
 # ---------------------------------------------------------------------------
@@ -393,7 +403,7 @@ class RegressionPoissonLoss(RegressionL2Loss):
         return self._apply_weight(grad, hess)
 
     def convert_output(self, scores):
-        return np.exp(scores)
+        return _stable_expish(lambda: np.exp(scores))
 
     def boost_from_score(self, class_id=0):
         mean = RegressionL2Loss.boost_from_score(self, class_id)
@@ -566,7 +576,7 @@ class BinaryLogloss(ObjectiveFunction):
         return self.need_train
 
     def convert_output(self, scores):
-        return 1.0 / (1.0 + np.exp(-self.sigmoid * scores))
+        return _stable_expish(lambda: 1.0 / (1.0 + np.exp(-self.sigmoid * scores)))
 
     def to_string(self):
         return "binary sigmoid:%g" % self.sigmoid
@@ -665,7 +675,7 @@ class MulticlassOVA(ObjectiveFunction):
         return self._binary[class_id].need_train
 
     def convert_output(self, scores):
-        return 1.0 / (1.0 + np.exp(-self.sigmoid * scores))
+        return _stable_expish(lambda: 1.0 / (1.0 + np.exp(-self.sigmoid * scores)))
 
     @property
     def num_model_per_iteration(self):
@@ -713,7 +723,7 @@ class CrossEntropy(ObjectiveFunction):
         return math.log(pavg / (1.0 - pavg))
 
     def convert_output(self, scores):
-        return 1.0 / (1.0 + np.exp(-scores))
+        return _stable_expish(lambda: 1.0 / (1.0 + np.exp(-scores)))
 
 
 class CrossEntropyLambda(ObjectiveFunction):
@@ -752,7 +762,7 @@ class CrossEntropyLambda(ObjectiveFunction):
         return math.log(pavg / (1.0 - pavg))
 
     def convert_output(self, scores):
-        return np.log1p(np.exp(scores))
+        return _stable_expish(lambda: np.log1p(np.exp(scores)))
 
 
 # ---------------------------------------------------------------------------
