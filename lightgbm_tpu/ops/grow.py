@@ -28,6 +28,9 @@ Differences from the reference are architectural, not semantic:
    coupled/lazy feature penalties the grower re-scans every leaf per iteration
    (the reference instead patches its cached splits_per_leaf_,
    serial_tree_learner.cpp:757-775 — same fixpoint, different mechanics).
+   Under a histogram pool only slot-RESIDENT leaves rescan; evicted leaves
+   keep their cached candidate with the reference's coupled-gain patch.
+   Custom split searches (voting) supply a batched ``cegb_rescan`` hook.
  * With ``axis_name`` set (under shard_map), rows are sharded across the mesh and
    the histogram/root sums are combined with psum — the data-parallel learner's
    dataflow (data_parallel_tree_learner.cpp:149-257) collapsed onto XLA collectives.
