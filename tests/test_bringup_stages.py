@@ -1,0 +1,55 @@
+"""Static health checks for the TPU bring-up stage scripts.
+
+helpers/tpu_bringup.py builds its measurement stages as source strings
+(some via anchored .replace surgery); chip windows are rare, so a stage
+that fails to parse — or a replace anchor that silently stopped matching —
+must be caught here, not at first contact.
+"""
+import ast
+
+import helpers.tpu_bringup as tb
+
+
+STAGES = (
+    "MATMUL", "PALLAS", "PACK4", "SMOKE", "SMOKE_XLA", "SMOKE_XLA_RADIX",
+    "SMOKE_BF16", "SMOKE_PSPLIT",
+)
+
+
+def test_every_stage_parses():
+    for name in STAGES:
+        ast.parse(getattr(tb, name))
+
+
+def test_stage_table_complete():
+    """Every stage run by main() has a timeout entry, and vice versa."""
+    assert set(tb.STAGE_TIMEOUTS) == {
+        "matmul", "pallas", "pack4", "smoke", "smoke_xla", "smoke_xla_radix",
+        "smoke_bf16", "smoke_psplit", "bench",
+    }
+
+
+def test_replace_anchors_took_effect():
+    """The derived smoke variants must really differ from SMOKE in the way
+    their env overrides promise (a drifted anchor silently no-ops)."""
+    assert 'LIGHTGBM_TPU_HIST_IMPL"] = "xla"' in tb.SMOKE_XLA
+    assert 'LIGHTGBM_TPU_HIST_IMPL"] = "xla_radix"' in tb.SMOKE_XLA_RADIX
+    assert '"tpu_hist_dtype": "bfloat16"' in tb.SMOKE_BF16
+    assert 'LIGHTGBM_TPU_SPLIT_IMPL"] = "pallas"' in tb.SMOKE_PSPLIT
+    for derived in (tb.SMOKE_XLA, tb.SMOKE_XLA_RADIX, tb.SMOKE_BF16,
+                    tb.SMOKE_PSPLIT):
+        assert derived != tb.SMOKE
+
+
+def test_env_overrides_precede_import():
+    """The env knobs are read at lightgbm_tpu import time (env_choice), so
+    each stage must set them BEFORE the import line."""
+    for src in (tb.SMOKE_XLA, tb.SMOKE_XLA_RADIX, tb.SMOKE_PSPLIT):
+        assert src.index("os.environ[") < src.index("import lightgbm_tpu")
+
+
+def test_timeloop_protocol_in_common():
+    """The single-fetch timing protocol lives once, in the shared prelude."""
+    assert "def timeloop" in tb._COMMON
+    # 2 uses of the trailing-fetch idiom inside timeloop itself
+    assert tb._COMMON.count("float(jnp.ravel(acc)[0])") == 2
