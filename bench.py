@@ -394,6 +394,7 @@ def _run() -> None:
     # Phases from 3 extra TIMETAG'd iterations (TIMETAG serializes phases
     # with blocking waits, so it runs OUTSIDE the headline timing loop).
     phases = {}
+    phases_error = None
     try:
         gbdt = booster._gbdt
         gbdt.timers.enabled = True
@@ -401,9 +402,15 @@ def _run() -> None:
         gbdt.timers.counts.clear()
         for _ in range(3):
             booster.update()
+        # close the async pipeline before reading the timers (same
+        # block-can-lie caveat as the headline loop)
+        float(np.asarray(jax.numpy.ravel(booster._gbdt.scores)[0]))
         phases = {k: round(v / 3, 4) for k, v in gbdt.timers.seconds.items()}
         gbdt.timers.enabled = False
     except Exception as e:
+        # surface the failure in the emitted JSON — the r4 TPU capture lost
+        # its phase row silently and the artifact read as "never instrumented"
+        phases_error = "%s: %s" % (type(e).__name__, str(e)[:200])
         print("bench: phase breakdown failed: %s" % e, file=sys.stderr)
     # Work model per boosting iteration, from the actually-grown trees:
     # histogram rows = sum over splits of the smaller child (subtraction
@@ -434,9 +441,20 @@ def _run() -> None:
             hist_flops = small_rows * F * K * 2
             scan_flops = nsplit * 2 * F * Bn * 20  # two-direction cumsum scans
             hist_bytes = small_rows * (F + K * 4) + n_rows * (F + 8)
-            # v5e-1: ~197 TFLOP/s bf16 / ~99 TFLOP/s f32 MXU, ~819 GB/s HBM
-            peak_flops = 99e12 if platform in ("tpu", "axon") else 1e11
-            peak_bw = 819e9 if platform in ("tpu", "axon") else 2e10
+            # v5e-1: ~197 TFLOP/s bf16 / ~99 TFLOP/s f32 MXU, ~819 GB/s HBM.
+            # The chip the constants assume is labeled in the JSON
+            # (roofline_chip) — on another TPU generation the utilization
+            # numbers would be vs the WRONG peak (ADVICE r4).
+            if platform in ("tpu", "axon"):
+                peak_flops, peak_bw = 99e12, 819e9
+                try:
+                    kind = jax.devices()[0].device_kind
+                except Exception:
+                    kind = "unknown"
+                roofline_chip = "v5e-1 (assumed; device_kind=%s)" % kind
+            else:
+                peak_flops, peak_bw = 1e11, 2e10
+                roofline_chip = "cpu-nominal"
             # MEASURED per-iteration time at the MEASURED n_rows — the
             # scaled (1M-equivalent) rate would mismatch the tree's work
             # model when the sliced CPU fallback ran (scaled != 1)
@@ -447,13 +465,39 @@ def _run() -> None:
                 "model_flops_per_iter": float(hist_flops + scan_flops),
                 "model_bytes_per_iter": float(hist_bytes),
                 "hbm_utilization": round(hist_bytes / iter_s / peak_bw, 4),
+                "roofline_chip": roofline_chip,
             }
     except Exception as e:
         print("bench: roofline model failed: %s" % e, file=sys.stderr)
 
     extra = {"platform": platform, "train_auc": round(float(auc), 6)}
+    if platform not in ("tpu", "axon"):
+        # the relay dies unpredictably; a CPU-fallback capture must still
+        # carry the last REAL on-chip record (clearly labeled, never promoted
+        # into the headline value) so the driver artifact can't read as
+        # "no TPU has ever run" during a relay outage (VERDICT r4 item 2)
+        try:
+            tpu_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU.json"
+            )
+            with open(tpu_path) as f:
+                last = json.load(f)
+            if last.get("platform") in ("tpu", "axon"):
+                # prefer the in-file stamp (mtime lies after a git checkout)
+                last["recorded_at"] = last.pop("t", None) or time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(os.path.getmtime(tpu_path))
+                )
+                last["note"] = (
+                    "last on-chip result (relay down at capture time); "
+                    "headline value above is the CPU fallback"
+                )
+                extra["last_tpu"] = last
+        except Exception:
+            pass
     if phases:
         extra["phases_s"] = phases
+    elif phases_error:
+        extra["phases_error"] = phases_error
     if mfu_estimate is not None:
         extra["mfu_estimate"] = mfu_estimate
         extra.update(roofline)

@@ -310,7 +310,8 @@ def run_bench() -> dict:
     result.setdefault("ok", result.get("value", 0) > 0)
     if "metric" in result:
         with open(os.path.join(REPO, "BENCH_TPU.json"), "w") as f:
-            json.dump({k: v for k, v in result.items() if k not in ("ok", "wall_s")}, f)
+            json.dump({"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                       **{k: v for k, v in result.items() if k not in ("ok", "wall_s")}}, f)
             f.write("\n")
     return result
 

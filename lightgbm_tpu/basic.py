@@ -738,7 +738,16 @@ class Booster:
         return self
 
     def update(self, train_set=None, fobj=None) -> bool:
-        """One boosting iteration; returns True if stopped (can't split)."""
+        """One boosting iteration; returns True if stopped (can't split).
+
+        Stop reporting runs one call behind the reference (gbdt.cpp:402):
+        to keep the training loop free of per-iteration device syncs, the
+        no-split check is deferred — the splitless iteration itself returns
+        False and the True arrives on the NEXT update() call (which trains
+        nothing and rolls the placeholder back). Final model state is
+        identical to the reference's; only callers branching on the return
+        value see the one-call lag.
+        """
         if fobj is None:
             return self._gbdt.train_one_iter()
         K = self._gbdt.num_tree_per_iteration

@@ -227,6 +227,10 @@ def booster_add_valid_data(bid: int, did: int) -> None:
 
 
 def booster_update_one_iter(bid: int) -> int:
+    # is_finished (1 = no split possible) lags one call behind the reference
+    # C API: the deferred stop check means the splitless call returns 0 and
+    # the 1 arrives on the next LGBM_BoosterUpdateOneIter, which trains
+    # nothing. Model state after the loop is identical (Booster.update doc).
     return 1 if _boosters[bid].booster.update() else 0
 
 

@@ -281,20 +281,26 @@ _warned_interpret = False
 
 def supported(feature_meta: Dict, backend: str) -> bool:
     """Routing gate: numerical-only metas. Off-TPU the kernel would run in
-    the (Python-interpreter) pallas interpret mode — allowed for tests and
-    debugging, but warned loudly since it is orders of magnitude slower
-    than the XLA scan."""
+    the (Python-interpreter) pallas interpret mode — orders of magnitude
+    slower than the XLA scan — so production training declines it there and
+    LIGHTGBM_TPU_SPLIT_IMPL=pallas falls back to the XLA scan. Tests and
+    debugging opt in with LIGHTGBM_TPU_SPLIT_INTERPRET=1."""
+    import os
+
     if "is_categorical" in feature_meta:
         return False
     if backend != "tpu":
-        global _warned_interpret
-        if not _warned_interpret:
-            _warned_interpret = True
-            from ..utils import log
+        if os.environ.get("LIGHTGBM_TPU_SPLIT_INTERPRET") != "1":
+            global _warned_interpret
+            if not _warned_interpret:
+                _warned_interpret = True
+                from ..utils import log
 
-            log.warning(
-                "LIGHTGBM_TPU_SPLIT_IMPL=pallas on a %r backend runs the "
-                "split-scan kernel in interpret mode (very slow; intended "
-                "for tests). Unset the env var for the XLA scan." % backend
-            )
+                log.warning(
+                    "LIGHTGBM_TPU_SPLIT_IMPL=pallas ignored on a %r backend "
+                    "(the kernel would run in Python interpret mode); using "
+                    "the XLA scan. Set LIGHTGBM_TPU_SPLIT_INTERPRET=1 to "
+                    "force interpret mode for tests/debugging." % backend
+                )
+            return False
     return True

@@ -121,6 +121,10 @@ def test_env_routed_training_matches_default(monkeypatch):
 
     monkeypatch.setattr(sp_mod, "find_best_split_pair_pallas", spy)
     monkeypatch.setattr(grow_mod, "_ENV_SPLIT_IMPL", "pallas")
+    # off-TPU, supported() declines the kernel unless the interpret-mode
+    # debug flag is set (ADVICE r4: production must not silently run the
+    # Python interpreter)
+    monkeypatch.setenv("LIGHTGBM_TPU_SPLIT_INTERPRET", "1")
     jax.clear_caches()
     try:
         alt = lgb.train(
