@@ -42,7 +42,8 @@ STAGE_TIMEOUTS = {
     "pallas": 900,     # first Mosaic lowering can be slow
     "pack4": 900,      # nibble-packing measurement (VERDICT r3 item 8)
     "smoke": 1800,     # bucket-lattice switch compile at 100k rows
-    "smoke_xla": 1800,  # same smoke, XLA histogram impl (routing question)
+    "smoke_seq": 1800,  # sequential grower (spec-batch win measurement)
+    "smoke_pallas": 1800,  # same smoke, pallas histogram impl (routing race)
     "smoke_xla_radix": 1800,  # same smoke, plain-XLA radix factorization
     "smoke_bf16": 1800,  # same smoke, bf16 MXU operands (AUC delta record)
     "smoke_psplit": 1800,  # opt-in Pallas split-scan kernel (first lowering)
@@ -203,14 +204,25 @@ print(json.dumps({"ok": auc > 0.70, "first_iter_s": round(compile_s, 1),
 """ % (REPO, REPO)
 
 
-# same 100k training smoke with the XLA one-hot histogram impl instead of
-# the Pallas kernel: on-silicon r4 measurements had XLA at 16.8ms vs pallas
-# v1's 34.8ms for a full-N pass — this stage answers the routing question at
-# the real workload (iters_per_sec side by side with the 'smoke' stage)
-SMOKE_XLA = SMOKE.replace(
+# sequential grower vs the (r5 default-on-TPU) speculative top-k batch
+# grower: the 'smoke' stage runs spec, this one forces seq — their
+# iters_per_sec ratio is the measured spec-batch win
+SMOKE_SEQ = SMOKE.replace(
     'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"',
     'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"\n'
-    'os.environ["LIGHTGBM_TPU_HIST_IMPL"] = "xla"',
+    'os.environ["LIGHTGBM_TPU_GROW"] = "seq"',
+)
+assert 'LIGHTGBM_TPU_GROW' in SMOKE_SEQ
+
+# same 100k training smoke with the pallas radix histogram impl instead of
+# the (r5 default) XLA one-hot: on-silicon r4 measurements had XLA at
+# 16.8ms vs pallas v1's 34.8ms for a full-N pass; the feature-batched v2
+# kernel is the unmeasured contender this stage races at the real workload
+# (iters_per_sec side by side with the 'smoke' stage)
+SMOKE_PALLAS = SMOKE.replace(
+    'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"',
+    'os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"\n'
+    'os.environ["LIGHTGBM_TPU_HIST_IMPL"] = "pallas"',
 )
 
 SMOKE_XLA_RADIX = SMOKE.replace(
@@ -220,8 +232,8 @@ SMOKE_XLA_RADIX = SMOKE.replace(
 )
 assert "xla_radix" in SMOKE_XLA_RADIX
 # .replace on an exact anchor: fail loudly if the anchor drifts, or this
-# stage would silently re-measure the Pallas impl under an "xla" label
-assert "LIGHTGBM_TPU_HIST_IMPL" in SMOKE_XLA
+# stage would silently re-measure the default impl under the variant label
+assert "LIGHTGBM_TPU_HIST_IMPL" in SMOKE_PALLAS
 
 # bf16 MXU operands (the reference GPU path's single-precision trade,
 # GPU-Performance.rst:131-145): same smoke, records the AUC delta vs the
@@ -316,33 +328,46 @@ def run_bench() -> dict:
     return result
 
 
+def _dump(summary) -> None:
+    """Persist after EVERY stage: the relay dies unpredictably, and a
+    partial summary still feeds bench.py's bake-off auto-adoption."""
+    with open(SUMMARY, "w") as f:
+        json.dump(summary, f, indent=1)
+
+
 def main() -> int:
-    summary = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {}}
+    # ordered by decision value per minute of chip time: the spec-vs-seq
+    # grower race and the histogram routing race feed bench auto-adoption;
+    # pack4 is a shelved-accelerator measurement and goes last
+    summary = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {},
+               "verdict": "in progress"}
     for stage, src in (("matmul", MATMUL), ("pallas", PALLAS),
-                       ("pack4", PACK4), ("smoke", SMOKE),
-                       ("smoke_xla", SMOKE_XLA),
-                       ("smoke_xla_radix", SMOKE_XLA_RADIX),
+                       ("smoke", SMOKE),
+                       ("smoke_seq", SMOKE_SEQ),
+                       ("smoke_pallas", SMOKE_PALLAS),
                        ("smoke_bf16", SMOKE_BF16),
-                       ("smoke_psplit", SMOKE_PSPLIT)):
+                       ("smoke_xla_radix", SMOKE_XLA_RADIX),
+                       ("smoke_psplit", SMOKE_PSPLIT),
+                       ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         result = run_stage(stage, src)
         summary["stages"][stage] = result
+        _dump(summary)
         print("bringup: %s -> %s" % (stage, json.dumps(result)), flush=True)
         if not result.get("ok"):
             # matmul failing = relay gone again; pallas failing = still worth
-            # trying the XLA-impl smoke + bench (bench.py retries with
-            # LIGHTGBM_TPU_HIST_IMPL=xla on TPU worker failure by itself)
+            # running the smokes + bench (auto-adoption just won't pick the
+            # kernel, and bench.py retries with LIGHTGBM_TPU_HIST_IMPL=xla
+            # on TPU worker failure by itself)
             if stage == "matmul":
                 summary["verdict"] = "relay dead at stage %s" % stage
-                with open(SUMMARY, "w") as f:
-                    json.dump(summary, f, indent=1)
+                _dump(summary)
                 return 1
     print("bringup: stage bench ...", flush=True)
     summary["stages"]["bench"] = run_bench()
     ok = summary["stages"]["bench"].get("ok", False)
     summary["verdict"] = "ok" if ok else "bench failed"
-    with open(SUMMARY, "w") as f:
-        json.dump(summary, f, indent=1)
+    _dump(summary)
     print("bringup: done -> %s" % json.dumps(summary["stages"]["bench"]), flush=True)
     return 0 if ok else 1
 

@@ -46,8 +46,8 @@ from typing import Dict, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from ..utils.platform import env_choice
-from .histogram import leaf_histogram, leaf_values
+from ..utils.platform import env_choice, env_int
+from .histogram import _default_backend, leaf_histogram, leaf_values
 from .split import (
     MISSING_NAN,
     MISSING_ZERO,
@@ -71,6 +71,22 @@ _ENV_LATTICE = env_choice("LIGHTGBM_TPU_LATTICE", ("pow2", "coarse"))
 # (ops/split_pallas.py) — experimental until its Mosaic lowering and timing
 # are measured on silicon (bringup smoke_psplit stage). Default: XLA scan.
 _ENV_SPLIT_IMPL = env_choice("LIGHTGBM_TPU_SPLIT_IMPL", ("pallas",))
+
+# Speculative top-k batched growth ("spec" mode): each while_loop step
+# batches the partition/histogram/scan work of the top-k candidate leaves
+# and applies the longest prefix the sequential gain order would have
+# chosen — measured 3.7x fewer sequential loop steps at k=8 on real split
+# sequences (r5 study), attacking the dominant per-split fixed cost of the
+# r4 on-silicon breakdown (BENCH_NOTES.md). "spec"/"seq" force the mode on
+# any backend (tests use monkeypatch + clear_caches like _ENV_SPLIT_IMPL);
+# the default is spec on TPU, sequential elsewhere.
+_ENV_GROW = env_choice("LIGHTGBM_TPU_GROW", ("spec", "seq"))
+_ENV_SPEC_K = env_int("LIGHTGBM_TPU_SPEC_K", 8, lo=2, hi=64)
+
+# which mode the most recent grow_tree TRACE resolved to ("spec"/"seq") —
+# set at trace time, so only meaningful right after a cache-cleared call;
+# tests use it to prove the speculative path actually engaged
+_LAST_GROW_MODE = None
 
 
 class TreeArrays(NamedTuple):
@@ -194,6 +210,15 @@ class GrowState(NamedTuple):
     slot_of: jax.Array  # [M] int32: leaf -> pool slot, -1 = evicted
     slot_leaf: jax.Array  # [P] int32: slot -> leaf, -1 = free
     slot_age: jax.Array  # [P] int32 LRU stamps (0 = never used)
+    # spec-mode speculation cache (dummies otherwise): a speculated-but-
+    # unapplied split's children results are kept so its heavy work happens
+    # exactly once. The LEFT child's histogram is committed straight into
+    # the hist carry at cache time (the parent histogram's only use —
+    # subtraction — is over by then); the right child has no leaf slot yet,
+    # so its histogram parks here keyed by the parent leaf.
+    spec_flag: jax.Array  # [M] bool: leaf's pending split is cached
+    spec_lphys: jax.Array  # [M] int32: cached left physical count
+    spec_rhist: jax.Array  # [M, F, B, 3] cached right-child histograms
 
 
 def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat, member):
@@ -350,6 +375,32 @@ def grow_tree(
             "need >= %d slots" % (len(forced_splits) + 2)
         )
 
+    # ---- speculative top-k batching (spec mode) -------------------------
+    # Exactness argument: a leaf's cached best split and its children's
+    # histograms depend only on that leaf's own segment and histogram, so
+    # the work for the top-k candidates is computable in parallel; the
+    # applied prefix reproduces argmax's (higher gain, lower slot) order, so
+    # the applied split sequence — node numbering included — equals the
+    # sequential one. Gated off for CEGB (penalties are order-dependent),
+    # histogram pools (slot state is per-split), custom split searches
+    # (may contain collectives that don't vmap), masked mode, and the
+    # use_subtract=False oracle.
+    spec_ok = (
+        bucketed and not pooled and not cegb_on and use_subtract
+        and split_fn is find_best_split and _ENV_SPLIT_IMPL != "pallas"
+    )
+    if _ENV_GROW == "seq":
+        KB = 0
+    elif _ENV_GROW == "spec":
+        KB = _ENV_SPEC_K
+    else:
+        KB = _ENV_SPEC_K if _default_backend() == "tpu" else 0
+    KB = min(KB, M - 1) if spec_ok else 0
+    if KB < 2:
+        KB = 0
+    global _LAST_GROW_MODE  # trace-time introspection for tests
+    _LAST_GROW_MODE = "spec" if KB else "seq"
+
     num_bin_arr = feature_meta["num_bin"].astype(jnp.int32)
     missing_arr = feature_meta["missing_type"].astype(jnp.int32)
     default_bin_arr = feature_meta["default_bin"].astype(jnp.int32)
@@ -439,89 +490,153 @@ def grow_tree(
         valid = (pos >= off) & (pos < off + cnt)
         return start, off, seg, pos, valid
 
-    def partition_segment(order, begin, pcnt, f, threshold, default_left, member):
-        """Stably partition the leaf's segment in-place: left rows first.
+    def partition_batch(order, begin, pcnt, feat, thr, dleft, member):
+        """Stably partition W disjoint leaf segments in one lattice-switch
+        launch; returns (new order, left physical counts [W]). The W axis is
+        the leading axis of every operand; W=1 is the sequential grower's
+        per-split partition, W=KB a speculative batch — one implementation,
+        so the two modes cannot drift.
 
-        Returns (new order, left physical count) — DataPartition::Split
-        (data_partition.hpp:111) on a gathered size-lattice bucket."""
-        miss, dbin, nanb, iscat = (
-            missing_arr[f], default_bin_arr[f], num_bin_arr[f] - 1, is_cat_arr[f],
-        )
+        Layout after a partition (DataPartition::Split, data_partition.hpp:111):
+        [pre-segment | left | right | post-segment], stably, via prefix-sum
+        ranks — O(S) scatter instead of an O(S log S) stable sort. Integer-
+        exact and idempotent: re-partitioning an already-partitioned segment
+        yields the same layout, so work done for a speculated-but-unapplied
+        split stays valid when that leaf wins later."""
+        W = begin.shape[0]
+        miss = missing_arr[feat]
+        dbin = default_bin_arr[feat]
+        nanb = num_bin_arr[feat] - 1
+        iscat = is_cat_arr[feat]
+        rows = gid_arr[feat] if bundled else feat
+        slot_iota = jnp.arange(W, dtype=jnp.int32)
 
         def make_branch(S):
-            def branch(order, begin, pcnt, f, threshold, default_left):
-                start, off, seg, pos, valid = _segment_slice(order, begin, pcnt, S)
-                row = gid_arr[f] if bundled else f
-                if bins_nf is not None:
-                    # [N, F] layout: row gathers are contiguous (CPU cache)
-                    colraw = bins_nf[seg, row].astype(jnp.int32)
-                else:
-                    colraw = bins[row, seg].astype(jnp.int32)
-                colv = decode_col(colraw, f) if bundled else colraw
-                gl = _decision_go_left(colv, threshold, default_left, miss, dbin, nanb, iscat, member)
-                # stable partition via prefix sums — O(S) scatter instead of
-                # an O(S log S) stable sort. Bucket layout afterwards:
-                # [pre-segment | left | right | post-segment]; out-of-segment
-                # rows keep their positions, in-segment rows land at
-                # off + rank-within-class (lefts first).
-                is_left = valid & gl
-                is_right = valid & ~gl
-                # int ranks: associative_scan reassociation is exact for ints.
-                # One scan suffices: the segment is contiguous, so a right
-                # element's rank among rights is (in-segment position) minus
-                # (lefts before it) = pos - off - (left_rank + 1).
-                left_rank = jax.lax.associative_scan(jnp.add, is_left.astype(jnp.int32)) - 1
-                left_cnt = left_rank[-1] + 1
-                target = jnp.where(
-                    is_left,
-                    off + left_rank,
-                    jnp.where(is_right, left_cnt + pos - left_rank - 1, pos),
+            def branch(order, begin, pcnt, rows, feat, thr, dleft, miss,
+                       dbin, nanb, iscat, member):
+                def one(begin_j, pcnt_j, row_j, f_j, thr_j, dl_j, miss_j,
+                        dbin_j, nanb_j, iscat_j, member_j, slot_j):
+                    start, off, seg, pos, valid = _segment_slice(
+                        order, begin_j, pcnt_j, S
+                    )
+                    colraw = (
+                        bins_nf[seg, row_j]  # [N, F]: contiguous row gathers
+                        if bins_nf is not None
+                        else bins[row_j, seg]
+                    ).astype(jnp.int32)
+                    colv = decode_col(colraw, f_j) if bundled else colraw
+                    gl = _decision_go_left(
+                        colv, thr_j, dl_j, miss_j, dbin_j, nanb_j, iscat_j,
+                        member_j,
+                    )
+                    is_left = valid & gl
+                    is_right = valid & ~gl
+                    # int ranks: associative_scan reassociation is exact for
+                    # ints. One scan suffices: the segment is contiguous, so
+                    # a right element's rank among rights is (in-segment
+                    # position) minus (lefts before it).
+                    left_rank = jax.lax.associative_scan(
+                        jnp.add, is_left.astype(jnp.int32)
+                    ) - 1
+                    left_cnt = left_rank[-1] + 1
+                    tgt = jnp.where(
+                        is_left,
+                        off + left_rank,
+                        jnp.where(
+                            is_right, left_cnt + pos - left_rank - 1, pos
+                        ),
+                    )
+                    # invalid lanes get DISTINCT out-of-range targets
+                    # (scatter drops them; keeps unique_indices honest)
+                    gt = jnp.where(valid, start + tgt, N + slot_j * S + pos)
+                    return seg, gt, left_cnt
+
+                seg, gt, left_cnt = jax.vmap(one)(
+                    begin, pcnt, rows, feat, thr, dleft, miss, dbin, nanb,
+                    iscat, member, slot_iota,
                 )
-                out = jnp.zeros_like(seg).at[target].set(seg, unique_indices=True)
-                order2 = jax.lax.dynamic_update_slice(order, out, (start,))
+                # in-segment targets are disjoint across slots (disjoint
+                # leaves), so ONE scatter commits every partition
+                order2 = order.at[gt.reshape(-1)].set(
+                    seg.reshape(-1), unique_indices=True
+                )
                 return order2, left_cnt
 
             return branch
 
         idx = jnp.clip(
-            jnp.searchsorted(sizes_arr, pcnt, side="left"), 0, len(SIZES) - 1
+            jnp.searchsorted(sizes_arr, jnp.max(pcnt), side="left"),
+            0, len(SIZES) - 1,
         )
         return jax.lax.switch(
             idx, [make_branch(S) for S in SIZES],
-            order, begin, pcnt, f, threshold, default_left,
+            order, begin, pcnt, rows, feat, thr, dleft, miss, dbin, nanb,
+            iscat, member,
         )
 
-    def segment_histogram(order, begin, cnt):
-        """[F, B, 3] histogram of rows order[begin:begin+cnt) via the
-        smallest lattice bucket covering cnt — replaces the full-N masked
-        pass; cost tracks leaf size like the reference's ordered-index
-        histograms (dense_bin.hpp:71)."""
+    def partition_segment(order, begin, pcnt, f, threshold, default_left, member):
+        """One split's partition — the W=1 case of partition_batch."""
+        order2, left_cnt = partition_batch(
+            order, begin[None], pcnt[None], f[None], threshold[None],
+            default_left[None], member[None],
+        )
+        return order2, left_cnt[0]
+
+    def segment_histogram_batch(order, begin, cnt):
+        """[W, F, B, 3] histograms of W disjoint segments via ONE lattice-
+        switch launch: one fused gather for all segments, then a vmapped
+        chunked pass. W=1 is the sequential per-split histogram, W=KB a
+        speculative batch — the launch amortization that attacks the
+        per-split fixed cost dominating the r4 on-silicon breakdown.
+
+        Cost tracks leaf size like the reference's ordered-index histograms
+        (dense_bin.hpp:71); one gather from the precomputed [N, 3]
+        (grad*bag, hess*bag, bag) instead of three masked takes — bag/valid
+        are exact {0,1} multipliers so the product order cannot change f32
+        results."""
+        W = begin.shape[0]
+        Frows = bins.shape[0]
 
         def make_branch(S):
             def branch(order, begin, cnt):
-                _, _, seg, _, valid = _segment_slice(order, begin, cnt, S)
-                # one gather from the precomputed [N, 3] (grad*bag, hess*bag,
-                # bag) instead of three masked takes; bag/valid are exact
-                # {0,1} multipliers so the product order cannot change f32
-                # results
-                vals = jnp.take(vals_all, seg, axis=0) * valid[:, None].astype(f32)
+                def geo(begin_j, cnt_j):
+                    _, _, seg, _, valid = _segment_slice(
+                        order, begin_j, cnt_j, S
+                    )
+                    return seg, valid
+
+                seg, valid = jax.vmap(geo)(begin, cnt)  # [W, S]
+                flat = seg.reshape(-1)
+                vals = jnp.take(vals_all, flat, axis=0).reshape(W, S, 3)
+                vals = vals * valid[..., None].astype(f32)
                 if bins_nf is not None:
-                    b_seg = jnp.take(bins_nf, seg, axis=0).T  # [F or G, S]
+                    b_seg = jnp.take(bins_nf, flat, axis=0).reshape(
+                        W, S, Frows
+                    ).transpose(0, 2, 1)
                 else:
-                    b_seg = jnp.take(bins, seg, axis=1)  # [F or G, S]
-                return leaf_histogram(
-                    b_seg, vals, B_hist, chunk=chunk, hist_dtype=hist_dtype,
-                    feature_sharded=feature_sharded,
-                )
+                    b_seg = jnp.take(bins, flat, axis=1).reshape(
+                        Frows, W, S
+                    ).transpose(1, 0, 2)
+                return jax.vmap(
+                    lambda b, v: leaf_histogram(
+                        b, v, B_hist, chunk=chunk, hist_dtype=hist_dtype,
+                        feature_sharded=feature_sharded,
+                    )
+                )(b_seg, vals)
 
             return branch
 
         idx = jnp.clip(
-            jnp.searchsorted(sizes_arr, cnt, side="left"), 0, len(SIZES) - 1
+            jnp.searchsorted(sizes_arr, jnp.max(cnt), side="left"),
+            0, len(SIZES) - 1,
         )
         return jax.lax.switch(
             idx, [make_branch(S) for S in SIZES], order, begin, cnt
         )
+
+    def segment_histogram(order, begin, cnt):
+        """One segment's histogram — the W=1 case of the batch launch."""
+        return segment_histogram_batch(order, begin[None], cnt[None])[0]
 
     coupled_arr = feature_meta.get("cegb_coupled")
     lazy_arr = feature_meta.get("cegb_lazy")
@@ -812,6 +927,13 @@ def grow_tree(
         slot_of=slot_of0,
         slot_leaf=slot_leaf0,
         slot_age=slot_age0,
+        spec_flag=jnp.zeros((M,) if KB else (1,), bool),
+        spec_lphys=jnp.zeros((M,) if KB else (1,), jnp.int32),
+        spec_rhist=(
+            jnp.zeros((M, F, B, 3), f32)
+            if KB
+            else jnp.zeros((1, 1, 1, 1), f32)
+        ),
     )
 
     def apply_split(s: GrowState, best_leaf, rec: SplitResult) -> GrowState:
@@ -1132,6 +1254,9 @@ def grow_tree(
             slot_of=slot_of,
             slot_leaf=slot_leaf,
             slot_age=slot_age,
+            spec_flag=s.spec_flag,
+            spec_lphys=s.spec_lphys,
+            spec_rhist=s.spec_rhist,
         )
 
     # ---- forced splits preamble (ForceSplits) ---------------------------
@@ -1182,8 +1307,234 @@ def grow_tree(
         rec = _unpack_best_row(s.best, best_leaf)
         return apply_split(s, best_leaf, rec)
 
+    def body_spec(s: GrowState) -> GrowState:
+        """One speculative batch: compute the top-KB candidates' split work
+        (skipping slots whose results are cached from an earlier batch),
+        apply the longest sequential-order prefix, and CACHE the rest — so
+        each split's partition/histogram work happens exactly once no matter
+        how often it is speculated."""
+        it0 = s.it
+        nl0 = s.tree.num_leaves
+        kb_iota = jnp.arange(KB, dtype=jnp.int32)
+
+        # top-k by cached gain; lax.top_k breaks ties toward lower indices,
+        # matching the sequential argmax's first-max choice
+        g_top, b_idx = jax.lax.top_k(s.best.f[:, 0], KB)
+        b_top = b_idx.astype(jnp.int32)
+        rf = s.best.f[b_top]  # [KB, 9]
+        ri = s.best.i[b_top]  # [KB, 3]
+        rb = s.best.b[b_top]  # [KB, 1 + B]
+        feat, thr = ri[:, 0], ri[:, 1]
+        dleft, member = rb[:, 0].astype(bool), rb[:, 1:].astype(bool)
+        pbegin = s.leaf_begin[b_top]
+        pphys = s.leaf_phys[b_top]
+        cached = s.spec_flag[b_top]  # [KB]
+        # slots already cached, or with no live split (gain <= 0, incl. the
+        # -inf filler the tail of every tree's top-k carries), contribute
+        # zero-size segments: the lattice switch keys on the largest slot
+        # actually COMPUTING, their lanes carry no histogram mass, and a
+        # dead slot's garbage record (feat may be -1) never drives work
+        compute = (~cached) & (g_top > 0.0)
+
+        pphys_c = jnp.where(compute, pphys, 0)
+        order2, left_phys_c = partition_batch(
+            s.order, pbegin, pphys_c, feat, thr, dleft, member
+        )
+        left_phys = jnp.where(cached, s.spec_lphys[b_top], left_phys_c)
+        right_phys = pphys - left_phys
+
+        # smaller-child choice from the GLOBAL counts in the cached record
+        # (shard-uniform under shard_map, like the sequential path)
+        l_cnt, r_cnt = rf[:, 3], rf[:, 6]
+        left_smaller = l_cnt <= r_cnt
+        small_begin = jnp.where(left_smaller, pbegin, pbegin + left_phys)
+        small_cnt = jnp.where(
+            compute, jnp.where(left_smaller, left_phys, right_phys), 0
+        )
+        small_hist = segment_histogram_batch(order2, small_begin, small_cnt)
+        if hist_axis is not None:
+            # ONE collective for the whole batch (vs one per split)
+            small_hist = jax.lax.psum(small_hist, hist_axis)
+        if bundled:
+            small_hist = jax.vmap(remap_hist)(
+                small_hist,
+                jnp.where(left_smaller, rf[:, 1], rf[:, 4]),
+                jnp.where(left_smaller, rf[:, 2], rf[:, 5]),
+                jnp.where(left_smaller, l_cnt, r_cnt),
+            )
+        # for a cached slot, hist row b_j already holds the LEFT child's
+        # histogram (committed at cache time) and the right child's parks in
+        # spec_rhist; for computing slots it still holds the parent's
+        parent_hist = s.hist[b_top]
+        large_hist = parent_hist - small_hist
+        ls4 = left_smaller[:, None, None, None]
+        c4 = cached[:, None, None, None]
+        lhist = jnp.where(
+            c4, parent_hist, jnp.where(ls4, small_hist, large_hist)
+        )
+        rhist = jnp.where(
+            c4, s.spec_rhist[b_top], jnp.where(ls4, large_hist, small_hist)
+        )
+
+        # ---- children: aux, monotone windows, one batched scan ----------
+        mono_f = mono_arr[feat]
+        mid = (rf[:, 7] + rf[:, 8]) * 0.5
+        pmin = s.laux[b_top, _LAUX_MIN]
+        pmax = s.laux[b_top, _LAUX_MAX]
+        l_min = jnp.where(mono_f < 0, mid, pmin)
+        l_max = jnp.where(mono_f > 0, mid, pmax)
+        r_min = jnp.where(mono_f > 0, mid, pmin)
+        r_max = jnp.where(mono_f < 0, mid, pmax)
+
+        ch_hist = jnp.concatenate([lhist, rhist], axis=0)  # [2KB, F, B, 3]
+        ch_res = jax.vmap(
+            lambda h, sg, sh, nd, mn, mx: find_best_split(
+                h, sg, sh, nd, mn, mx, feature_meta, feature_mask, params,
+                two_way=two_way,
+            )
+        )(
+            ch_hist,
+            jnp.concatenate([rf[:, 1], rf[:, 4]]),
+            jnp.concatenate([rf[:, 2], rf[:, 5]]),
+            jnp.concatenate([l_cnt, r_cnt]),
+            jnp.concatenate([l_min, r_min]),
+            jnp.concatenate([l_max, r_max]),
+        )
+        depth_child = s.tree.leaf_i[b_top, 1] + 1  # [KB]
+        ch_gain = depth_gate(
+            ch_res.gain, jnp.concatenate([depth_child, depth_child])
+        )
+
+        # ---- sequential-prefix validation -------------------------------
+        # slot j applies iff (gain, slot) lex-beats every child produced by
+        # the batch so far — exactly the argmax order the sequential loop
+        # would follow (higher gain wins; equal gain -> lower slot wins).
+        gl, gr = ch_gain[:KB], ch_gain[KB:]
+        new_slot = nl0 + kb_iota  # child slot ids along the applied prefix
+        pair_g = jnp.maximum(gl, gr)
+        pair_s = jnp.where(gl >= gr, b_top, new_slot)  # tie -> lower (left)
+        big = jnp.int32(2 ** 30)
+        run_g, run_s = neg_inf, big
+        cm_g, cm_s = [], []
+        for j in range(KB):  # exclusive lexicographic running max (tiny)
+            cm_g.append(run_g)
+            cm_s.append(run_s)
+            beats = (pair_g[j] > run_g) | (
+                (pair_g[j] == run_g) & (pair_s[j] < run_s)
+            )
+            run_g = jnp.where(beats, pair_g[j], run_g)
+            run_s = jnp.where(beats, pair_s[j], run_s)
+        cm_g, cm_s = jnp.stack(cm_g), jnp.stack(cm_s)
+        ok = (g_top > cm_g) | ((g_top == cm_g) & (b_top < cm_s))
+        valid = (g_top > 0.0) & ok & (it0 + kb_iota < M - 1)
+        applied = jnp.cumprod(valid.astype(jnp.int32)).astype(bool)
+        p = jnp.sum(applied.astype(jnp.int32))
+
+        # ---- apply the prefix (batched scatters; row M drops) -----------
+        drop = jnp.int32(M)
+        node_idx = it0 + kb_iota
+        nrow = jnp.where(applied, node_idx, drop)
+        lrow = jnp.where(applied, b_top, drop)
+        rrow = jnp.where(applied, new_slot, drop)
+        ch_rows = jnp.concatenate([lrow, rrow])
+        # computed-but-unapplied slots with a live split become cache entries
+        cache_set = compute & (~applied)
+        crow = jnp.where(cache_set, b_top, drop)
+
+        t = s.tree
+        # parent pointers: each applied leaf's encoding appears in exactly
+        # one existing node row; remap it BEFORE writing the new node rows
+        # (whose own left-child encoding is that same value). No write-off
+        # row needed: a root split's encoding matches nothing.
+        node_ch = t.node_i[:, 2:4]
+        for j in range(KB):
+            node_ch = jnp.where(
+                applied[j] & (node_ch == -(b_top[j] + 1)),
+                node_idx[j], node_ch,
+            )
+        node_i = jnp.concatenate([t.node_i[:, :2], node_ch], axis=1)
+        node_i = node_i.at[nrow].set(
+            jnp.stack([feat, thr, -(b_top + 1), -(new_slot + 1)], axis=1)
+        )
+        parent_aux = s.laux[b_top]  # [KB, 5]
+        parent_value = calculate_leaf_output(
+            parent_aux[:, _LAUX_SG], parent_aux[:, _LAUX_SH], params
+        )
+        tree = PackedTree(
+            num_leaves=nl0 + p,
+            node_f=t.node_f.at[nrow].set(
+                jnp.stack(
+                    [rf[:, 0], parent_value, parent_aux[:, _LAUX_ND]], axis=1
+                )
+            ),
+            node_i=node_i,
+            node_b=t.node_b.at[nrow].set(rb.astype(bool)),
+            leaf_f=t.leaf_f.at[ch_rows].set(
+                jnp.concatenate([
+                    jnp.stack([rf[:, 7], rf[:, 3], rf[:, 2]], axis=1),
+                    jnp.stack([rf[:, 8], rf[:, 6], rf[:, 5]], axis=1),
+                ])
+            ),
+            leaf_i=t.leaf_i.at[ch_rows].set(
+                jnp.concatenate(
+                    [jnp.stack([node_idx, depth_child], axis=1)] * 2
+                )
+            ),
+        )
+        laux = s.laux.at[ch_rows].set(
+            jnp.concatenate([
+                jnp.stack([rf[:, 1], rf[:, 2], rf[:, 3], l_min, l_max], axis=1),
+                jnp.stack([rf[:, 4], rf[:, 5], rf[:, 6], r_min, r_max], axis=1),
+            ])
+        )
+        leaf_begin = s.leaf_begin.at[rrow].set(pbegin + left_phys)
+        leaf_phys = s.leaf_phys.at[ch_rows].set(
+            jnp.concatenate([left_phys, right_phys])
+        )
+        # the LEFT child's histogram lands in row b_j both on apply and on
+        # cache (the parent histogram there is dead once its children are
+        # built); the right child's goes to its new slot on apply, or parks
+        # in spec_rhist keyed by the parent on cache
+        lrow_hist = jnp.where(applied | cache_set, b_top, drop)
+        hist = s.hist.at[jnp.concatenate([lrow_hist, rrow])].set(
+            jnp.concatenate([lhist, rhist])
+        )
+        spec_rhist = s.spec_rhist.at[crow].set(rhist)
+        spec_lphys = s.spec_lphys.at[crow].set(left_phys)
+        spec_flag = (
+            s.spec_flag.at[crow].set(True)
+            .at[lrow].set(False)  # applied: children start uncached
+            .at[rrow].set(False)
+        )
+        pb2 = _pack_best(ch_res._replace(gain=ch_gain))  # [2KB, ...]
+        best = PackedBest(
+            s.best.f.at[ch_rows].set(pb2.f),
+            s.best.i.at[ch_rows].set(pb2.i),
+            s.best.b.at[ch_rows].set(pb2.b),
+        )
+        return GrowState(
+            it=it0 + p,
+            leaf_id=s.leaf_id,
+            tree=tree,
+            best=best,
+            laux=laux,
+            hist=hist,
+            feature_used=s.feature_used,
+            unused_cnt=s.unused_cnt,
+            used_in_data=s.used_in_data,
+            order=order2,
+            leaf_begin=leaf_begin,
+            leaf_phys=leaf_phys,
+            slot_of=s.slot_of,
+            slot_leaf=s.slot_leaf,
+            slot_age=s.slot_age,
+            spec_flag=spec_flag,
+            spec_lphys=spec_lphys,
+            spec_rhist=spec_rhist,
+        )
+
     if M > 1:
-        final = jax.lax.while_loop(cond, body, state)
+        final = jax.lax.while_loop(cond, body_spec if KB else body, state)
     else:
         final = state
 

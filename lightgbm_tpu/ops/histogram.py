@@ -13,10 +13,12 @@ The histogram layout is ``[num_features, num_bins, 3]`` float32 with channels
 accumulation follows the reference's GPU path, which demonstrates AUC parity with
 single-precision accumulators (docs/GPU-Performance.rst:131-145).
 
-On TPU the radix-packed Pallas kernel (ops/hist_pallas.py) replaces the
-one-hot contraction — ``leaf_histogram`` dispatches at trace time on the
-default backend; this module remains the portable XLA fallback and the
-reference implementation for the kernel's differential tests.
+``leaf_histogram`` dispatches at trace time on the default backend: the
+chunked one-hot contraction is the TPU default (measured winner over the
+pallas v1 kernel at every r4 on-silicon shape — BENCH_NOTES.md), a chunked
+scatter-add serves CPU, and the radix-packed Pallas kernels
+(ops/hist_pallas.py) remain selectable via LIGHTGBM_TPU_HIST_IMPL for the
+bringup bake-off.
 """
 from __future__ import annotations
 
@@ -58,11 +60,15 @@ _ENV_IMPL = env_choice(
 )
 
 
-def _pick_chunk(num_features: int, num_bins: int, requested: int) -> int:
-    """Bound the transient one-hot tensor to ~64MB of f32."""
+def _pick_chunk(num_features: int, num_bins: int, requested: int, n: int) -> int:
+    """Bound the transient one-hot tensor to ~64MB of f32, and never exceed
+    the row count itself: N is padded UP to a chunk multiple, so a chunk
+    larger than N would multiply the work of every small-bucket pass (the
+    majority of per-split histograms in bucketed mode) by chunk/N."""
     budget = 64 * 1024 * 1024 // 4
     c = budget // max(num_features * num_bins, 1)
-    c = max(256, min(int(c), requested))
+    n_ceil = -(-n // 256) * 256
+    c = max(256, min(int(c), requested, n_ceil))
     # round down to a multiple of 256 for clean tiling
     return max(256, (c // 256) * 256)
 
@@ -95,13 +101,15 @@ def leaf_histogram(
       axis_name: if set, psum the result over that mesh axis (the data-parallel
         ReduceScatter path of data_parallel_tree_learner.cpp:161 collapsed into
         one XLA collective).
-      impl: "auto" (pallas on TPU, chunked scatter-add on CPU, one-hot
-        contraction elsewhere), "pallas", "scatter", "xla" (the one-hot
+      impl: "auto" (chunked scatter-add on CPU, one-hot contraction on TPU
+        and elsewhere), "pallas", "scatter", "xla" (the one-hot
         contraction — also the differential oracle for the others), or
         "xla_radix" (the radix factorization in plain XLA).
-      hist_dtype: MXU operand dtype for the pallas kernel — "float32" (exact,
-        matches the XLA fallback) or "bfloat16" (rounds grad/hess operands;
-        accumulation stays f32 — the reference GPU path's single-precision
+      hist_dtype: MXU operand dtype for the pallas kernel and the XLA
+        one-hot/radix contractions — "float32" (exact) or "bfloat16"
+        (rounds grad/hess operands; the one-hot side and the count channel
+        are exact 0/1 values, and accumulation stays f32 via
+        preferred_element_type — the reference GPU path's single-precision
         trade, docs/GPU-Performance.rst:131-145).
 
     Returns:
@@ -121,11 +129,19 @@ def leaf_histogram(
             "to the XLA one-hot implementation" % (num_bins,)
         )
         impl = "xla"
-    if impl == "pallas" or (impl == "auto" and hist_pallas.supported(num_bins)):
+    if impl == "pallas":
         hist = hist_pallas.histogram_pallas(
             bins, values, num_bins, chunk=max(chunk, 512), dtype_name=hist_dtype
         )
         return _combine(hist, axis_name)
+    if impl == "auto" and _default_backend() == "tpu":
+        # Measured on v5e-1 (BENCH_NOTES r4): XLA one-hot 16.8 ms vs pallas
+        # v1 34.8 ms for a full-N 1Mx28x255 pass — the one-hot contraction is
+        # the on-chip winner at every measured shape, so TPU auto routes here.
+        # The pallas kernels stay selectable (LIGHTGBM_TPU_HIST_IMPL=pallas)
+        # and the bringup bake-off re-races them (incl. the feature-batched
+        # v2) each chip window; flip this default if a kernel wins.
+        impl = "xla"
     if impl == "scatter" or (impl == "auto" and _default_backend() == "cpu"):
         # CPU: a scatter-add is the dense_bin.hpp:71 loop XLA can actually run
         # well — F*N adds instead of the one-hot contraction's 2*F*N*B flops
@@ -174,6 +190,7 @@ def leaf_histogram(
     F, N = bins.shape
     K = values.shape[1]
     B = num_bins
+    op_dtype = jnp.bfloat16 if hist_dtype == "bfloat16" else jnp.float32
 
     if impl == "xla_radix":
         # The Pallas kernel's radix factorization (hist_pallas.py module
@@ -187,7 +204,7 @@ def leaf_histogram(
         # chunk sized for THIS path's transients ([F, C, LO*K+HI], not the
         # one-hot's [F, C, B]) — the B-based budget would undersize C ~4x
         # and handicap the very contender this branch exists to race
-        C = _pick_chunk(F, LO * K + HI, chunk)
+        C = _pick_chunk(F, LO * K + HI, chunk, N)
         if N % C != 0:
             pad = (-N) % C
             bins = jnp.pad(bins, ((0, 0), (0, pad)))
@@ -204,11 +221,11 @@ def leaf_histogram(
             bi = b.astype(jnp.int32)
             hi = bi // LO
             lo = bi - hi * LO
-            oh_lo = (lo[:, :, None] == lo_iota[None, None, :]).astype(jnp.float32)
-            lhs = (oh_lo[:, :, :, None] * v[None, :, None, :]).reshape(
+            oh_lo = (lo[:, :, None] == lo_iota[None, None, :]).astype(op_dtype)
+            lhs = (oh_lo[:, :, :, None] * v.astype(op_dtype)[None, :, None, :]).reshape(
                 F, C, LO * K
             )
-            oh_hi = (hi[:, :, None] == hi_iota[None, None, :]).astype(jnp.float32)
+            oh_hi = (hi[:, :, None] == hi_iota[None, None, :]).astype(op_dtype)
             part = jax.lax.dot_general(
                 lhs, oh_hi,
                 dimension_numbers=(((1,), (1,)), ((0,), (0,))),
@@ -226,7 +243,7 @@ def leaf_histogram(
         )
         return _combine(hist, axis_name)
 
-    C = _pick_chunk(F, B, chunk)
+    C = _pick_chunk(F, B, chunk, N)
     if N % C != 0:
         pad = (-N) % C
         bins = jnp.pad(bins, ((0, 0), (0, pad)))
@@ -241,12 +258,12 @@ def leaf_histogram(
 
     def body(acc, inputs):
         b, v = inputs  # [F, C], [C, K]
-        onehot = (b.astype(jnp.int32)[:, :, None] == iota[None, None, :]).astype(jnp.float32)
+        onehot = (b.astype(jnp.int32)[:, :, None] == iota[None, None, :]).astype(op_dtype)
         # [F, C, B] x [C, K] -> [F, B, K]; f32 accumulate on MXU
         # contract the C axis: [F, C, B] . [C, K] -> [F, B, K]
         acc = acc + jax.lax.dot_general(
             onehot,
-            v,
+            v.astype(op_dtype),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )

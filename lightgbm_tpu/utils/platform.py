@@ -73,6 +73,28 @@ def ensure_virtual_devices(n_devices: int):
     return jax
 
 
+def env_int(name: str, default: int, lo: int = None, hi: int = None) -> int:
+    """Import-time integer env knob beside env_choice: unparseable values
+    warn and fall back (never silently), range-clamped when bounds given."""
+    raw = os.environ.get(name, "")
+    if not raw:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        import warnings
+
+        warnings.warn(
+            "%s=%r is not an integer; using %d" % (name, raw, default)
+        )
+        return default
+    if lo is not None:
+        val = max(lo, val)
+    if hi is not None:
+        val = min(hi, val)
+    return val
+
+
 def env_choice(name: str, allowed) -> str:
     """Import-time env knob: the env var's lowercased value if in ``allowed``,
     else "" with a warning. Shared by the LIGHTGBM_TPU_* routing knobs

@@ -1,0 +1,94 @@
+"""bench.py's bake-off auto-adoption: the headline TPU run must pick the
+measured-best grower/histogram/precision config from TPU_BRINGUP.json
+(VERDICT r4 item 1a — 'consume the bake-off'), never a stale or unsafe one.
+"""
+import os
+
+import pytest
+
+import bench
+
+_KNOBS = ("LIGHTGBM_TPU_GROW", "LIGHTGBM_TPU_HIST_IMPL",
+          "LIGHTGBM_TPU_SPLIT_IMPL")
+
+
+@pytest.fixture(autouse=True)
+def _knob_sandbox():
+    """_adopt_from_bringup mutates os.environ directly (by design: the env
+    knobs are import-time); snapshot/restore so adopted knobs cannot leak
+    into later tests' subprocesses."""
+    saved = {k: os.environ.get(k) for k in _KNOBS}
+    for k in _KNOBS:
+        os.environ.pop(k, None)
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _st(rate, auc=0.74, ok=True):
+    d = {"ok": ok}
+    if rate is not None:
+        d["iters_per_sec"] = rate
+        d["train_auc_11_iters"] = auc
+    return d
+
+
+def test_adopts_fastest_stage():
+    stages = {
+        "smoke": _st(2.0),
+        "smoke_seq": _st(3.5),
+        "smoke_pallas": _st(1.5),
+    }
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert rec["winner"] == "smoke_seq"
+    assert os.environ["LIGHTGBM_TPU_GROW"] == "seq"
+    assert pars == {}
+
+
+def test_default_winner_sets_nothing():
+    stages = {"smoke": _st(5.0), "smoke_seq": _st(3.0)}
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert rec["winner"] == "smoke"
+    assert "LIGHTGBM_TPU_GROW" not in os.environ
+    assert pars == {}
+
+
+def test_bf16_needs_auc_within_noise():
+    stages = {
+        "smoke": _st(2.0, auc=0.745),
+        "smoke_seq": _st(1.0),
+        "smoke_bf16": _st(9.9, auc=0.72),  # fast but AUC off: rejected
+    }
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert rec["winner"] == "smoke"
+    stages["smoke_bf16"] = _st(9.9, auc=0.7449)
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert rec["winner"] == "smoke_bf16"
+    assert pars == {"tpu_hist_dtype": "bfloat16"}
+
+
+def test_stale_summary_ignored():
+    """A pre-r5 summary (no smoke_seq stage) measured different code."""
+    stages = {"smoke": _st(9.0), "smoke_xla": _st(2.0)}
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert rec is None and pars == {}
+
+
+def test_failed_stages_skipped():
+    stages = {
+        "smoke": _st(None, ok=False),
+        "smoke_seq": _st(2.5),
+        "smoke_psplit": _st(4.0),
+    }
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert rec["winner"] == "smoke_psplit"
+    assert os.environ["LIGHTGBM_TPU_SPLIT_IMPL"] == "pallas"
+    assert os.environ["LIGHTGBM_TPU_GROW"] == "seq"
+
+
+def test_cpu_platform_never_adopts():
+    pars, rec = bench._adopt_from_bringup("cpu", {"smoke_seq": _st(3.0)})
+    assert rec is None and pars == {}

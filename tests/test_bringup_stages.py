@@ -11,8 +11,8 @@ import helpers.tpu_bringup as tb
 
 
 STAGES = (
-    "MATMUL", "PALLAS", "PACK4", "SMOKE", "SMOKE_XLA", "SMOKE_XLA_RADIX",
-    "SMOKE_BF16", "SMOKE_PSPLIT",
+    "MATMUL", "PALLAS", "PACK4", "SMOKE", "SMOKE_SEQ", "SMOKE_PALLAS",
+    "SMOKE_XLA_RADIX", "SMOKE_BF16", "SMOKE_PSPLIT",
 )
 
 
@@ -24,27 +24,29 @@ def test_every_stage_parses():
 def test_stage_table_complete():
     """Every stage run by main() has a timeout entry, and vice versa."""
     assert set(tb.STAGE_TIMEOUTS) == {
-        "matmul", "pallas", "pack4", "smoke", "smoke_xla", "smoke_xla_radix",
-        "smoke_bf16", "smoke_psplit", "bench",
+        "matmul", "pallas", "pack4", "smoke", "smoke_seq", "smoke_pallas",
+        "smoke_xla_radix", "smoke_bf16", "smoke_psplit", "bench",
     }
 
 
 def test_replace_anchors_took_effect():
     """The derived smoke variants must really differ from SMOKE in the way
     their env overrides promise (a drifted anchor silently no-ops)."""
-    assert 'LIGHTGBM_TPU_HIST_IMPL"] = "xla"' in tb.SMOKE_XLA
+    assert 'LIGHTGBM_TPU_GROW"] = "seq"' in tb.SMOKE_SEQ
+    assert 'LIGHTGBM_TPU_HIST_IMPL"] = "pallas"' in tb.SMOKE_PALLAS
     assert 'LIGHTGBM_TPU_HIST_IMPL"] = "xla_radix"' in tb.SMOKE_XLA_RADIX
     assert '"tpu_hist_dtype": "bfloat16"' in tb.SMOKE_BF16
     assert 'LIGHTGBM_TPU_SPLIT_IMPL"] = "pallas"' in tb.SMOKE_PSPLIT
-    for derived in (tb.SMOKE_XLA, tb.SMOKE_XLA_RADIX, tb.SMOKE_BF16,
-                    tb.SMOKE_PSPLIT):
+    for derived in (tb.SMOKE_SEQ, tb.SMOKE_PALLAS, tb.SMOKE_XLA_RADIX,
+                    tb.SMOKE_BF16, tb.SMOKE_PSPLIT):
         assert derived != tb.SMOKE
 
 
 def test_env_overrides_precede_import():
     """The env knobs are read at lightgbm_tpu import time (env_choice), so
     each stage must set them BEFORE the import line."""
-    for src in (tb.SMOKE_XLA, tb.SMOKE_XLA_RADIX, tb.SMOKE_PSPLIT):
+    for src in (tb.SMOKE_SEQ, tb.SMOKE_PALLAS, tb.SMOKE_XLA_RADIX,
+                tb.SMOKE_PSPLIT):
         assert src.index("os.environ[") < src.index("import lightgbm_tpu")
 
 

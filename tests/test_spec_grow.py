@@ -1,0 +1,168 @@
+"""Speculative top-k batched growth (ops/grow.py spec mode) vs the
+sequential grower: the applied split sequence must be EXACTLY the
+sequential one (node numbering included), because the batch-prefix rule
+reproduces argmax's (higher gain, lower slot) order.
+
+The reference has no counterpart — leaf-wise growth there is a host loop
+(serial_tree_learner.cpp:173-237); spec mode is this framework's TPU answer
+to the per-split fixed cost that dominated the r4 on-silicon breakdown.
+"""
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.ops.grow as grow_mod
+
+
+@pytest.fixture
+def spec_env(monkeypatch):
+    """Force spec mode on (CPU included) for the duration of a test."""
+
+    def set_mode(mode):
+        monkeypatch.setattr(grow_mod, "_ENV_GROW", mode)
+        jax.clear_caches()
+
+    yield set_mode
+    monkeypatch.setattr(grow_mod, "_ENV_GROW", "")
+    jax.clear_caches()
+
+
+def _data(seed=3, n=1500, f=10):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    X[:, 3] = rng.randint(0, 8, n)
+    X[rng.rand(n, f) < 0.05] = np.nan
+    y = (
+        X[:, 0] * 2 + np.nan_to_num(X[:, 1] * X[:, 2]) + 0.3 * rng.randn(n) > 0
+    ).astype(float)
+    return X, y
+
+
+def _train_pair(spec_env, params, X, y, rounds=3, **dskw):
+    params = dict(params, verbosity=-1)
+    spec_env("seq")
+    base = lgb.train(params, lgb.Dataset(X, label=y, **dskw), rounds)
+    assert grow_mod._LAST_GROW_MODE == "seq"
+    spec_env("spec")
+    spec = lgb.train(params, lgb.Dataset(X, label=y, **dskw), rounds)
+    return base, spec
+
+
+CONFIGS = {
+    "binary": dict(objective="binary", num_leaves=31),
+    "monotone": dict(
+        objective="regression",
+        num_leaves=31,
+        monotone_constraints=[1, -1, 0, 0, 0, 0, 0, 0, 0, 0],
+    ),
+    "max_depth": dict(objective="binary", num_leaves=63, max_depth=5),
+    "bagging": dict(
+        objective="binary", num_leaves=31, bagging_fraction=0.7,
+        bagging_freq=1, feature_fraction=0.6, seed=11,
+    ),
+    "multiclass": dict(objective="multiclass", num_class=3, num_leaves=15),
+    "regularized": dict(
+        objective="binary", num_leaves=31, lambda_l1=0.5, lambda_l2=2.0,
+        min_gain_to_split=0.01,
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CONFIGS))
+def test_spec_matches_sequential(spec_env, name):
+    X, y = _data()
+    if CONFIGS[name].get("objective") == "multiclass":
+        y = np.random.RandomState(1).randint(0, 3, len(y)).astype(float)
+    elif CONFIGS[name].get("objective") == "regression":
+        y = np.nan_to_num(X[:, 0] + X[:, 1])
+    base, spec = _train_pair(spec_env, CONFIGS[name], X, y)
+    assert grow_mod._LAST_GROW_MODE == "spec", "spec path never engaged"
+    assert base.model_to_string() == spec.model_to_string()
+
+
+def test_spec_weights_and_categorical(spec_env):
+    X, y = _data(seed=5)
+    w = np.random.RandomState(2).rand(len(y)) + 0.5
+    base, spec = _train_pair(
+        spec_env, dict(objective="binary", num_leaves=31), X, y,
+        weight=w, categorical_feature=[3],
+    )
+    assert base.model_to_string() == spec.model_to_string()
+
+
+def test_spec_forced_splits(spec_env, tmp_path):
+    X, y = _data(seed=7)
+    fpath = tmp_path / "forced.json"
+    fpath.write_text(
+        json.dumps(
+            {"feature": 0, "threshold": 0.0,
+             "left": {"feature": 1, "threshold": 0.0}}
+        )
+    )
+    base, spec = _train_pair(
+        spec_env,
+        dict(objective="binary", num_leaves=31,
+             forcedsplits_filename=str(fpath)),
+        X, y,
+    )
+    assert base.model_to_string() == spec.model_to_string()
+
+
+def test_spec_efb_bundles(spec_env):
+    rng = np.random.RandomState(9)
+    n = 1500
+    Xs = np.zeros((n, 12))
+    hot = rng.randint(0, 12, n)
+    Xs[np.arange(n), hot] = 1.0
+    X = np.hstack([rng.randn(n, 4), Xs])
+    y = (X[:, 0] + (hot % 3 == 0) + 0.3 * rng.randn(n) > 0.5).astype(float)
+    base, spec = _train_pair(
+        spec_env, dict(objective="binary", num_leaves=31, enable_bundle=True),
+        X, y,
+    )
+    assert base.model_to_string() == spec.model_to_string()
+
+
+def test_spec_data_parallel(spec_env):
+    """Spec under shard_map: one psum per BATCH instead of per split; trees
+    must still equal the sequential data-parallel learner's exactly."""
+    X, y = _data(seed=13)
+    params = dict(objective="binary", num_leaves=31, tree_learner="data")
+    base, spec = _train_pair(spec_env, params, X, y)
+    assert grow_mod._LAST_GROW_MODE == "spec"
+    assert base.model_to_string() == spec.model_to_string()
+
+
+def test_spec_gated_off_for_cegb_and_pool(spec_env):
+    """Order-dependent features must decline the batch path, loudly-typed
+    via _LAST_GROW_MODE, and still train correctly."""
+    X, y = _data(seed=17)
+    spec_env("spec")
+    bst = lgb.train(
+        dict(objective="binary", num_leaves=15, verbosity=-1,
+             cegb_penalty_feature_coupled=[0.1] * X.shape[1],
+             cegb_tradeoff=0.5),
+        lgb.Dataset(X, label=y), 2,
+    )
+    assert grow_mod._LAST_GROW_MODE == "seq"
+    assert bst.num_trees() > 0
+    jax.clear_caches()
+    bst2 = lgb.train(
+        dict(objective="binary", num_leaves=31, verbosity=-1,
+             histogram_pool_size=0.5),
+        lgb.Dataset(X, label=y), 2,
+    )
+    assert grow_mod._LAST_GROW_MODE == "seq"
+    assert bst2.num_trees() > 0
+
+
+def test_spec_k_clamped_small_trees(spec_env):
+    """num_leaves smaller than the batch width still trains (KB clamps)."""
+    X, y = _data(seed=19)
+    base, spec = _train_pair(
+        spec_env, dict(objective="binary", num_leaves=4), X, y
+    )
+    assert base.model_to_string() == spec.model_to_string()
