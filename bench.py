@@ -278,6 +278,19 @@ def _adopt_from_bringup(platform, stages=None):
         # summary predates the r5 stage set: its rates measured different
         # code — never mix them into today's routing decision
         return {}, None
+    knobs = ("LIGHTGBM_TPU_GROW", "LIGHTGBM_TPU_HIST_IMPL",
+             "LIGHTGBM_TPU_SPLIT_IMPL")
+    preset = [k for k in knobs if os.environ.get(k)]
+    if preset:
+        # an explicit knob is already in force — the orchestrator's
+        # crash-recovery retry (LIGHTGBM_TPU_HIST_IMPL=xla) or an operator
+        # override. Adoption must never clobber it: re-imposing the config
+        # that just crashed the worker would burn the whole chip window.
+        print(
+            "bench: bake-off adoption skipped (%s already set)"
+            % ",".join(preset), file=sys.stderr, flush=True,
+        )
+        return {}, {"skipped": "env override in force", "env_preset": preset}
 
     def rate(name):
         st = stages.get(name, {})

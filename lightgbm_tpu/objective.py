@@ -779,6 +779,55 @@ def dcg_discount(positions: np.ndarray) -> np.ndarray:
     return 1.0 / np.log2(2.0 + positions)
 
 
+@functools.partial(jax.jit, donate_argnums=())
+def _lambdarank_bucket(score, idx, labs, gains, invq, weight, sigmoid):
+    """Pairwise lambda/hessian for one size-bucket of queries, jitted.
+
+    The device twin of the reference's per-query OpenMP loop
+    (/root/reference/src/objective/rank_objective.hpp:74-82), restructured
+    as dense [nq, S, S] pairwise tensors over size-padded query rows — the
+    segment-ops formulation SURVEY §7 step 6 prescribes. Pads carry label
+    -1 and are masked out of every pair.
+
+    Args: score [N] f32; idx [nq, S] int32 row ids (N = pad); labs [nq, S]
+    int32 (-1 = pad); gains [nq, S] f32 label gains; invq [nq] f32 inverse
+    max DCG; weight [nq, S] f32 (or None); sigmoid scalar f32.
+    Returns (g, h) [nq, S] f32 (zeros in pad lanes).
+    """
+    valid = labs >= 0
+    s_raw = score[jnp.minimum(idx, score.shape[0] - 1)]
+    s0 = jnp.where(valid, s_raw, 0.0)  # pair-difference operand (NaN-safe)
+    # DCG ranks: stable descending sort of real entries, pads last — the
+    # double argsort inverts the order permutation exactly
+    key = jnp.where(valid, -s_raw, jnp.inf)
+    order = jnp.argsort(key, axis=1, stable=True)
+    rank = jnp.argsort(order, axis=1, stable=True).astype(jnp.float32)
+    disc = jnp.where(valid, 1.0 / jnp.log2(2.0 + rank), 0.0)
+    best = jnp.max(jnp.where(valid, s_raw, -jnp.inf), axis=1)
+    worst = jnp.min(jnp.where(valid, s_raw, jnp.inf), axis=1)
+
+    dl = (labs[:, :, None] > labs[:, None, :]) & valid[:, :, None] & valid[:, None, :]
+    ds = s0[:, :, None] - s0[:, None, :]
+    dcg_gap = gains[:, :, None] - gains[:, None, :]
+    paired_disc = jnp.abs(disc[:, :, None] - disc[:, None, :])
+    delta_ndcg = dcg_gap * paired_disc * invq[:, None, None]
+    delta_ndcg = jnp.where(
+        (best != worst)[:, None, None],
+        delta_ndcg / (0.01 + jnp.abs(ds)),
+        delta_ndcg,
+    )
+    p_lambda = 2.0 / (1.0 + jnp.exp(2.0 * ds * sigmoid))
+    p_hess = p_lambda * (2.0 - p_lambda)
+    lam = jnp.where(dl, -p_lambda * delta_ndcg, 0.0)
+    hes = jnp.where(dl, p_hess * 2.0 * delta_ndcg, 0.0)
+    g = jnp.sum(lam, axis=2) - jnp.sum(lam, axis=1)
+    h = jnp.sum(hes, axis=2) + jnp.sum(hes, axis=1)
+    if weight is not None:
+        g = g * weight
+        h = h * weight
+    return g, h
+
+
 class LambdarankNDCG(ObjectiveFunction):
     name = "lambdarank"
 
@@ -810,9 +859,73 @@ class LambdarankNDCG(ObjectiveFunction):
             maxdcg = float(np.sum(self.label_gain[top] * dcg_discount(np.arange(k))))
             inv[q] = 1.0 / maxdcg if maxdcg > 0 else 0.0
         self.inverse_max_dcgs = inv
+        self._build_device_plans()
+
+    def _build_device_plans(self):
+        """Group queries into power-of-two size buckets with static padded
+        gather plans — query sizes are dataset constants, so bucketing is a
+        trace-time decision and every jitted bucket shape is stable across
+        iterations. Row-chunked so the [nq, S, S] pairwise transients stay
+        ~32MB."""
+        qb = np.asarray(self.query_boundaries, np.int64)
+        sizes = np.diff(qb)
+        li = self.label.astype(np.int64)
+        n = self.num_data
+        buckets = {}
+        for q, c in enumerate(sizes):
+            if c <= 1:
+                continue  # no pairs, zero gradient
+            S = 1 << max(3, int(c - 1).bit_length())
+            buckets.setdefault(S, []).append(q)
+        plans = []
+        for S, qs in sorted(buckets.items()):
+            idx = np.full((len(qs), S), n, np.int64)
+            for r, q in enumerate(qs):
+                lo, hi = qb[q], qb[q + 1]
+                idx[r, : hi - lo] = np.arange(lo, hi)
+            valid = idx < n
+            safe = np.minimum(idx, n - 1)
+            labs = np.where(valid, li[safe], -1)
+            gains = np.where(valid, self.label_gain[np.maximum(labs, 0)], 0.0)
+            invq = self.inverse_max_dcgs[qs]
+            w = (
+                np.where(valid, self.weight[safe], 0.0)
+                if self.weight is not None
+                else None
+            )
+            chunk = max(1, (8 << 20) // (S * S))
+            for lo_r in range(0, len(qs), chunk):
+                sl = slice(lo_r, lo_r + chunk)
+                plans.append(
+                    (
+                        jnp.asarray(idx[sl], jnp.int32),
+                        jnp.asarray(labs[sl], jnp.int32),
+                        jnp.asarray(gains[sl], jnp.float32),
+                        jnp.asarray(invq[sl], jnp.float32),
+                        jnp.asarray(w[sl], jnp.float32) if w is not None else None,
+                    )
+                )
+        self._device_plans = plans
+        self._sigmoid_dev = jnp.float32(self.sigmoid)
 
     def get_gradients(self, score):
-        """Per-query pairwise lambdas; computed on host in numpy (vectorized per query)."""
+        """Jitted per-bucket pairwise lambdas; the whole gradient stays on
+        device (VERDICT r4 item 3 — no per-query host loop)."""
+        score = jnp.asarray(score, jnp.float32).reshape(-1)
+        grad = jnp.zeros(self.num_data, jnp.float32)
+        hess = jnp.zeros(self.num_data, jnp.float32)
+        for idx, labs, gains, invq, w in self._device_plans:
+            g, h = _lambdarank_bucket(
+                score, idx, labs, gains, invq, w, self._sigmoid_dev
+            )
+            flat = idx.reshape(-1)  # pads point at N: scatter-dropped
+            grad = grad.at[flat].set(g.reshape(-1))
+            hess = hess.at[flat].set(h.reshape(-1))
+        return grad, hess
+
+    def _get_gradients_host(self, score):
+        """Host-loop oracle (the original implementation) — kept as the
+        differential reference for the jitted path (tests/test_lambdarank_device)."""
         score_np = np.asarray(score, np.float64)
         grad = np.zeros(self.num_data, np.float64)
         hess = np.zeros(self.num_data, np.float64)

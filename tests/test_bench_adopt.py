@@ -92,3 +92,15 @@ def test_failed_stages_skipped():
 def test_cpu_platform_never_adopts():
     pars, rec = bench._adopt_from_bringup("cpu", {"smoke_seq": _st(3.0)})
     assert rec is None and pars == {}
+
+
+def test_preset_env_knob_blocks_adoption():
+    """The orchestrator's crash-recovery retry injects
+    LIGHTGBM_TPU_HIST_IMPL=xla; adoption must never clobber it with the
+    config that just crashed the worker."""
+    os.environ["LIGHTGBM_TPU_HIST_IMPL"] = "xla"
+    stages = {"smoke": _st(1.0), "smoke_seq": _st(1.5),
+              "smoke_pallas": _st(9.0)}
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert pars == {} and rec.get("skipped")
+    assert os.environ["LIGHTGBM_TPU_HIST_IMPL"] == "xla"

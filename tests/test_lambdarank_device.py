@@ -1,0 +1,105 @@
+"""Device-resident lambdarank gradients (objective.py _lambdarank_bucket)
+vs the host-loop oracle — VERDICT r4 item 3: the per-query Python loop is
+gone; the jitted bucket kernels must reproduce it.
+
+Reference semantics: /root/reference/src/objective/rank_objective.hpp:74-82
+(per-query pairwise lambdas with ΔNDCG weighting and score-gap
+normalization).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata
+from lightgbm_tpu.objective import LambdarankNDCG
+
+
+def _make_obj(labels, groups, weights=None, **cfg):
+    config = Config(objective="lambdarank", **cfg)
+    md = Metadata(
+        num_data=len(labels),
+        label=np.asarray(labels, np.float32),
+        weight=None if weights is None else np.asarray(weights, np.float32),
+        group=np.asarray(groups, np.int64),
+    )
+    obj = LambdarankNDCG(config)
+    obj.init(md, len(labels))
+    return obj
+
+
+def _mixed_case(seed=0, with_weights=False):
+    rng = np.random.RandomState(seed)
+    # deliberately mixed query sizes across several buckets, incl. size-1
+    # (no pairs), a tied-score query, and a single-label query
+    groups = [1, 2, 3, 7, 8, 9, 20, 33, 64, 130, 5, 1]
+    n = sum(groups)
+    labels = rng.randint(0, 5, n)
+    w = rng.rand(n).astype(np.float64) + 0.5 if with_weights else None
+    scores = rng.randn(n).astype(np.float64)
+    # query 3 (size 7): all scores identical -> best == worst branch
+    off = sum(groups[:3])
+    scores[off : off + 7] = 1.25
+    # query 4 (size 8): all labels equal -> no valid pairs
+    off = sum(groups[:4])
+    labels[off : off + 8] = 2
+    return labels, groups, w, scores
+
+
+@pytest.mark.parametrize("with_weights", [False, True])
+def test_device_matches_host_oracle(with_weights):
+    labels, groups, w, scores = _mixed_case(with_weights=with_weights)
+    obj = _make_obj(labels, groups, weights=w)
+    g_dev, h_dev = obj.get_gradients(scores.astype(np.float32))
+    g_host, h_host = obj._get_gradients_host(scores.astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(g_dev), np.asarray(g_host), rtol=2e-4, atol=2e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(h_dev), np.asarray(h_host), rtol=2e-4, atol=2e-6
+    )
+
+
+def test_device_plan_covers_every_row_once():
+    labels, groups, _, _ = _mixed_case(seed=3)
+    obj = _make_obj(labels, groups)
+    seen = np.concatenate(
+        [np.asarray(p[0]).reshape(-1) for p in obj._device_plans]
+    )
+    seen = seen[seen < obj.num_data]
+    assert len(seen) == len(set(seen.tolist()))
+    # rows of size-1 queries legitimately never appear (no pairs)
+    n1 = sum(g for g in groups if g <= 1)
+    assert len(seen) == obj.num_data - n1
+
+
+def test_single_query_all_pairs():
+    """One query, hand-checkable: gradients must push high labels up."""
+    labels = [3, 0]
+    obj = _make_obj(labels, [2])
+    g, h = obj.get_gradients(np.asarray([0.0, 0.0], np.float32))
+    g = np.asarray(g)
+    assert g[0] < 0 < g[1]  # negative gradient raises the leaf output
+    assert np.all(np.asarray(h) > 0)
+
+
+def test_e2e_training_quality():
+    rng = np.random.RandomState(6)
+    n_q, per_q = 80, 24
+    n = n_q * per_q
+    X = rng.randn(n, 8)
+    rel = np.clip(np.round(X[:, 0] + 0.3 * rng.randn(n) + 1), 0, 4)
+    bst = lgb.train(
+        {"objective": "lambdarank", "metric": "ndcg", "verbosity": -1,
+         "num_leaves": 15},
+        lgb.Dataset(X, label=rel, group=np.full(n_q, per_q)),
+        15,
+    )
+    p = bst.predict(X)
+    top = [
+        rel[q * per_q : (q + 1) * per_q][
+            np.argmax(p[q * per_q : (q + 1) * per_q])
+        ]
+        for q in range(n_q)
+    ]
+    assert np.mean(top) > rel.mean() + 0.8
