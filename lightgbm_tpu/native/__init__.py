@@ -94,7 +94,7 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.lgbt_partition_segment.argtypes = [
         c_int32_p, ctypes.c_int64, ctypes.c_int64, c_uint8_p,
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-        ctypes.c_int32, ctypes.c_int32, c_uint8_p, c_int32_p,
+        ctypes.c_int32, ctypes.c_int32, c_uint8_p, c_int32_p, ctypes.c_int32,
     ]
     lib.lgbt_alloc.restype = ctypes.c_void_p
     lib.lgbt_alloc.argtypes = [ctypes.c_int64]
@@ -329,12 +329,14 @@ def partition_segment(
     order: np.ndarray, begin: int, cnt: int, col: np.ndarray,
     threshold: int, default_left: bool, missing_type: int, default_bin: int,
     nan_bin: int, is_cat: bool, member: Optional[np.ndarray],
-    tmp_scratch: np.ndarray,
+    tmp_scratch: np.ndarray, efb_offset: int = -1,
 ) -> Optional[int]:
     """Stable in-place partition of order[begin:begin+cnt); returns the left
     count, or None when the native library is unavailable. ``col`` is one
-    feature's [N] uint8 column; ``member`` the [B] uint8 bitset for
-    categorical splits; ``tmp_scratch`` a reusable >= cnt int32 buffer."""
+    feature's [N] uint8 column (or its EFB GROUP column with
+    ``efb_offset >= 0`` — the kernel decodes sub-bins before the decision);
+    ``member`` the [B] uint8 bitset for categorical splits; ``tmp_scratch``
+    a reusable >= cnt int32 buffer."""
     lib = get_lib()
     if lib is None:
         return None
@@ -343,7 +345,7 @@ def partition_segment(
         col.ctypes.data_as(c_uint8_p), int(threshold), int(bool(default_left)),
         int(missing_type), int(default_bin), int(nan_bin), int(bool(is_cat)),
         member.ctypes.data_as(c_uint8_p) if member is not None else None,
-        tmp_scratch.ctypes.data_as(c_int32_p),
+        tmp_scratch.ctypes.data_as(c_int32_p), int(efb_offset),
     )
 
 

@@ -541,18 +541,30 @@ void lgbt_hist_segment(const int32_t* order, int64_t begin, int64_t cnt,
 //   is_cat: go_left = member[bin]  (no default-direction logic)
 //   member: [B] uint8 left-side membership bitset (may be null when !is_cat)
 //   tmp: caller scratch, >= cnt int32
+//   efb_offset: >= 0 when `col` is an EFB GROUP column (efb.py offset
+//   encoding); the feature's sub-bin is decoded before the decision, exactly
+//   like ops/grow.py decode_col: r = b - off; in [0, num_bin-1) ->
+//   r + (r >= default_bin), else the default bin. -1 = plain feature column.
 int64_t lgbt_partition_segment(int32_t* order, int64_t begin, int64_t cnt,
                                const uint8_t* col, int32_t threshold,
                                int32_t default_left, int32_t missing_type,
                                int32_t default_bin, int32_t nan_bin,
                                int32_t is_cat, const uint8_t* member,
-                               int32_t* tmp) {
+                               int32_t* tmp, int32_t efb_offset) {
   int32_t* seg = order + begin;
   int64_t nl = 0, nr = 0;
+  const bool efb = efb_offset >= 0;
+  auto decode = [&](int32_t b) -> int32_t {
+    if (!efb) return b;
+    const int32_t r = b - efb_offset;
+    if (r >= 0 && r < nan_bin)  // nan_bin == num_bin - 1
+      return r + (r >= default_bin ? 1 : 0);
+    return default_bin;
+  };
   if (is_cat) {
     for (int64_t i = 0; i < cnt; ++i) {
       const int32_t r = seg[i];
-      if (member[col[r]])
+      if (member[decode(col[r])])
         seg[nl++] = r;
       else
         tmp[nr++] = r;
@@ -560,7 +572,7 @@ int64_t lgbt_partition_segment(int32_t* order, int64_t begin, int64_t cnt,
   } else {
     for (int64_t i = 0; i < cnt; ++i) {
       const int32_t r = seg[i];
-      const int32_t b = col[r];
+      const int32_t b = decode(col[r]);
       bool go_left = b <= threshold;
       if (missing_type == 1 && b == default_bin) go_left = default_left;
       if (missing_type == 2 && b == nan_bin) go_left = default_left;

@@ -341,11 +341,14 @@ def grow_tree(
     hist_axis = axis_name if psum_hist else None
     cegb_on = cegb.enabled
     if cegb_on and split_fn is not find_best_split and cegb_rescan is None:
-        raise NotImplementedError(
-            "CEGB with a custom split_fn needs a matching batched "
+        # API contract, not a feature gap: every learner that customizes the
+        # split search ships its batched rescan (the voting learner's
+        # vote+elect, parallel/voting_parallel.py) — CEGB re-ranks cached
+        # candidates per split, so the two hooks must agree on semantics.
+        raise ValueError(
+            "CEGB with a custom split_fn requires a matching batched "
             "cegb_rescan(hist, lsg, lsh, lnd, mn, mx, pen, feature_meta, "
-            "feature_mask, params) -> SplitResult[M] (the voting learner "
-            "supplies one; see parallel/voting_parallel.py)"
+            "feature_mask, params) -> SplitResult[M]"
         )
     if hist_mode not in ("bucketed", "masked"):
         raise ValueError(
@@ -363,12 +366,6 @@ def grow_tree(
     P = int(hist_pool_slots) if pooled else M
     if pooled and P < 2:
         raise ValueError("histogram pool needs at least 2 slots, got %d" % P)
-    if pooled and cegb_on and cegb_rescan is not None:
-        raise NotImplementedError(
-            "histogram_pool_size with CEGB under a custom split search is "
-            "unsupported: the batched rescan needs per-leaf histograms, but "
-            "the pool keeps only resident slots"
-        )
     if pooled and forced_splits and P < len(forced_splits) + 2:
         raise ValueError(
             "histogram pool too small for the forced-splits preamble: "
@@ -741,15 +738,29 @@ def grow_tree(
         newly-used feature is adjusted, no re-argmax)."""
         pen = leaf_penalties(laux[:, _LAUX_ND], feature_used, unused_cnt)
         lv = jnp.maximum(slot_leaf, 0)  # [P] leaf of each slot (0 for free)
-        res = jax.vmap(
-            lambda h, sg, sh, nd, mn1, mx1, pr: find_best_split(
-                h, sg, sh, nd, mn1, mx1, feature_meta, feature_mask, params,
-                pr, two_way=two_way,
+        if cegb_rescan is not None:
+            # custom split search (the voting learner's batched vote+elect)
+            # over the RESIDENT slot rows — it is leading-axis polymorphic
+            # and its collectives run uniformly across shards because slot
+            # state is a pure function of the replicated split sequence;
+            # free-slot rows compute garbage that the `occupied` mask drops
+            res = cegb_rescan(
+                hist, laux[lv, _LAUX_SG], laux[lv, _LAUX_SH],
+                laux[lv, _LAUX_ND], laux[lv, _LAUX_MIN],
+                laux[lv, _LAUX_MAX], pen[lv], feature_meta, feature_mask,
+                params,
             )
-        )(
-            hist, laux[lv, _LAUX_SG], laux[lv, _LAUX_SH], laux[lv, _LAUX_ND],
-            laux[lv, _LAUX_MIN], laux[lv, _LAUX_MAX], pen[lv],
-        )
+        else:
+            res = jax.vmap(
+                lambda h, sg, sh, nd, mn1, mx1, pr: find_best_split(
+                    h, sg, sh, nd, mn1, mx1, feature_meta, feature_mask,
+                    params, pr, two_way=two_way,
+                )
+            )(
+                hist, laux[lv, _LAUX_SG], laux[lv, _LAUX_SH],
+                laux[lv, _LAUX_ND],
+                laux[lv, _LAUX_MIN], laux[lv, _LAUX_MAX], pen[lv],
+            )
         occupied = (slot_leaf >= 0) & (slot_age > 0) & (lv < tree.num_leaves)
         gain = jnp.where(occupied, res.gain, neg_inf)
         gain = depth_gate(gain, tree.leaf_i[lv, 1])

@@ -62,6 +62,7 @@ def grow_tree_data_parallel(
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
     two_way: bool = True,
+    hist_pool_slots=None,
 ):
     """Explicit shard_map data-parallel growth; returns (TreeArrays, leaf_id).
 
@@ -102,6 +103,7 @@ def grow_tree_data_parallel(
             axis_name="data",
             forced_splits=forced_splits,
             cegb=cegb,
+            hist_pool_slots=hist_pool_slots,
             cegb_state=(fu, uid) if cegb_on else None,
         )
 

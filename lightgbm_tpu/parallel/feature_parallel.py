@@ -54,6 +54,7 @@ def grow_tree_feature_parallel(
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
     two_way: bool = True,
+    hist_pool_slots=None,
 ):
     """Feature-sharded growth; returns (TreeArrays, leaf_id), both replicated."""
     fcol = NamedSharding(mesh, P("feature", None))
@@ -107,6 +108,7 @@ def grow_tree_feature_parallel(
         forced_splits=forced_splits,
         cegb=cegb,
         cegb_state=cegb_state,
+        hist_pool_slots=hist_pool_slots,
     )
     if cegb.enabled and pad:
         tree, leaf_id, (fu, uid) = out

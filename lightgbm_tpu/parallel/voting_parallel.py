@@ -162,6 +162,7 @@ def grow_tree_voting_parallel(
     cegb: CegbParams = CegbParams(),
     cegb_state=None,
     two_way: bool = True,
+    hist_pool_slots=None,
 ):
     """Voting-parallel growth; returns (TreeArrays replicated, leaf_id sharded).
 
@@ -204,6 +205,7 @@ def grow_tree_voting_parallel(
             forced_splits=forced_splits,
             num_group_bins=num_group_bins,
             cegb=cegb,
+            hist_pool_slots=hist_pool_slots,
             cegb_state=(fu, uid) if cegb_on else None,
             cegb_rescan=rescan_fn,
         )

