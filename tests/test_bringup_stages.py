@@ -24,8 +24,9 @@ def test_every_stage_parses():
 def test_stage_table_complete():
     """Every stage run by main() has a timeout entry, and vice versa."""
     assert set(tb.STAGE_TIMEOUTS) == {
-        "matmul", "pallas", "pack4", "smoke", "smoke_seq", "smoke_pallas",
-        "smoke_xla_radix", "smoke_bf16", "smoke_psplit", "bench",
+        "matmul", "pallas", "pack4", "smoke", "smoke_seq", "bench_early",
+        "smoke_pallas", "smoke_xla_radix", "smoke_bf16", "smoke_psplit",
+        "bench",
     }
 
 
