@@ -1,0 +1,111 @@
+"""Ad-hoc differential fuzz: spec-mode grower vs sequential across random
+configs (the r4 close-out's fuzz-sweep pattern, pointed at the r5 grower).
+
+Each trial draws a random config (leaves, depth, bagging, feature fraction,
+regularization, monotone, categorical, missing density, EFB, weights,
+objective, learner) and trains twice — LIGHTGBM_TPU_GROW=seq vs spec — and
+compares model strings byte for byte. Near-ties can legitimately flip under
+different f32 chunk groupings, so a mismatch triggers a prediction-
+equivalence check before being counted as a failure.
+
+Run: JAX_PLATFORMS=cpu python helpers/fuzz_spec_grow.py [n_trials]
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def one_trial(i: int):
+    import jax
+
+    import lightgbm_tpu as lgb
+    import lightgbm_tpu.ops.grow as grow_mod
+
+    rng = np.random.RandomState(1000 + i)
+    n = int(rng.choice([700, 1500, 3000]))
+    f = int(rng.choice([5, 8, 12]))
+    X = rng.randn(n, f)
+    cat_cols = []
+    if rng.rand() < 0.4:
+        c = rng.randint(0, f)
+        X[:, c] = rng.randint(0, rng.randint(3, 20), n)
+        cat_cols = [c]
+    if rng.rand() < 0.5:
+        X[rng.rand(n, f) < rng.uniform(0.01, 0.2)] = np.nan
+    obj = rng.choice(["binary", "regression", "multiclass"])
+    if obj == "multiclass":
+        y = rng.randint(0, 3, n).astype(float)
+    elif obj == "binary":
+        y = (np.nan_to_num(X[:, 0] + 0.5 * X[:, 1]) + 0.2 * rng.randn(n) > 0).astype(float)
+    else:
+        y = np.nan_to_num(X[:, 0]) + 0.1 * rng.randn(n)
+    params = {
+        "objective": obj, "verbosity": -1,
+        "num_leaves": int(rng.choice([4, 15, 31, 63])),
+        "min_data_in_leaf": int(rng.choice([1, 5, 20])),
+        "learning_rate": float(rng.choice([0.05, 0.1, 0.3])),
+        "seed": int(rng.randint(0, 1000)),
+    }
+    if obj == "multiclass":
+        params["num_class"] = 3
+    if rng.rand() < 0.3:
+        params["max_depth"] = int(rng.randint(3, 8))
+    if rng.rand() < 0.3:
+        params.update(bagging_fraction=0.7, bagging_freq=1)
+    if rng.rand() < 0.3:
+        params["feature_fraction"] = 0.7
+    if rng.rand() < 0.3:
+        params.update(lambda_l1=0.2, lambda_l2=1.0)
+    if rng.rand() < 0.2:
+        params["min_gain_to_split"] = 0.01
+    if rng.rand() < 0.2 and obj == "regression":
+        mono = [0] * f
+        mono[0] = 1
+        params["monotone_constraints"] = mono
+    learner = rng.choice(["serial", "serial", "data"])
+    if learner != "serial":
+        params["tree_learner"] = learner
+    dskw = {}
+    if rng.rand() < 0.3:
+        dskw["weight"] = rng.rand(n) + 0.5
+    if cat_cols:
+        dskw["categorical_feature"] = cat_cols
+    rounds = int(rng.choice([2, 4]))
+
+    models = {}
+    for mode in ("seq", "spec"):
+        grow_mod._ENV_GROW = mode
+        jax.clear_caches()
+        bst = lgb.train(params, lgb.Dataset(X.copy(), label=y, **dict(dskw)), rounds)
+        models[mode] = bst
+    grow_mod._ENV_GROW = ""
+    s = models["seq"].model_to_string()
+    a = models["spec"].model_to_string()
+    if s == a:
+        return "exact"
+    p1 = models["seq"].predict(np.nan_to_num(X))
+    p2 = models["spec"].predict(np.nan_to_num(X))
+    if np.allclose(p1, p2, rtol=5e-3, atol=5e-4):
+        return "tie-flip"
+    print("FAIL trial %d params=%s dskw_keys=%s" % (i, params, list(dskw)))
+    return "FAIL"
+
+
+def main():
+    n_trials = int(sys.argv[1]) if len(sys.argv) > 1 else 30
+    counts = {}
+    for i in range(n_trials):
+        r = one_trial(i)
+        counts[r] = counts.get(r, 0) + 1
+        print("trial %d: %s  (totals %s)" % (i, r, counts), flush=True)
+    print("DONE", counts)
+    sys.exit(1 if counts.get("FAIL") else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -10,11 +10,16 @@ script runs, in order, each stage in its own killed-process-group subprocess
                    bench shapes, bf16 and f32 operands. The on-silicon
                    analogue of the reference GPU path's in-code cross-check
                    (/root/reference/src/treelearner/gpu_tree_learner.cpp:996-1019).
-  3. smoke       — 100k-row binary training (pow2 lattice to cap compile
-                   cost), train-AUC sanity vs the known CPU value (~0.74)
-  4. bench       — full bench.py on the env-default backend; result copied
-                   to BENCH_TPU.json so the number survives even if the
-                   relay dies again before the driver's end-of-round run.
+  3. smoke / smoke_seq — 100k-row binary training (pow2 lattice to cap
+                   compile cost) under the spec and sequential growers;
+                   train-AUC sanity vs the known CPU value (~0.74)
+  4. bench_early — full bench.py RIGHT AFTER the grower race (the relay
+                   has died mid-bringup in 3 of 4 rounds; the headline 1M
+                   number lands in BENCH_TPU.json before the measurement
+                   tail, already auto-adopting the better grower)
+  5. smoke_* variants + pack4 — the routing/precision bake-off
+  6. bench       — final full bench.py with the complete bake-off;
+                   overwrites BENCH_TPU.json on success only.
 
 Every stage appends a JSON line to .tpu_bringup.log and the final summary
 lands in TPU_BRINGUP.json. Run directly, or let the probe chain fire it:

@@ -37,8 +37,12 @@ def test_training_through_pallas_matches_fallback(monkeypatch):
     y = (np.nan_to_num(X[:, 0]) + 0.4 * np.nan_to_num(X[:, 1]) > 0).astype(float)
 
     # route every histogram through the pallas kernel in interpret mode, as
-    # if on TPU, counting invocations so the assertion below cannot pass
-    # vacuously off a cached XLA-only trace
+    # if LIGHTGBM_TPU_HIST_IMPL=pallas were set (since r5, TPU `auto` picks
+    # the XLA one-hot — the measured winner — so the kernel path is an
+    # explicit routing choice), counting invocations so the assertion below
+    # cannot pass vacuously off a cached XLA-only trace
+    import lightgbm_tpu.ops.histogram as hist_mod
+
     real = hist_pallas.histogram_pallas
     calls = {"n": 0}
 
@@ -48,6 +52,7 @@ def test_training_through_pallas_matches_fallback(monkeypatch):
         kwargs["interpret"] = True
         return real(*args, **kwargs)
 
+    monkeypatch.setattr(hist_mod, "_ENV_IMPL", "pallas")
     monkeypatch.setattr(hist_pallas, "supported", lambda *a, **k: True)
     monkeypatch.setattr(hist_pallas, "histogram_pallas", interp)
     # both jit caches may hold XLA-only traces from earlier tests with the
