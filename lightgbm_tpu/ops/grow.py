@@ -83,10 +83,22 @@ _ENV_SPLIT_IMPL = env_choice("LIGHTGBM_TPU_SPLIT_IMPL", ("pallas",))
 _ENV_GROW = env_choice("LIGHTGBM_TPU_GROW", ("spec", "seq"))
 _ENV_SPEC_K = env_int("LIGHTGBM_TPU_SPEC_K", 8, lo=2, hi=64)
 
-# which mode the most recent grow_tree TRACE resolved to ("spec"/"seq") —
-# set at trace time, so only meaningful right after a cache-cleared call;
-# tests use it to prove the speculative path actually engaged
+# Spec-mode batched-histogram form: "flat" (one concatenated chunk-aligned
+# pass — arithmetic ∝ total segment rows) vs "lanes" (vmapped common-max
+# lanes — arithmetic ∝ KB x max segment, ~3.4x the sequential row work in
+# the r5 batch study). Default: flat whenever the effective histogram impl
+# is the XLA one-hot (the r5 TPU default), because flat's fixed chunk
+# boundaries then make it BITWISE equal to the per-slot path; under the
+# scatter/pallas impls the groupings differ, so lanes (which reuse the
+# impl verbatim per lane) keep exactness.
+_ENV_SPEC_HIST = env_choice("LIGHTGBM_TPU_SPEC_HIST", ("flat", "lanes"))
+
+# which mode the most recent grow_tree TRACE resolved to ("spec"/"seq"),
+# and which batched-histogram form ("flat"/"lanes") — set at trace time, so
+# only meaningful right after a cache-cleared call; tests use these to
+# prove the intended path actually engaged
 _LAST_GROW_MODE = None
+_LAST_SPEC_HIST = None
 
 
 class TreeArrays(NamedTuple):
@@ -221,11 +233,12 @@ class GrowState(NamedTuple):
     spec_rhist: jax.Array  # [M, F, B, 3] cached right-child histograms
 
 
-def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat, member):
+def _decision_go_left(col, threshold, default_left, missing_type, default_bin, nan_bin, is_cat, member_val):
     """Bin-space split decision (dense_bin.hpp Split / CategoricalDecisionInner).
 
-    ``member`` is the split's [B]-bool left-side bin membership (covers both
-    one-hot and CTR-sorted bitset splits); categorical decisions are a pure
+    ``member_val`` is the split's left-side membership ALREADY LOOKED UP at
+    ``col`` (the caller gathers from its [B]-bool bitset — per-segment, per
+    vmapped lane, or per flat row); categorical decisions are that pure
     bitset lookup — no default-direction logic (tree.h:275).
     """
     go_left = col <= threshold
@@ -233,7 +246,7 @@ def _decision_go_left(col, threshold, default_left, missing_type, default_bin, n
     is_nan_missing = missing_type == MISSING_NAN
     go_left = jnp.where(is_zero_missing & (col == default_bin), default_left, go_left)
     go_left = jnp.where(is_nan_missing & (col == nan_bin), default_left, go_left)
-    go_left = jnp.where(is_cat, member[col], go_left)
+    go_left = jnp.where(is_cat, member_val, go_left)
     return go_left
 
 
@@ -395,8 +408,16 @@ def grow_tree(
     KB = min(KB, M - 1) if spec_ok else 0
     if KB < 2:
         KB = 0
-    global _LAST_GROW_MODE  # trace-time introspection for tests
+    if _ENV_SPEC_HIST:
+        use_flat = _ENV_SPEC_HIST == "flat"
+    else:
+        from .histogram import _ENV_IMPL as _hist_env
+
+        eff_impl = _hist_env or ("xla" if _default_backend() == "tpu" else "")
+        use_flat = eff_impl == "xla"
+    global _LAST_GROW_MODE, _LAST_SPEC_HIST  # trace-time test introspection
     _LAST_GROW_MODE = "spec" if KB else "seq"
+    _LAST_SPEC_HIST = ("flat" if use_flat else "lanes") if KB else None
 
     num_bin_arr = feature_meta["num_bin"].astype(jnp.int32)
     missing_arr = feature_meta["missing_type"].astype(jnp.int32)
@@ -473,102 +494,126 @@ def grow_tree(
             }
         SIZES = sorted(sizes | {N})
         sizes_arr = jnp.asarray(SIZES, jnp.int32)
+        def _branch_steps(cap: int):
+            """Branch-size family up to ``cap``, honoring the same
+            LIGHTGBM_TPU_LATTICE compile-cost knob as the bucket lattice:
+            branches execute ALL their lanes, so the default {2^k, 3*2^(k-1)}
+            family caps round-up waste at 33% (pure powers of two allow 2x),
+            while pow2/coarse trade waste for fewer compiled branches."""
+            fam = set()
+            k = 0
+            while (1 << k) < cap * 2:
+                if _ENV_LATTICE != "coarse" or k % 2 == 0:
+                    fam.add(1 << k)
+                if _ENV_LATTICE == "":
+                    fam.add(3 << k)
+                k += 1
+            return sorted({min(v, cap) for v in fam} | {cap})
 
-    def _segment_slice(order, begin, cnt, S):
-        """Gathered segment of `order` of static size S >= cnt, with validity.
-
-        dynamic_slice clamps the start when begin+S > N, so the segment may
-        carry rows of neighboring leaves on either side; `valid` marks exactly
-        the [begin, begin+cnt) positions."""
-        start = jnp.clip(begin, 0, max(N - S, 0))
-        off = begin - start
-        seg = jax.lax.dynamic_slice(order, (start,), (S,))
-        pos = jnp.arange(S, dtype=jnp.int32)
-        valid = (pos >= off) & (pos < off + cnt)
-        return start, off, seg, pos, valid
+        # flat-partition branch lattice over 256-row units, up to the worst
+        # case (every row plus per-slot 256-alignment)
+        _part_cap = -(-N // 256) * 256 + max(KB, 1) * 256
+        _part_sizes = [
+            u * 256 for u in _branch_steps(-(-_part_cap // 256))
+        ]
+        _part_sizes_arr = jnp.asarray(_part_sizes, jnp.int32)
 
     def partition_batch(order, begin, pcnt, feat, thr, dleft, member):
-        """Stably partition W disjoint leaf segments in one lattice-switch
-        launch; returns (new order, left physical counts [W]). The W axis is
+        """Stably partition W disjoint leaf segments in ONE flat segmented
+        pass; returns (new order, left physical counts [W]). The W axis is
         the leading axis of every operand; W=1 is the sequential grower's
         per-split partition, W=KB a speculative batch — one implementation,
-        so the two modes cannot drift.
+        so the two modes cannot drift, and arithmetic is proportional to the
+        segments' TOTAL rows (a vmapped common-max form would pay
+        W x max(segment)).
 
         Layout after a partition (DataPartition::Split, data_partition.hpp:111):
-        [pre-segment | left | right | post-segment], stably, via prefix-sum
-        ranks — O(S) scatter instead of an O(S log S) stable sort. Integer-
-        exact and idempotent: re-partitioning an already-partitioned segment
-        yields the same layout, so work done for a speculated-but-unapplied
-        split stays valid when that leaf wins later."""
+        [pre-segment | left | right | post-segment], stably, via a segmented
+        prefix-sum rank — O(L) scatter instead of an O(L log L) stable sort.
+        Integer-exact and idempotent: re-partitioning an already-partitioned
+        segment yields the same layout, so work done for a speculated-but-
+        unapplied split stays valid when that leaf wins later."""
         W = begin.shape[0]
         miss = missing_arr[feat]
         dbin = default_bin_arr[feat]
         nanb = num_bin_arr[feat] - 1
         iscat = is_cat_arr[feat]
-        rows = gid_arr[feat] if bundled else feat
-        slot_iota = jnp.arange(W, dtype=jnp.int32)
+        rows_of = (gid_arr[feat] if bundled else feat).astype(jnp.int32)
+        Frows = bins.shape[0]
 
-        def make_branch(S):
-            def branch(order, begin, pcnt, rows, feat, thr, dleft, miss,
-                       dbin, nanb, iscat, member):
-                def one(begin_j, pcnt_j, row_j, f_j, thr_j, dl_j, miss_j,
-                        dbin_j, nanb_j, iscat_j, member_j, slot_j):
-                    start, off, seg, pos, valid = _segment_slice(
-                        order, begin_j, pcnt_j, S
-                    )
-                    colraw = (
-                        bins_nf[seg, row_j]  # [N, F]: contiguous row gathers
-                        if bins_nf is not None
-                        else bins[row_j, seg]
-                    ).astype(jnp.int32)
-                    colv = decode_col(colraw, f_j) if bundled else colraw
-                    gl = _decision_go_left(
-                        colv, thr_j, dl_j, miss_j, dbin_j, nanb_j, iscat_j,
-                        member_j,
-                    )
-                    is_left = valid & gl
-                    is_right = valid & ~gl
-                    # int ranks: associative_scan reassociation is exact for
-                    # ints. One scan suffices: the segment is contiguous, so
-                    # a right element's rank among rights is (in-segment
-                    # position) minus (lefts before it).
-                    left_rank = jax.lax.associative_scan(
-                        jnp.add, is_left.astype(jnp.int32)
-                    ) - 1
-                    left_cnt = left_rank[-1] + 1
-                    tgt = jnp.where(
-                        is_left,
-                        off + left_rank,
-                        jnp.where(
-                            is_right, left_cnt + pos - left_rank - 1, pos
-                        ),
-                    )
-                    # invalid lanes get DISTINCT out-of-range targets
-                    # (scatter drops them; keeps unique_indices honest)
-                    gt = jnp.where(valid, start + tgt, N + slot_j * S + pos)
-                    return seg, gt, left_cnt
+        padded = ((pcnt + 255) // 256) * 256  # [W]
+        ends = jnp.cumsum(padded)
+        offs = ends - padded
+        L = ends[-1]
 
-                seg, gt, left_cnt = jax.vmap(one)(
-                    begin, pcnt, rows, feat, thr, dleft, miss, dbin, nanb,
-                    iscat, member, slot_iota,
+        def make_branch(Lb):
+            def branch(order, begin, pcnt, offs, ends, rows_of, feat, thr,
+                       dleft, miss, dbin, nanb, iscat, member):
+                t = jnp.arange(Lb, dtype=jnp.int32)
+                j = jnp.minimum(
+                    jnp.searchsorted(ends, t, side="right").astype(jnp.int32),
+                    W - 1,
                 )
-                # in-segment targets are disjoint across slots (disjoint
-                # leaves), so ONE scatter commits every partition
-                order2 = order.at[gt.reshape(-1)].set(
-                    seg.reshape(-1), unique_indices=True
+                q = t - offs[j]
+                valid = q < pcnt[j]
+                src = jnp.clip(
+                    begin[j] + jnp.minimum(q, jnp.maximum(pcnt[j] - 1, 0)),
+                    0, N - 1,
                 )
+                rows = order[src]
+                # per-row feature column through ONE flat gather (each row's
+                # slot picks its own split feature)
+                flat_idx = rows_of[j] * N + rows
+                colraw = (
+                    jnp.take(bins_nf.reshape(-1), rows * Frows + rows_of[j])
+                    if bins_nf is not None
+                    else jnp.take(bins.reshape(-1), flat_idx)
+                ).astype(jnp.int32)
+                colv = decode_col(colraw, feat[j]) if bundled else colraw
+                gl = _decision_go_left(
+                    colv, thr[j], dleft[j], miss[j], dbin[j], nanb[j],
+                    iscat[j], member[j, jnp.clip(colv, 0, B - 1)],
+                )
+                is_left = valid & gl
+                is_right = valid & ~gl
+                # segmented inclusive count of lefts (resets at slot starts);
+                # int adds are reassociation-exact
+                seg_start = t == offs[j]
+
+                def comb(a, b):
+                    av, af = a
+                    bv, bf = b
+                    return jnp.where(bf, bv, av + bv), af | bf
+
+                lc_inc, _ = jax.lax.associative_scan(
+                    comb, (is_left.astype(jnp.int32), seg_start)
+                )
+                # lefts per slot = inclusive count at the slot's last lane
+                # (pad lanes contribute 0); zero-width slots read a stale
+                # lane and are masked to 0
+                left_cnt = jnp.where(
+                    padded > 0, lc_inc[jnp.maximum(ends - 1, 0)], 0
+                )
+                tgt_local = jnp.where(
+                    is_left,
+                    lc_inc - 1,
+                    left_cnt[j] + q - lc_inc,
+                )
+                write = is_left | is_right
+                gt = jnp.where(write, begin[j] + tgt_local, N + t)
+                order2 = order.at[gt].set(rows, unique_indices=True)
                 return order2, left_cnt
 
             return branch
 
         idx = jnp.clip(
-            jnp.searchsorted(sizes_arr, jnp.max(pcnt), side="left"),
-            0, len(SIZES) - 1,
+            jnp.searchsorted(_part_sizes_arr, L, side="left"),
+            0, len(_part_sizes) - 1,
         )
         return jax.lax.switch(
-            idx, [make_branch(S) for S in SIZES],
-            order, begin, pcnt, rows, feat, thr, dleft, miss, dbin, nanb,
-            iscat, member,
+            idx, [make_branch(Lb) for Lb in _part_sizes],
+            order, begin, pcnt, offs, ends, rows_of, feat, thr, dleft, miss,
+            dbin, nanb, iscat, member,
         )
 
     def partition_segment(order, begin, pcnt, f, threshold, default_left, member):
@@ -597,10 +642,13 @@ def grow_tree(
         def make_branch(S):
             def branch(order, begin, cnt):
                 def geo(begin_j, cnt_j):
-                    _, _, seg, _, valid = _segment_slice(
-                        order, begin_j, cnt_j, S
-                    )
-                    return seg, valid
+                    # zero-based (NOT the clamped _segment_slice window):
+                    # real rows sit at positions [0, cnt) so chunk
+                    # boundaries are segment-relative — the invariant that
+                    # makes the flat batched form bitwise-identical
+                    pos = jnp.arange(S, dtype=jnp.int32)
+                    seg = order[jnp.clip(begin_j + pos, 0, N - 1)]
+                    return seg, pos < cnt_j
 
                 seg, valid = jax.vmap(geo)(begin, cnt)  # [W, S]
                 flat = seg.reshape(-1)
@@ -634,6 +682,91 @@ def grow_tree(
     def segment_histogram(order, begin, cnt):
         """One segment's histogram — the W=1 case of the batch launch."""
         return segment_histogram_batch(order, begin[None], cnt[None])[0]
+
+    if KB:
+        from .histogram import _pick_chunk, onehot_chunk_partial
+
+        # flat-chunk batching constants: every slot is padded to a multiple
+        # of the SAME chunk the per-slot path would use (the F/B budget cap,
+        # un-shrunk by segment size), so chunk boundaries — and therefore
+        # f32 accumulation grouping — coincide with the sequential path's,
+        # and zero-valued pad lanes are fp-exact no-ops (x + 0 == x): the
+        # batched histogram is BITWISE equal to per-slot histograms.
+        _Frows = bins.shape[0]
+        C_FLAT = _pick_chunk(_Frows, B_hist, chunk, 1 << 60)
+        # branch lattice over the flat buffer's CHUNK COUNT (so every branch
+        # length is an exact C_FLAT multiple) up to the cap (L = N rows +
+        # per-slot alignment), honoring LIGHTGBM_TPU_LATTICE like the rest
+        _flat_sizes = [
+            n * C_FLAT for n in _branch_steps(-(-N // C_FLAT) + KB)
+        ]
+        _flat_sizes_arr = jnp.asarray(_flat_sizes, jnp.int32)
+
+        def segment_histogram_flat(order, begin, cnt):
+            """[KB, F, B, 3] histograms of KB disjoint segments via ONE flat
+            concatenated pass — unlike the vmapped-lane form, arithmetic is
+            proportional to the segments' TOTAL padded rows, not
+            KB x max(segment): the r5 batch-structure study measured the
+            lane form at ~3.4x the sequential row work and this at ~1.06x.
+
+            Layout: slot j owns flat rows [off_j, off_j + ceil_C(cnt_j));
+            each C_FLAT-chunk lies inside exactly one slot, so a chunked
+            one-hot scan attributes each partial to its slot row with one
+            dynamic-index add."""
+            padded = ((cnt + C_FLAT - 1) // C_FLAT) * C_FLAT  # [KB]
+            ends = jnp.cumsum(padded)  # [KB]
+            offs = ends - padded
+            L = ends[-1]
+
+            def make_branch(Lb):
+                nsteps = Lb // C_FLAT
+
+                def branch(order, begin, cnt, offs, ends):
+                    t = jnp.arange(Lb, dtype=jnp.int32)
+                    j = jnp.searchsorted(ends, t, side="right").astype(jnp.int32)
+                    j = jnp.minimum(j, KB - 1)
+                    q = t - offs[j]
+                    valid = q < cnt[j]
+                    src = jnp.clip(begin[j] + jnp.minimum(q, jnp.maximum(cnt[j] - 1, 0)), 0, N - 1)
+                    rows = order[src]
+                    vals = jnp.take(vals_all, rows, axis=0) * valid[:, None].astype(f32)
+                    b_seg = (
+                        jnp.take(bins_nf, rows, axis=0).T
+                        if bins_nf is not None
+                        else jnp.take(bins, rows, axis=1)
+                    )  # [Frows, Lb]
+                    slot_of_chunk = jnp.searchsorted(
+                        ends, jnp.arange(nsteps, dtype=jnp.int32) * C_FLAT,
+                        side="right",
+                    ).astype(jnp.int32)
+                    slot_of_chunk = jnp.minimum(slot_of_chunk, KB - 1)
+                    bins_c = b_seg.reshape(_Frows, nsteps, C_FLAT).transpose(1, 0, 2)
+                    vals_c = vals.reshape(nsteps, C_FLAT, 3)
+                    op_dtype = (
+                        jnp.bfloat16 if hist_dtype == "bfloat16" else jnp.float32
+                    )
+
+                    def step(acc, xs):
+                        bc, vc, sl = xs  # [Frows, C], [C, 3], scalar
+                        part = onehot_chunk_partial(bc, vc, B_hist, op_dtype)
+                        return acc.at[sl].add(part), None
+
+                    acc0 = jnp.zeros((KB, _Frows, B_hist, 3), f32)
+                    acc, _ = jax.lax.scan(
+                        step, acc0, (bins_c, vals_c, slot_of_chunk)
+                    )
+                    return acc
+
+                return branch
+
+            idx = jnp.clip(
+                jnp.searchsorted(_flat_sizes_arr, L, side="left"),
+                0, len(_flat_sizes) - 1,
+            )
+            return jax.lax.switch(
+                idx, [make_branch(Lb) for Lb in _flat_sizes],
+                order, begin, cnt, offs, ends,
+            )
 
     coupled_arr = feature_meta.get("cegb_coupled")
     lazy_arr = feature_meta.get("cegb_lazy")
@@ -980,7 +1113,7 @@ def grow_tree(
                 default_bin_arr[f],
                 num_bin_arr[f] - 1,
                 is_cat_arr[f],
-                rec.cat_bitset,
+                rec.cat_bitset[jnp.clip(col, 0, B - 1)],
             )
             in_leaf = s.leaf_id == best_leaf
             leaf_id = jnp.where(in_leaf & ~go_left, new_leaf, s.leaf_id)
@@ -1362,7 +1495,9 @@ def grow_tree(
         small_cnt = jnp.where(
             compute, jnp.where(left_smaller, left_phys, right_phys), 0
         )
-        small_hist = segment_histogram_batch(order2, small_begin, small_cnt)
+        small_hist = (
+            segment_histogram_flat if use_flat else segment_histogram_batch
+        )(order2, small_begin, small_cnt)
         if hist_axis is not None:
             # ONE collective for the whole batch (vs one per split)
             small_hist = jax.lax.psum(small_hist, hist_axis)

@@ -254,24 +254,31 @@ def leaf_histogram(
     bins_c = bins.reshape(F, n_chunks, C).transpose(1, 0, 2)  # [n, F, C]
     vals_c = values.reshape(n_chunks, C, K)  # [n, C, K]
 
-    iota = jnp.arange(B, dtype=jnp.int32)
-
     def body(acc, inputs):
         b, v = inputs  # [F, C], [C, K]
-        onehot = (b.astype(jnp.int32)[:, :, None] == iota[None, None, :]).astype(op_dtype)
-        # [F, C, B] x [C, K] -> [F, B, K]; f32 accumulate on MXU
-        # contract the C axis: [F, C, B] . [C, K] -> [F, B, K]
-        acc = acc + jax.lax.dot_general(
-            onehot,
-            v.astype(op_dtype),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return acc, None
+        return acc + onehot_chunk_partial(b, v, B, op_dtype), None
 
     init = jnp.zeros((F, B, K), dtype=jnp.float32)
     hist, _ = jax.lax.scan(body, init, (bins_c, vals_c))
     return _combine(hist, axis_name)
+
+
+def onehot_chunk_partial(b, v, num_bins, op_dtype=jnp.float32):
+    """One chunk's one-hot contraction: [F, C] bins x [C, K] values ->
+    [F, B, K] partial histogram, f32-accumulated on the MXU.
+
+    THE shared accumulation body of the XLA one-hot impl above and the
+    spec-mode flat batched histogram (ops/grow.py segment_histogram_flat):
+    the flat path's bitwise-equality-with-sequential guarantee requires the
+    two to be byte-identical, so there is exactly one copy."""
+    iota = jnp.arange(num_bins, dtype=jnp.int32)
+    onehot = (b.astype(jnp.int32)[:, :, None] == iota[None, None, :]).astype(op_dtype)
+    return jax.lax.dot_general(
+        onehot,
+        v.astype(op_dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
 
 
 def leaf_values(

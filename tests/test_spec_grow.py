@@ -166,3 +166,25 @@ def test_spec_k_clamped_small_trees(spec_env):
         spec_env, dict(objective="binary", num_leaves=4), X, y
     )
     assert base.model_to_string() == spec.model_to_string()
+
+
+def test_spec_flat_batching_exact_under_onehot_impl(spec_env, monkeypatch):
+    """The flat concatenated batched histogram (the TPU default, where the
+    effective impl is the XLA one-hot) must stay BITWISE equal to the
+    sequential grower: slots align to the same budget-derived chunk the
+    per-slot path uses, and zero pads are fp no-ops."""
+    import lightgbm_tpu.ops.histogram as hist_mod
+
+    monkeypatch.setattr(hist_mod, "_ENV_IMPL", "xla")
+    X, y = _data(seed=23, n=5000)
+    params = dict(objective="binary", num_leaves=63, min_data_in_leaf=5,
+                  verbosity=-1)
+    spec_env("seq")
+    base = lgb.train(params, lgb.Dataset(X, label=y), 3)
+    spec_env("spec")
+    monkeypatch.setattr(grow_mod, "_ENV_SPEC_HIST", "flat")
+    jax.clear_caches()
+    flat = lgb.train(params, lgb.Dataset(X, label=y), 3)
+    assert grow_mod._LAST_SPEC_HIST == "flat", "flat batching never engaged"
+    monkeypatch.setattr(grow_mod, "_ENV_SPEC_HIST", "")
+    assert base.model_to_string() == flat.model_to_string()
