@@ -130,13 +130,17 @@ def _probe_once(platforms, probe_timeout_s: float):
 def _choose_platform(probe_timeout_s: float, probe_deadline: float = float("inf")):
     """Find a JAX backend that actually initializes, without risking a hang.
 
-    Tries, in order: the environment as-is (TPU via the axon tunnel when it
-    works), auto-select, cpu. Each probe runs in a subprocess under a timeout
-    so a wedged backend init cannot take this process down with it.
+    Tries, in order: an explicit BENCH_FORCE_PLATFORMS pin (operator or
+    bringup-rehearsal override), the environment as-is (TPU via the axon
+    tunnel when it works), auto-select, cpu. Each probe runs in a subprocess
+    under a timeout so a wedged backend init cannot take this process down
+    with it.
 
     Returns (platforms_override_or_None, platform_name).
     """
-    for platforms in (None, "", "cpu"):
+    pinned = os.environ.get("BENCH_FORCE_PLATFORMS")
+    attempts = (pinned,) if pinned else (None, "", "cpu")
+    for platforms in attempts:
         desc = "<env default>" if platforms is None else platforms
         t0 = time.time()
         # cumulative budget: each probe may use at most the time left before
@@ -294,6 +298,8 @@ def _adopt_from_bringup(platform, stages=None):
 
     def rate(name):
         st = stages.get(name, {})
+        if st.get("platform") not in ("tpu", "axon"):
+            return None  # never adopt off-chip rates (e.g. a CPU rehearsal)
         return st["iters_per_sec"] if st.get("ok") and "iters_per_sec" in st else None
 
     base_auc = stages.get("smoke", {}).get("train_auc_11_iters")

@@ -40,7 +40,13 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, ".tpu_bringup.log")
-SUMMARY = os.path.join(REPO, "TPU_BRINGUP.json")
+_REHEARSAL = os.environ.get("LIGHTGBM_TPU_BRINGUP_CPU") == "1"
+# a CPU rehearsal must never write the production summary: bench.py's
+# bake-off adoption reads TPU_BRINGUP.json, and CPU-measured smoke rates
+# would steer a later REAL chip window to the wrong config
+SUMMARY = os.path.join(
+    REPO, "TPU_BRINGUP_REHEARSAL.json" if _REHEARSAL else "TPU_BRINGUP.json"
+)
 
 STAGE_TIMEOUTS = {
     "matmul": 180,
@@ -61,6 +67,14 @@ import os, sys, time, json
 import numpy as np
 os.environ.setdefault("JAX_PLATFORMS", "axon")
 import jax
+if os.environ.get("LIGHTGBM_TPU_BRINGUP_CPU") == "1":
+    # dress-rehearsal mode: XLA compute stages run on the CPU backend (the
+    # env var alone is not enough — this machine's sitecustomize re-pins
+    # the axon platform via jax.config.update at interpreter start); the
+    # Mosaic kernel stages (pallas/pack4/smoke_pallas) cannot lower on CPU
+    # and rehearse only their fail-and-continue path
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
 jax.config.update("jax_compilation_cache_dir", %r)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 import jax.numpy as jnp
@@ -323,10 +337,19 @@ def run_stage(stage: str, src: str) -> dict:
 def run_bench(stage: str = "bench") -> dict:
     env = dict(os.environ)
     env.pop("BENCH_FORCE_PLATFORMS", None)
+    if _REHEARSAL:
+        # pin the bench to CPU outright: probing the axon backend would
+        # burn ~20 min against a dead relay — or run the scarce REAL chip
+        # from inside a rehearsal if the relay happens to be up
+        env["BENCH_FORCE_PLATFORMS"] = "cpu"
+        env.setdefault("BENCH_PROBE_TIMEOUT_S", "60")
     env["BENCH_TIMEOUT_S"] = str(STAGE_TIMEOUTS[stage] - 120)
     result = _run_child(stage, [sys.executable, os.path.join(REPO, "bench.py")], env=env)
     result.setdefault("ok", result.get("value", 0) > 0)
-    if "metric" in result:
+    if "metric" in result and result.get("platform") in ("tpu", "axon"):
+        # platform-guarded: a half-up tunnel can make bench fall back to
+        # CPU, and a CPU record must never overwrite the on-chip evidence
+        # (BENCH_TPU.json is the durable proof a chip run ever happened)
         with open(os.path.join(REPO, "BENCH_TPU.json"), "w") as f:
             json.dump({"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                        **{k: v for k, v in result.items() if k not in ("ok", "wall_s")}}, f)

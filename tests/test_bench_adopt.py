@@ -28,8 +28,8 @@ def _knob_sandbox():
             os.environ[k] = v
 
 
-def _st(rate, auc=0.74, ok=True):
-    d = {"ok": ok}
+def _st(rate, auc=0.74, ok=True, platform="tpu"):
+    d = {"ok": ok, "platform": platform}
     if rate is not None:
         d["iters_per_sec"] = rate
         d["train_auc_11_iters"] = auc
@@ -92,6 +92,17 @@ def test_failed_stages_skipped():
 def test_cpu_platform_never_adopts():
     pars, rec = bench._adopt_from_bringup("cpu", {"smoke_seq": _st(3.0)})
     assert rec is None and pars == {}
+
+
+def test_cpu_measured_stages_never_adopted():
+    """A dress-rehearsal summary (stages measured on CPU) must not steer a
+    real chip window: off-chip rates are invisible to adoption."""
+    stages = {
+        "smoke": _st(2.0),
+        "smoke_seq": _st(9.0, platform="cpu"),  # CPU rate: ignored
+    }
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert rec["winner"] == "smoke"
 
 
 def test_preset_env_knob_blocks_adoption():
