@@ -56,3 +56,14 @@ def test_timeloop_protocol_in_common():
     assert "def timeloop" in tb._COMMON
     # 2 uses of the trailing-fetch idiom inside timeloop itself
     assert tb._COMMON.count("float(jnp.ravel(acc)[0])") == 2
+
+
+def test_rehearsal_mode_is_isolated():
+    """Dress-rehearsal mode must not be able to pollute the production
+    adoption inputs: the knob pins CPU inside every stage prelude, and the
+    summary filename switches away from TPU_BRINGUP.json."""
+    assert 'LIGHTGBM_TPU_BRINGUP_CPU' in tb._COMMON
+    assert 'jax.config.update("jax_platforms", "cpu")' in tb._COMMON
+    src = open(tb.__file__).read()
+    assert 'TPU_BRINGUP_REHEARSAL.json' in src
+    assert 'BENCH_FORCE_PLATFORMS"] = "cpu"' in src
