@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Pre-merge gate: static analysis first (cheap, seconds), then the test
+# suite. Mirrors what tier-1 enforces — tests/test_graftlint.py re-runs the
+# graftlint baseline check inside pytest — but fails faster when the lint
+# gate is the problem.
+#
+# Usage:
+#   helpers/check.sh            # graftlint + ruff/mypy (if installed) + tier-1
+#   helpers/check.sh --quick    # same lint gate, then the quick pytest tier
+#   helpers/check.sh --lint     # lint gate only, no pytest
+#
+# ruff/mypy are optional: the container may not ship them (no network
+# installs); when absent they are skipped with a notice — graftlint and
+# pytest are the hard gate either way.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+case "$MODE" in
+    full|--quick|--lint) ;;
+    *)
+        echo "check.sh: unknown mode '$MODE' (expected --quick or --lint)" >&2
+        exit 2
+        ;;
+esac
+fail=0
+
+echo "== graftlint (lightgbm_tpu/ against baseline) =="
+python -m tools.graftlint lightgbm_tpu/ || fail=1
+
+echo "== graftlint (tools/ + helpers/, no baseline) =="
+python -m tools.graftlint --no-baseline tools/ helpers/ || fail=1
+
+if python -m ruff --version >/dev/null 2>&1; then
+    echo "== ruff =="
+    python -m ruff check lightgbm_tpu/ tools/ helpers/ tests/ || fail=1
+else
+    echo "== ruff not installed; skipping (config in pyproject.toml) =="
+fi
+
+if python -m mypy --version >/dev/null 2>&1; then
+    echo "== mypy (strict zone: lightgbm_tpu/utils, tools) =="
+    python -m mypy || fail=1
+else
+    echo "== mypy not installed; skipping (config in pyproject.toml) =="
+fi
+
+if [ "$fail" -ne 0 ]; then
+    echo "check.sh: lint gate FAILED (fix or baseline with justification)"
+    exit 1
+fi
+
+if [ "$MODE" = "--lint" ]; then
+    echo "check.sh: lint gate clean"
+    exit 0
+fi
+
+if [ "$MODE" = "--quick" ]; then
+    MARK='quick and not slow'
+else
+    MARK='not slow'
+fi
+
+echo "== pytest (-m \"$MARK\") =="
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$MARK" \
+    --continue-on-collection-errors -p no:cacheprovider

@@ -442,7 +442,9 @@ class GBDT:
                 self.models.append(None)  # lazily converted
                 try:
                     nl_dev.copy_to_host_async()
-                except Exception:
+                except AttributeError:
+                    # plain numpy / non-jax arrays have no async copy; the
+                    # blocking int() in _consume_pending_stop still works
                     pass
                 pending.append((nl_dev, k, init_scores[k]))
             else:

@@ -45,6 +45,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..utils.platform import env_choice, env_int
 from .histogram import _default_backend, leaf_histogram, leaf_values
@@ -255,6 +256,10 @@ def _ceil_log2(n: int) -> int:
 
 
 MIN_BUCKET_LOG2 = 8  # smallest gathered-segment bucket (256 rows)
+
+# node_i column indices for apply_split's fused 6-element scatter (numpy so
+# the module builds it once without touching the jax backend at import)
+_NODE_I_COLS = np.array([0, 1, 2, 3, 2, 3], np.int32)
 
 
 @functools.partial(
@@ -1140,7 +1145,7 @@ def grow_tree(
         # older nodes), and the write-off row M-1 exceeds every node index
         node_i = t.node_i.at[
             jnp.stack([node, node, node, node, prow, prow]),
-            jnp.asarray([0, 1, 2, 3, 2, 3]),
+            _NODE_I_COLS,
         ].set(
             jnp.stack([
                 f, rec.threshold, -(best_leaf + 1), -(new_leaf + 1),

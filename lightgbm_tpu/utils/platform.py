@@ -50,10 +50,17 @@ def force_cpu_devices(n_devices: int = 1):
 
     try:
         jax.config.update("jax_platforms", "cpu")
-        if n_devices > 1:
-            jax.config.update("jax_num_cpu_devices", n_devices)
     except (RuntimeError, ValueError):
         pass  # backend already up — caller's assert on len(devices) decides
+    if n_devices > 1:
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except AttributeError:
+            # pre-0.9 jax has no jax_num_cpu_devices; the XLA_FLAGS
+            # host-platform-device-count knob set above covers it
+            pass
+        except (RuntimeError, ValueError):
+            pass  # backend already up
     return jax
 
 

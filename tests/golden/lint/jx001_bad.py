@@ -1,0 +1,21 @@
+"""JX001 positive: host-device syncs inside jit functions."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def loss_scalar(x):
+    return float(x.sum())  # JX001: float() on traced value
+
+
+@functools.partial(jax.jit, static_argnames=("scale",))
+def to_host(x, scale):
+    return np.asarray(x) * scale  # JX001: np.asarray on traced value
+
+
+@jax.jit
+def first_item(x):
+    return x[0].item()  # JX001: .item() inside jit

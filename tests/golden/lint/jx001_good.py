@@ -1,0 +1,22 @@
+"""JX001 negative: static-arg conversions and host-side syncs are fine."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("hist_pool_slots",))
+def pooled(x, hist_pool_slots):
+    slots = int(hist_pool_slots)  # static arg: a Python int, no sync
+    return x * slots
+
+
+@jax.jit
+def shape_math(x):
+    n = int(x.shape[0] * 2)  # .shape is static metadata, not a traced value
+    return x.reshape(n // 2)
+
+
+def host_side(x):
+    return float(np.asarray(x).sum())  # not jitted: syncing is the point

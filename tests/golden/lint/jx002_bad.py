@@ -1,0 +1,17 @@
+"""JX002 positive: Python control flow on traced values."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x, lo):
+    if x.sum() > lo:  # JX002: if on traced value
+        return jnp.minimum(x, lo)
+    return x
+
+
+@jax.jit
+def drain(x):
+    while x > 0:  # JX002: while on traced value
+        x = x - 1
+    return x

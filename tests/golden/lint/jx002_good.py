@@ -1,0 +1,23 @@
+"""JX002 negative: static/structure conditions and lax control flow."""
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnames=("impl",))
+def routed(x, impl, scratch: Optional[jax.Array] = None):
+    if impl == "xla":  # static arg: trace-time routing, fine
+        x = x * 2
+    if scratch is not None:  # pytree-structure guard, fine
+        x = x + scratch
+    if x.shape[0] > 4:  # shape metadata is static, fine
+        x = x[:4]
+    return x
+
+
+@jax.jit
+def drain(x):
+    return lax.while_loop(lambda v: v > 0, lambda v: v - 1, x)  # the fix

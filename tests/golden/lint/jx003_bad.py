@@ -1,0 +1,13 @@
+"""JX003 positive: device constants rebuilt inside function bodies."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scatter_cols(t):
+    cols = jnp.asarray([0, 1, 2, 3, 2, 3])  # JX003: rebuilt every trace
+    return t[cols]
+
+
+def weights():
+    return jnp.array([0.25, 0.5, 0.25])  # JX003: rebuilt every call

@@ -1,0 +1,19 @@
+"""JX003 negative: module-level constants, runtime values, scalar wraps."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# module level: built once at import (numpy keeps the backend untouched)
+_COLS = np.array([0, 1, 2, 3, 2, 3], np.int32)
+
+
+@jax.jit
+def scatter_cols(t):
+    return t[_COLS]
+
+
+@jax.jit
+def from_runtime(sizes, flag):
+    arr = jnp.asarray(sizes)  # runtime value, not a literal
+    pred = jnp.asarray(False)  # scalar wrap for lax.cond: no build cost
+    return jax.lax.cond(pred, lambda: arr, lambda: arr * 2)

@@ -1,0 +1,15 @@
+"""JX004 positive: mutable defaults on public API functions."""
+
+
+def train(params, callbacks=[]):  # JX004: shared list across calls
+    callbacks.append("log")
+    return params, callbacks
+
+
+def predict(data, *, extra={}):  # JX004: shared dict across calls
+    return data, extra
+
+
+def load(path, seen=set()):  # JX004: shared set across calls
+    seen.add(path)
+    return seen

@@ -1,0 +1,15 @@
+"""JX004 negative: None defaults and immutable defaults."""
+
+
+def train(params, callbacks=None):
+    callbacks = list(callbacks) if callbacks is not None else []
+    callbacks.append("log")
+    return params, callbacks
+
+
+def predict(data, *, extra=None, shape=(1, 2)):  # tuple default is immutable
+    return data, extra or {}, shape
+
+
+def _helper(acc=[]):  # private helper: exempt by policy
+    return acc

@@ -1,0 +1,15 @@
+"""JX005 positive: jit functions taking undonated large buffers."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("num_bins",))
+def accumulate(hist_buf, bins, num_bins):  # JX005: hist_buf not donated
+    return hist_buf.at[bins].add(1.0)
+
+
+@jax.jit
+def update_scores(scores, delta):  # JX005: scores not donated
+    return scores + delta

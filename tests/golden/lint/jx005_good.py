@@ -1,0 +1,29 @@
+"""JX005 negative: donated buffers and explicit opt-outs."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins",), donate_argnames=("hist_buf",)
+)
+def accumulate(hist_buf, bins, num_bins):  # donated: in-place friendly
+    return hist_buf.at[bins].add(1.0)
+
+
+# explicit empty donation: "considered, caller retains the buffer"
+@functools.partial(jax.jit, donate_argnums=())
+def read_scores(scores, idx):
+    return scores[idx]
+
+
+def plain_python(score_buf):  # not jitted: donation does not apply
+    return score_buf
+
+
+def _make():
+    def step(scores, delta):
+        return scores + delta
+
+    return jax.jit(step, donate_argnums=(0,))  # call-form donation

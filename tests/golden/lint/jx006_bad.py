@@ -1,0 +1,17 @@
+"""JX006 positive: float64 references inside jit (anywhere), and untyped
+jnp factories (only when placed under a hot-path dir: ops/ or parallel/ —
+the fixture test copies this file into a tmp ops/ dir for that case)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def widen(x):
+    return x.astype(jnp.float64)  # JX006: 64-bit dtype in compiled code
+
+
+@jax.jit
+def accumulate(vals):
+    acc = jnp.zeros(vals.shape)  # JX006 (hot path): dtype follows x64 flag
+    return acc + vals.astype(np.float64)  # JX006: np.float64 in jit

@@ -1,0 +1,15 @@
+"""JX006 negative: explicit accumulator dtypes everywhere."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def accumulate(vals):
+    acc = jnp.zeros(vals.shape, jnp.float32)  # explicit positional dtype
+    ones = jnp.ones((4,), dtype=jnp.bfloat16)  # explicit kwarg dtype
+    return acc + jnp.sum(ones).astype(jnp.float32)
+
+
+def host_oracle(vals):
+    return np.zeros(vals.shape, np.float64)  # host-side numpy: not jitted

@@ -1,0 +1,21 @@
+"""JX007 positive: axis names that no Mesh declares."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices), ("data", "feature"))
+
+
+def combine(hist):
+    return jax.lax.psum(hist, "rows")  # JX007: "rows" not declared
+
+
+def shard_spec():
+    return P("model", None)  # JX007: "model" not declared
+
+
+def grow(tree_fn):
+    return jax.vmap(tree_fn, axis_name="shard")  # JX007: "shard" undeclared

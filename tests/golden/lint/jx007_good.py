@@ -1,0 +1,21 @@
+"""JX007 negative: every axis name matches the Mesh declaration."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def make_mesh(devices):
+    return Mesh(np.array(devices).reshape(2, -1), ("data", "feature"))
+
+
+def combine(hist):
+    return jax.lax.psum(hist, "data")
+
+
+def shard_spec():
+    return P(None, "feature")
+
+
+def grow(tree_fn):
+    return jax.vmap(tree_fn, axis_name="data")

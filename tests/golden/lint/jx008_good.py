@@ -1,0 +1,21 @@
+"""JX008 negative: narrow handlers, and broad handlers that act."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def probe_backend():
+    try:
+        import jax
+
+        return jax.default_backend()
+    except ImportError:  # narrow: the one failure we expect
+        pass
+    return "cpu"
+
+
+def cleanup(handle):
+    try:
+        handle.close()
+    except Exception as e:  # broad but not silent: logged
+        log.warning("close failed: %s", e)

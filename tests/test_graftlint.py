@@ -1,0 +1,301 @@
+"""graftlint self-tests: per-rule golden fixtures + the baseline gate.
+
+Three layers:
+  * per-rule true-positive / true-negative fixtures (tests/golden/lint/):
+    every JX rule must fire on its ``_bad`` fixture and stay silent on its
+    ``_good`` fixture;
+  * the shipped baseline regression: linting ``lightgbm_tpu/`` must produce
+    EXACTLY the findings recorded in tools/graftlint/baseline.txt — a new
+    violation fails tier-1, and so does a fixed-but-not-removed entry;
+  * CLI smoke via ``python -m tools.graftlint``.
+
+No test here is marked slow: this IS the tier-1 lint gate.
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.graftlint import RULES, load_baseline, run_lint  # noqa: E402
+from tools.graftlint.cli import DEFAULT_BASELINE, main as cli_main  # noqa: E402
+from tools.graftlint.engine import compare_to_baseline  # noqa: E402
+
+LINT_DIR = os.path.join(REPO, "tests", "golden", "lint")
+ALL_RULES = ("JX001", "JX002", "JX003", "JX004",
+             "JX005", "JX006", "JX007", "JX008")
+
+
+def _lint(path, rule_id):
+    return run_lint([path], root=REPO, select=[rule_id])
+
+
+# ---------------------------------------------------------------------------
+# per-rule golden fixtures
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("rule_id", ALL_RULES)
+def test_rule_fires_on_bad_fixture(rule_id):
+    path = os.path.join(LINT_DIR, "%s_bad.py" % rule_id.lower())
+    findings = _lint(path, rule_id)
+    assert findings, "%s produced no findings on its bad fixture" % rule_id
+    assert all(f.rule == rule_id for f in findings)
+    # every finding carries a location and a content-stable key
+    for f in findings:
+        assert f.line > 0
+        assert f.key.startswith(rule_id + ":")
+        assert f.key.count(":") >= 3  # RULE:path:qualname:detail
+
+
+@pytest.mark.parametrize("rule_id", ALL_RULES)
+def test_rule_silent_on_good_fixture(rule_id):
+    path = os.path.join(LINT_DIR, "%s_good.py" % rule_id.lower())
+    findings = _lint(path, rule_id)
+    assert findings == [], (
+        "%s false positives: %s" % (rule_id, [f.format() for f in findings])
+    )
+
+
+def test_jx001_counts():
+    path = os.path.join(LINT_DIR, "jx001_bad.py")
+    assert len(_lint(path, "JX001")) == 3  # float(), np.asarray(), .item()
+
+
+def test_jx004_counts_and_params():
+    path = os.path.join(LINT_DIR, "jx004_bad.py")
+    findings = _lint(path, "JX004")
+    assert sorted(f.detail for f in findings) == [
+        "param=callbacks", "param=extra", "param=seen",
+    ]
+
+
+def test_jx006_hot_path_factory(tmp_path):
+    """The untyped-factory check is scoped to ops/ and parallel/ dirs:
+    the same file is clean outside and flagged inside a hot-path dir."""
+    outside = _lint(os.path.join(LINT_DIR, "jx006_bad.py"), "JX006")
+    ops_dir = tmp_path / "ops"
+    ops_dir.mkdir()
+    for name in ("jx006_bad.py", "jx006_good.py"):
+        shutil.copy(os.path.join(LINT_DIR, name), ops_dir / name)
+    inside = run_lint([str(ops_dir / "jx006_bad.py")],
+                      root=str(tmp_path), select=["JX006"])
+    assert len(inside) == len(outside) + 1  # + the untyped jnp.zeros
+    good = run_lint([str(ops_dir / "jx006_good.py")],
+                    root=str(tmp_path), select=["JX006"])
+    assert good == []
+
+
+def test_jx007_axis_index_first_positional(tmp_path):
+    """axis_index takes the axis name as its FIRST argument — the rule must
+    check args[0] there, not the reduction collectives' args[1]."""
+    src = (
+        "import jax\nimport numpy as np\n"
+        "from jax.sharding import Mesh\n\n"
+        "def make_mesh(devices):\n"
+        "    return Mesh(np.array(devices), ('data',))\n\n"
+        "def rank():\n"
+        "    return jax.lax.axis_index('dtaa')\n"  # typo'd axis
+    )
+    p = tmp_path / "axis_index.py"
+    p.write_text(src)
+    findings = run_lint([str(p)], root=str(tmp_path), select=["JX007"])
+    assert len(findings) == 1 and "dtaa" in findings[0].message
+
+
+def test_jx007_needs_a_mesh_declaration(tmp_path):
+    """Without any Mesh() in scope the axis check cannot validate and
+    stays silent instead of guessing."""
+    src = 'import jax\n\ndef f(x):\n    return jax.lax.psum(x, "data")\n'
+    p = tmp_path / "no_mesh.py"
+    p.write_text(src)
+    assert run_lint([str(p)], root=str(tmp_path), select=["JX007"]) == []
+
+
+def test_jx001_tolist_on_static_arg_is_legal(tmp_path):
+    """.tolist() on a static argument is a trace-time constant, not a
+    device sync — the no-false-positive-on-statics contract applies."""
+    src = (
+        "import functools\nimport jax\n\n"
+        "@functools.partial(jax.jit, static_argnames=('bins',))\n"
+        "def f(x, bins):\n"
+        "    edges = bins.tolist()\n"
+        "    return x * len(edges)\n"
+    )
+    p = tmp_path / "static_tolist.py"
+    p.write_text(src)
+    assert run_lint([str(p)], root=str(tmp_path), select=["JX001"]) == []
+
+
+@pytest.mark.parametrize("header,dec", [
+    ("import numba", "@numba.jit"),           # dotted non-jax
+    ("from numba import jit", "@jit"),        # bare name from non-jax
+])
+def test_non_jax_jit_decorators_are_not_jit_scope(tmp_path, header, dec):
+    """numba's jit (dotted or from-imported) is not a jax tracing scope —
+    Python branches and float() are legal there."""
+    src = (
+        "%s\n\n"
+        "%s\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return float(x)\n"
+        "    return 0.0\n" % (header, dec)
+    )
+    p = tmp_path / "numba_fn.py"
+    p.write_text(src)
+    findings = run_lint([str(p)], root=str(tmp_path))
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_bare_jit_from_jax_still_counts(tmp_path):
+    """``from jax import jit`` keeps the bare decorator a tracing scope."""
+    src = (
+        "from jax import jit\n\n"
+        "@jit\n"
+        "def f(x):\n"
+        "    return float(x.sum())\n"
+    )
+    p = tmp_path / "jax_bare.py"
+    p.write_text(src)
+    findings = run_lint([str(p)], root=str(tmp_path), select=["JX001"])
+    assert len(findings) == 1
+
+
+def test_nonexistent_path_is_an_error(capsys):
+    """A typo'd path must be a usage error, not a vacuous clean pass."""
+    rc = cli_main(["no_such_dir_xyz/", "--root", REPO])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "no such file or directory" in err
+
+
+def test_overlapping_paths_lint_each_file_once():
+    """A file reachable through two path arguments must produce each
+    finding once, or the multiset baseline would see phantom duplicates."""
+    grow = os.path.join(REPO, "lightgbm_tpu", "ops", "grow.py")
+    once = run_lint([grow], root=REPO)
+    twice = run_lint([os.path.join(REPO, "lightgbm_tpu", "ops"), grow],
+                     root=REPO)
+    assert [f.key for f in twice if f.path.endswith("grow.py")] == [
+        f.key for f in once
+    ]
+
+
+def test_static_argnames_are_not_traced():
+    """The jit model must honor static_argnames: int()/branching on a
+    static argument is legal and must not fire JX001/JX002."""
+    path = os.path.join(LINT_DIR, "jx001_good.py")
+    assert _lint(path, "JX001") == []
+    assert _lint(path, "JX002") == []
+
+
+# ---------------------------------------------------------------------------
+# registry + docs
+# ---------------------------------------------------------------------------
+def test_rule_registry_complete():
+    assert set(RULES) == set(ALL_RULES)
+    for r in RULES.values():
+        assert r.title
+        assert r.doc, "rule %s has no documentation" % r.id
+
+
+def test_rules_documented_in_docs():
+    doc = open(os.path.join(REPO, "docs", "StaticAnalysis.md")).read()
+    for rule_id in ALL_RULES:
+        assert rule_id in doc, "%s missing from docs/StaticAnalysis.md" % rule_id
+
+
+# ---------------------------------------------------------------------------
+# the shipped baseline is exact: no new findings, no stale suppressions
+# ---------------------------------------------------------------------------
+def test_baseline_matches_current_findings_exactly():
+    findings = run_lint([os.path.join(REPO, "lightgbm_tpu")], root=REPO)
+    baseline, notes = load_baseline(DEFAULT_BASELINE)
+    new, stale = compare_to_baseline(findings, baseline)
+    assert not new, (
+        "new graftlint findings (fix them or baseline with a "
+        "justification):\n" + "\n".join(f.format() for f in new)
+    )
+    assert not stale, (
+        "stale baseline entries (the finding is gone — delete the line):\n"
+        + "\n".join(sorted(stale))
+    )
+
+
+def test_baseline_entries_are_justified():
+    baseline, notes = load_baseline(DEFAULT_BASELINE)
+    assert baseline, "baseline unexpectedly empty"
+    for key in baseline:
+        note = notes.get(key, "")
+        assert note and "TODO" not in note, (
+            "baseline entry lacks a real justification: %s" % key
+        )
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def test_cli_in_process_clean(capsys):
+    rc = cli_main([os.path.join(REPO, "lightgbm_tpu"), "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "clean" in out
+
+
+def test_cli_reports_findings(capsys):
+    rc = cli_main([
+        os.path.join(LINT_DIR, "jx004_bad.py"), "--no-baseline",
+        "--root", REPO,
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "JX004" in out
+
+
+def test_cli_subprocess_entrypoint():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "lightgbm_tpu/"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_rejects_unknown_rule_id(capsys):
+    rc = cli_main([
+        os.path.join(LINT_DIR, "jx004_bad.py"), "--select", "JX0O1",
+        "--root", REPO,
+    ])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "unknown rule id" in err
+
+
+def test_write_baseline_preserves_unscanned_entries(tmp_path, capsys):
+    """A partial-path --write-baseline must not delete suppressions (and
+    their justifications) belonging to files the run never parsed."""
+    (tmp_path / "clean.py").write_text("def f(x):\n    return x\n")
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "JX004:somewhere/else.py:train:param=callbacks  # kept on purpose\n"
+    )
+    rc = cli_main([
+        str(tmp_path / "clean.py"), "--write-baseline",
+        "--baseline", str(bl), "--root", str(tmp_path),
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    content = bl.read_text()
+    assert "somewhere/else.py" in content
+    assert "kept on purpose" in content
+
+
+def test_cli_list_rules(capsys):
+    rc = cli_main(["--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for rule_id in ALL_RULES:
+        assert rule_id in out

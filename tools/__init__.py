@@ -1,0 +1,5 @@
+"""Repo-local developer tooling (not shipped in the wheel).
+
+``tools.graftlint`` is the JAX-aware static analyzer that guards the TPU hot
+path; run it from the repo root as ``python -m tools.graftlint lightgbm_tpu/``.
+"""

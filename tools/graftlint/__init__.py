@@ -1,0 +1,51 @@
+"""graftlint — JAX-aware static analysis for the lightgbm_tpu hot path.
+
+The TPU-native design keeps histogram construction, split evaluation, and
+tree growth inside ``jit``/``pjit``-compiled programs; the biggest silent
+performance killers there are Python leaking into traced code — host syncs,
+tracer-dependent branching, hidden recompile triggers, dtype drift. graftlint
+is an AST-based rule engine specialized for this codebase's JAX idioms: it
+understands ``functools.partial(jax.jit, static_argnames=...)`` decorations,
+knows which parameters are traced vs static, and checks mesh axis names
+against their declaration site.
+
+Public API::
+
+    from tools.graftlint import run_lint, RULES, Finding
+    findings = run_lint(["lightgbm_tpu/"])
+
+CLI::
+
+    python -m tools.graftlint lightgbm_tpu/
+
+Rules (see docs/StaticAnalysis.md for bad/good examples):
+
+=======  ==================================================================
+JX001    host-device sync inside a jit/pjit function
+JX002    Python ``if``/``while`` on a traced value (needs lax.cond/while)
+JX003    jnp.array/asarray of a Python constant rebuilt on every trace
+JX004    mutable default argument in a public API function
+JX005    jit function with a large-buffer parameter and no donation
+JX006    dtype drift in hot-path code (untyped factories, float64 refs)
+JX007    collective/sharding axis name not declared on any mesh
+JX008    broad exception handler that silently swallows (pass-only body)
+=======  ==================================================================
+"""
+from .engine import (  # noqa: F401
+    Finding,
+    ProjectContext,
+    RULES,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+from . import rules  # noqa: F401  (importing registers the JX rules)
+
+__all__ = [
+    "Finding",
+    "ProjectContext",
+    "RULES",
+    "load_baseline",
+    "run_lint",
+    "write_baseline",
+]

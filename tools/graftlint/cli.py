@@ -1,0 +1,155 @@
+"""graftlint command line.
+
+Usage::
+
+    python -m tools.graftlint lightgbm_tpu/            # lint with baseline
+    python -m tools.graftlint --list-rules             # rule documentation
+    python -m tools.graftlint --write-baseline <paths> # refresh baseline
+    python -m tools.graftlint --no-baseline <paths>    # raw findings
+
+Exit codes: 0 clean (all findings baselined), 1 unsuppressed findings or a
+stale baseline entry (a fixed finding whose suppression should be removed —
+kept strict so the baseline can only shrink), 2 usage/internal error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import Counter
+from typing import List, Optional
+
+from .engine import (
+    RULES,
+    compare_to_baseline,
+    load_baseline,
+    run_lint,
+    write_baseline,
+)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.txt")
+
+
+def _list_rules() -> str:
+    out = []
+    for rid, r in sorted(RULES.items()):
+        out.append("%s — %s" % (rid, r.title))
+        for line in r.doc.splitlines():
+            out.append("    " + line.strip())
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="JAX-aware static analysis for the lightgbm_tpu hot path",
+    )
+    parser.add_argument("paths", nargs="*", help="files or directories to lint")
+    parser.add_argument(
+        "--baseline", default=DEFAULT_BASELINE,
+        help="baseline suppression file (default: tools/graftlint/baseline.txt)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept all current findings into the baseline "
+             "(existing justifications are preserved)",
+    )
+    parser.add_argument(
+        "--select", action="append", metavar="JX00N",
+        help="run only these rule ids (repeatable)",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="path-key root for baseline entries (default: cwd)",
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        parser.print_usage(sys.stderr)
+        print("error: no paths given (try lightgbm_tpu/)", file=sys.stderr)
+        return 2
+    if args.select:
+        unknown = [s for s in args.select if s not in RULES]
+        if unknown:
+            print(
+                "error: unknown rule id(s): %s (known: %s)"
+                % (", ".join(unknown), ", ".join(sorted(RULES))),
+                file=sys.stderr,
+            )
+            return 2
+        if args.write_baseline:
+            print(
+                "error: --write-baseline with --select would record a "
+                "partial rule set; run it over all rules",
+                file=sys.stderr,
+            )
+            return 2
+
+    scanned: list = []
+    try:
+        findings = run_lint(
+            args.paths, root=args.root, select=args.select,
+            scanned_out=scanned,
+        )
+    except (OSError, SyntaxError) as e:
+        print("graftlint: %s" % e, file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        old_keys, notes = load_baseline(args.baseline)
+        scanned_set = set(scanned)
+        # keep suppressions for files this run never parsed
+        preserved = Counter(
+            {
+                k: n
+                for k, n in old_keys.items()
+                if (k.split(":", 2) + ["", ""])[1] not in scanned_set
+            }
+        )
+        write_baseline(args.baseline, findings, notes, preserved=preserved)
+        print(
+            "wrote %d finding(s) (+%d preserved for unscanned files) to %s"
+            % (len(findings), sum(preserved.values()), args.baseline)
+        )
+        return 0
+
+    if args.no_baseline:
+        for f in findings:
+            print(f.format())
+        print("graftlint: %d finding(s)" % len(findings))
+        return 1 if findings else 0
+
+    baseline, _ = load_baseline(args.baseline)
+    new, stale = compare_to_baseline(findings, baseline)
+    for f in new:
+        print(f.format())
+    for key, n in sorted(stale.items()):
+        print(
+            "stale baseline entry (finding no longer present x%d): %s"
+            % (n, key)
+        )
+    if new or stale:
+        print(
+            "graftlint: %d new finding(s), %d stale baseline entr%s "
+            "(%d baselined)"
+            % (
+                len(new), sum(stale.values()),
+                "y" if sum(stale.values()) == 1 else "ies",
+                len(findings) - len(new),
+            )
+        )
+        return 1
+    print(
+        "graftlint: clean (%d finding(s) baselined, %d rules)"
+        % (len(findings), len(RULES))
+    )
+    return 0

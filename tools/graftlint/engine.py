@@ -1,0 +1,526 @@
+"""graftlint core: file walker, jit-scope model, rule registry, baseline.
+
+The engine parses every target file once, builds a :class:`ProjectContext`
+(declared mesh axes, per-file jit scopes), and feeds each file to the
+registered rules. Rules yield :class:`Finding` objects whose ``key`` is
+line-number-free — ``RULE:path:qualname:detail`` — so the baseline survives
+unrelated edits to the same file.
+
+jit-scope model
+---------------
+A function is *jit-compiled* when it is decorated with ``@jax.jit``/``@pjit``
+(bare, called, or via ``functools.partial(jax.jit, ...)``) or when the file
+contains a ``jax.jit(fn_name, ...)`` call-form wrapping (the
+``jax.jit(step, donate_argnums=(0,))`` idiom in models/gbdt.py). Everything
+lexically inside a jit-compiled function — nested defs included — runs under
+trace and is *jit scope*. Traced parameter names are the jit function's own
+parameters minus ``static_argnames``/``static_argnums``; nested functions'
+parameters are deliberately NOT treated as traced (too many are loop-lattice
+constants), which keeps JX001/JX002 low-noise at the cost of missing some
+indirect cases.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from collections import Counter
+from typing import Callable, Dict, FrozenSet, Iterator, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding",
+    "FileContext",
+    "JitInfo",
+    "ProjectContext",
+    "RULES",
+    "load_baseline",
+    "compare_to_baseline",
+    "rule",
+    "run_lint",
+    "write_baseline",
+]
+
+MAX_DETAIL = 60
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a concrete source location."""
+
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    col: int
+    symbol: str  # dotted qualname of the enclosing function, or "<module>"
+    detail: str  # content-stable disambiguator (no line numbers)
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Line-number-free identity used for baseline matching."""
+        return "%s:%s:%s:%s" % (self.rule, self.path, self.symbol, self.detail)
+
+    def format(self) -> str:
+        return "%s:%d:%d: %s %s" % (
+            self.path, self.line, self.col + 1, self.rule, self.message
+        )
+
+
+class JitInfo:
+    """Static/donate argument model of one jit/pjit decoration."""
+
+    def __init__(
+        self,
+        fn: ast.AST,
+        static_names: FrozenSet[str] = frozenset(),
+        static_nums: Tuple[int, ...] = (),
+        donate_names: FrozenSet[str] = frozenset(),
+        donate_nums: Tuple[int, ...] = (),
+        donate_declared: bool = False,
+    ) -> None:
+        self.fn = fn
+        self.static_names = static_names
+        self.static_nums = static_nums
+        self.donate_names = donate_names
+        self.donate_nums = donate_nums
+        # True when the decoration spelled out donate_argnums/argnames at
+        # all — ``donate_argnums=()`` is this codebase's explicit
+        # "considered, nothing to donate" marker and opts out of JX005
+        self.donate_declared = donate_declared
+
+    def param_names(self) -> List[str]:
+        a = self.fn.args
+        return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+    def positional_names(self) -> List[str]:
+        a = self.fn.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    def traced_params(self) -> FrozenSet[str]:
+        pos = self.positional_names()
+        static = set(self.static_names)
+        for i in self.static_nums:
+            if 0 <= i < len(pos):
+                static.add(pos[i])
+        return frozenset(n for n in self.param_names() if n not in static)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.lax.psum' for a Name/Attribute chain, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _non_jax_jit_names(tree: ast.Module) -> FrozenSet[str]:
+    """Bare names bound to a NON-jax jit in this module — e.g.
+    ``from numba import jit`` — which must not open a jax tracing scope."""
+    banned = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or node.module is None:
+            continue
+        root = node.module.split(".")[0]
+        if root in ("jax", "pjit"):
+            continue
+        for alias in node.names:
+            if alias.name in ("jit", "pjit"):
+                banned.add(alias.asname or alias.name)
+    return frozenset(banned)
+
+
+def _is_jit_ref(node: ast.AST, banned: FrozenSet[str] = frozenset()) -> bool:
+    """True for jax's jit/pjit — bare ``jit``/``pjit`` names (unless the
+    module imported that name from a non-jax package, see
+    :func:`_non_jax_jit_names`) or dotted refs rooted at jax (``jax.jit``,
+    ``jax.experimental.pjit.pjit``). Other compilers' decorators
+    (``numba.jit``, ``from numba import jit``) are NOT jax tracing scopes."""
+    name = dotted_name(node)
+    if not name:
+        return False
+    parts = name.split(".")
+    if parts[-1] not in ("jit", "pjit"):
+        return False
+    if len(parts) == 1:
+        return name not in banned
+    return parts[0] in ("jax", "pjit")
+
+
+def _str_elems(node: ast.AST) -> List[str]:
+    """String payload of a Str or tuple/list-of-Str node."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+        return out
+    return []
+
+
+def _int_elems(node: ast.AST) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            el.value
+            for el in node.elts
+            if isinstance(el, ast.Constant) and isinstance(el.value, int)
+        ]
+    return []
+
+
+def _jit_kwargs(call: ast.Call) -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            out["static_names"] = frozenset(_str_elems(kw.value))
+        elif kw.arg == "static_argnums":
+            out["static_nums"] = tuple(_int_elems(kw.value))
+        elif kw.arg == "donate_argnames":
+            out["donate_names"] = frozenset(_str_elems(kw.value))
+            out["donate_declared"] = True
+        elif kw.arg == "donate_argnums":
+            out["donate_nums"] = tuple(_int_elems(kw.value))
+            out["donate_declared"] = True
+    return out
+
+
+def jit_info_from_decorators(
+    fn: ast.AST, banned: FrozenSet[str] = frozenset()
+) -> Optional[JitInfo]:
+    """JitInfo if ``fn`` carries a jax jit/pjit decoration, else None."""
+    for dec in fn.decorator_list:
+        if _is_jit_ref(dec, banned):
+            return JitInfo(fn)
+        if isinstance(dec, ast.Call):
+            # @jax.jit(static_argnums=...) applied directly
+            if _is_jit_ref(dec.func, banned):
+                return JitInfo(fn, **_jit_kwargs(dec))
+            # @functools.partial(jax.jit, static_argnames=...)
+            func_name = dotted_name(dec.func)
+            if (
+                func_name.rsplit(".", 1)[-1] == "partial"
+                and dec.args
+                and _is_jit_ref(dec.args[0], banned)
+            ):
+                return JitInfo(fn, **_jit_kwargs(dec))
+    return None
+
+
+class _ScopeVisitor(ast.NodeVisitor):
+    """Collect function qualnames, jit scopes, and call-form jit wrappings."""
+
+    def __init__(self, banned: FrozenSet[str] = frozenset()) -> None:
+        self.banned = banned  # bare jit names imported from non-jax packages
+        self.stack: List[str] = []
+        self.functions: Dict[int, str] = {}  # id(node) -> qualname
+        self.fn_nodes: List[ast.AST] = []  # every FunctionDef, in order
+        self.decorated: Dict[int, JitInfo] = {}  # id(fn node) -> info
+        self.call_wrapped: Dict[str, Dict[str, object]] = {}  # fn name -> kwargs
+        self.parents: Dict[int, ast.AST] = {}
+
+    def visit(self, node: ast.AST) -> None:  # record parents for every node
+        for child in ast.iter_child_nodes(node):
+            self.parents[id(child)] = node
+        super().visit(node)
+
+    def _visit_fn(self, node) -> None:
+        qual = ".".join(self.stack + [node.name]) if self.stack else node.name
+        self.functions[id(node)] = qual
+        self.fn_nodes.append(node)
+        info = jit_info_from_decorators(node, self.banned)
+        if info is not None:
+            self.decorated[id(node)] = info
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # call-form wrapping: jax.jit(fn_name, donate_argnums=...)
+        if (
+            _is_jit_ref(node.func, self.banned)
+            and node.args
+            and isinstance(node.args[0], ast.Name)
+        ):
+            self.call_wrapped[node.args[0].id] = _jit_kwargs(node)
+        self.generic_visit(node)
+
+
+class FileContext:
+    """Parsed file plus the jit-scope index the rules consume."""
+
+    def __init__(self, path: str, rel_path: str, source: str) -> None:
+        self.path = path
+        self.rel_path = rel_path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        v = _ScopeVisitor(banned=_non_jax_jit_names(self.tree))
+        v.visit(self.tree)
+        self._scopes = v
+        # resolve call-form wrappings onto same-named defs in this file
+        self.jit_fns: Dict[int, JitInfo] = dict(v.decorated)
+        for node in v.fn_nodes:
+            if id(node) in self.jit_fns:
+                continue
+            if node.name in v.call_wrapped:
+                self.jit_fns[id(node)] = JitInfo(
+                    node, **v.call_wrapped[node.name]
+                )
+
+    # -- scope queries ----------------------------------------------------
+    def qualname(self, fn: ast.AST) -> str:
+        return self._scopes.functions.get(id(fn), "<module>")
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._scopes.parents.get(id(node))
+
+    def enclosing_functions(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first chain of FunctionDefs containing ``node``."""
+        out: List[ast.AST] = []
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append(cur)
+            cur = self.parent(cur)
+        return out
+
+    def enclosing_jit(self, node: ast.AST) -> Optional[JitInfo]:
+        """JitInfo of the nearest jit-compiled ancestor function (or of the
+        node itself when it is one)."""
+        chain = [node] + self.enclosing_functions(node)
+        for fn in chain:
+            info = self.jit_fns.get(id(fn))
+            if info is not None:
+                return info
+        return None
+
+    def symbol_for(self, node: ast.AST) -> str:
+        fns = self.enclosing_functions(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return self.qualname(node)
+        return self.qualname(fns[0]) if fns else "<module>"
+
+    def detail_for(self, node: ast.AST) -> str:
+        try:
+            text = ast.unparse(node)
+        except Exception:
+            text = type(node).__name__
+        text = " ".join(text.split())
+        return text[:MAX_DETAIL]
+
+    def finding(self, rule_id: str, node: ast.AST, message: str,
+                detail: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=rule_id,
+            path=self.rel_path,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            symbol=self.symbol_for(node),
+            detail=detail if detail is not None else self.detail_for(node),
+            message=message,
+        )
+
+
+class ProjectContext:
+    """Cross-file facts: declared mesh axis names, the file set."""
+
+    def __init__(self, files: Sequence[FileContext]) -> None:
+        self.files = list(files)
+        self.declared_axes: FrozenSet[str] = self._collect_axes()
+
+    def _collect_axes(self) -> FrozenSet[str]:
+        axes: set = set()
+        for ctx in self.files:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if not name or name.rsplit(".", 1)[-1] != "Mesh":
+                    continue
+                # Mesh(devices, ("data", "feature")) or axis_names= kwarg
+                if len(node.args) >= 2:
+                    axes.update(_str_elems(node.args[1]))
+                for kw in node.keywords:
+                    if kw.arg == "axis_names":
+                        axes.update(_str_elems(kw.value))
+        return frozenset(axes)
+
+
+# --------------------------------------------------------------------------
+# rule registry
+# --------------------------------------------------------------------------
+RuleFn = Callable[[FileContext, ProjectContext], Iterator[Finding]]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    title: str
+    doc: str
+    fn: RuleFn
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, title: str) -> Callable[[RuleFn], RuleFn]:
+    """Register a rule; the function's docstring becomes its long doc."""
+
+    def deco(fn: RuleFn) -> RuleFn:
+        RULES[rule_id] = Rule(rule_id, title, (fn.__doc__ or "").strip(), fn)
+        return fn
+
+    return deco
+
+
+# --------------------------------------------------------------------------
+# walking + running
+# --------------------------------------------------------------------------
+def iter_python_files(paths: Sequence[str]) -> Iterator[str]:
+    seen = set()  # overlapping path args must not lint a file twice
+
+    def emit(path: str) -> Iterator[str]:
+        key = os.path.abspath(path)
+        if key not in seen:
+            seen.add(key)
+            yield path
+
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield from emit(p)
+        elif not os.path.isdir(p):
+            # a typo'd path must not make the gate pass vacuously
+            raise OSError("no such file or directory: %r" % p)
+        else:
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if not d.startswith(".") and d != "__pycache__"
+                )
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        yield from emit(os.path.join(root, n))
+
+
+def build_contexts(
+    paths: Sequence[str], root: Optional[str] = None
+) -> List[FileContext]:
+    root = root or os.getcwd()
+    out: List[FileContext] = []
+    for path in iter_python_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        try:
+            out.append(FileContext(path, rel, source))
+        except SyntaxError as e:
+            raise SyntaxError("%s: %s" % (path, e)) from e
+    return out
+
+
+def run_lint(
+    paths: Sequence[str],
+    root: Optional[str] = None,
+    select: Optional[Sequence[str]] = None,
+    scanned_out: Optional[List[str]] = None,
+) -> List[Finding]:
+    """Lint ``paths``; returns findings sorted by (path, line, rule).
+
+    ``scanned_out``, when given, receives the repo-relative path of every
+    file actually parsed (used by --write-baseline to preserve entries for
+    files outside the scanned set).
+    """
+    contexts = build_contexts(paths, root=root)
+    if scanned_out is not None:
+        scanned_out.extend(ctx.rel_path for ctx in contexts)
+    project = ProjectContext(contexts)
+    findings: List[Finding] = []
+    wanted = set(select) if select else None
+    for ctx in contexts:
+        for rid, r in sorted(RULES.items()):
+            if wanted is not None and rid not in wanted:
+                continue
+            findings.extend(r.fn(ctx, project))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+def load_baseline(path: str) -> Tuple[Counter, Dict[str, str]]:
+    """-> (multiset of suppressed keys, key -> justification)."""
+    keys: Counter = Counter()
+    notes: Dict[str, str] = {}
+    if not os.path.exists(path):
+        return keys, notes
+    with open(path, "r", encoding="utf-8") as fh:
+        for raw in fh:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "  # " in line:
+                key, note = line.split("  # ", 1)
+                key = key.strip()
+                notes[key] = note.strip()
+            else:
+                key = line
+            keys[key] += 1
+    return keys, notes
+
+
+def compare_to_baseline(
+    findings: Sequence[Finding], baseline: Counter
+) -> Tuple[List[Finding], Counter]:
+    """-> (unsuppressed findings, stale baseline keys)."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    for f in findings:
+        if remaining[f.key] > 0:
+            remaining[f.key] -= 1
+        else:
+            new.append(f)
+    stale = Counter({k: v for k, v in remaining.items() if v > 0})
+    return new, stale
+
+
+def write_baseline(
+    path: str,
+    findings: Sequence[Finding],
+    notes: Optional[Dict[str, str]] = None,
+    preserved: Optional[Counter] = None,
+) -> None:
+    """Write all current finding keys, keeping existing justifications.
+
+    ``preserved`` carries prior baseline entries (key -> count) for files
+    NOT covered by this run, so a partial-path --write-baseline cannot
+    silently delete unrelated suppressions and their justifications.
+    """
+    notes = notes or {}
+    entries: Counter = Counter(preserved or ())
+    for f in findings:
+        entries[f.key] += 1
+    lines = [
+        "# graftlint baseline — accepted findings, one per line:",
+        "#   <RULE:path:qualname:detail>  # <one-line justification>",
+        "# Repeated identical keys suppress that many occurrences.",
+        "# Regenerate with: python -m tools.graftlint --write-baseline <paths>",
+        "",
+    ]
+    for key in sorted(entries):
+        note = notes.get(key, "TODO: justify or fix")
+        lines.append("%s  # %s" % (key, note))
+        lines.extend([key] * (entries[key] - 1))
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("\n".join(lines) + "\n")

@@ -1,0 +1,453 @@
+"""The JX rule set. Each rule is registered with @rule and yields Findings.
+
+Rules lean on the engine's jit-scope model (FileContext.enclosing_jit /
+JitInfo.traced_params) so that static arguments — ``static_argnames`` /
+``static_argnums`` — never produce traced-value false positives. See
+docs/StaticAnalysis.md for a bad/good example per rule.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Optional, Set
+
+from .engine import (
+    FileContext,
+    Finding,
+    ProjectContext,
+    dotted_name,
+    rule,
+)
+
+# attribute reads that are static metadata even on a traced array
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "weak_type", "sharding"}
+
+# numpy module aliases as they appear in this codebase
+_NP_BASES = {"np", "numpy", "onp"}
+_JNP_BASES = {"jnp", "jax.numpy"}
+
+
+def _first_arg(call: ast.Call) -> Optional[ast.AST]:
+    return call.args[0] if call.args else None
+
+
+def _none_guard_subtrees(test: ast.AST) -> Set[int]:
+    """ids of Compare subtrees that are pure ``x is (not) None`` guards —
+    trace-time control on pytree *structure*, legal under jit."""
+    skip: Set[int] = set()
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare):
+            continue
+        if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops) and any(
+            isinstance(c, ast.Constant) and c.value is None
+            for c in [node.left] + node.comparators
+        ):
+            for sub in ast.walk(node):
+                skip.add(id(sub))
+    return skip
+
+
+def _references_traced(
+    ctx: FileContext, node: ast.AST, traced: frozenset,
+    skip: Optional[Set[int]] = None,
+) -> Optional[str]:
+    """Name of the first traced parameter *used as a value* in ``node``.
+
+    Static-metadata reads (``x.shape``, ``len(x)``, ``isinstance(x, ...)``)
+    and subtrees listed in ``skip`` do not count.
+    """
+    skip = skip or set()
+    for sub in ast.walk(node):
+        if id(sub) in skip:
+            continue
+        if not (isinstance(sub, ast.Name) and sub.id in traced):
+            continue
+        parent = ctx.parent(sub)
+        if (
+            isinstance(parent, ast.Attribute)
+            and parent.value is sub
+            and parent.attr in _STATIC_ATTRS
+        ):
+            continue
+        if (
+            isinstance(parent, ast.Call)
+            and isinstance(parent.func, ast.Name)
+            and parent.func.id in ("len", "isinstance", "type")
+        ):
+            continue
+        return sub.id
+    return None
+
+
+# --------------------------------------------------------------------------
+@rule("JX001", "host-device sync inside a jit/pjit function")
+def jx001_host_sync(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """``float(x)``/``int(x)``/``bool(x)``, ``np.asarray(x)``, ``.item()``,
+    ``.tolist()`` or ``jax.device_get`` on a traced value inside compiled
+    code forces the host to block on the device — a silent serialization
+    point that defeats async dispatch. Compute with jnp/lax primitives
+    instead, or hoist the conversion out of the jitted function.
+    """
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        info = ctx.enclosing_jit(node)
+        if info is None:
+            continue
+        traced = info.traced_params()
+        func = node.func
+        # float(x) / int(x) / bool(x) on a traced value
+        if isinstance(func, ast.Name) and func.id in ("float", "int", "bool"):
+            arg = _first_arg(node)
+            if arg is not None:
+                name = _references_traced(ctx, arg, traced)
+                if name is not None:
+                    yield ctx.finding(
+                        "JX001", node,
+                        "%s() on traced value %r blocks on the device inside "
+                        "jit; use jnp casts or hoist to the host side"
+                        % (func.id, name),
+                    )
+            continue
+        fname = dotted_name(func)
+        base, _, attr = fname.rpartition(".")
+        # np.asarray / np.array on a traced value materializes on host
+        if base in _NP_BASES and attr in ("asarray", "array"):
+            arg = _first_arg(node)
+            if arg is not None:
+                name = _references_traced(ctx, arg, traced)
+                if name is not None:
+                    yield ctx.finding(
+                        "JX001", node,
+                        "%s(%s) inside jit copies the traced value to host "
+                        "memory; use jnp.asarray or keep it on device"
+                        % (fname, name),
+                    )
+            continue
+        # .item()/.tolist(): a host sync when the receiver is traced. A
+        # receiver referencing only STATIC params is a trace-time constant
+        # and legal; unknown receivers (locals) are flagged — locals inside
+        # jit are almost always traced values.
+        if isinstance(func, ast.Attribute) and func.attr in ("item", "tolist"):
+            static = frozenset(info.param_names()) - traced
+            if (
+                _references_traced(ctx, func.value, traced) is not None
+                or _references_traced(ctx, func.value, static) is None
+            ):
+                yield ctx.finding(
+                    "JX001", node,
+                    ".%s() inside jit is a host-device sync; return the "
+                    "array and convert outside the compiled function"
+                    % func.attr,
+                )
+            continue
+        if attr == "device_get" and base.rsplit(".", 1)[-1] == "jax":
+            yield ctx.finding(
+                "JX001", node,
+                "jax.device_get inside jit forces a transfer; move it to "
+                "the caller",
+            )
+
+
+# --------------------------------------------------------------------------
+@rule("JX002", "Python branch on a traced value")
+def jx002_traced_branch(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """A Python ``if``/``while`` whose condition reads a traced value raises
+    a ConcretizationTypeError at trace time — or, when it sneaks through via
+    a host round-trip, re-traces per branch. Use ``lax.cond`` /
+    ``lax.while_loop`` / ``jnp.where``. Conditions on static arguments,
+    ``x.shape``-style metadata, and ``x is None`` pytree-structure guards
+    are trace-time constants and are not flagged.
+    """
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        info = ctx.enclosing_jit(node)
+        if info is None:
+            continue
+        traced = info.traced_params()
+        skip = _none_guard_subtrees(node.test)
+        name = _references_traced(ctx, node.test, traced, skip)
+        if name is not None:
+            kind = "if" if isinstance(node, ast.If) else "while"
+            yield ctx.finding(
+                "JX002", node,
+                "Python `%s` on traced value %r inside jit; use lax.cond/"
+                "lax.while_loop (or jnp.where) for data-dependent control"
+                % (kind, name),
+                detail=ctx.detail_for(node.test),
+            )
+
+
+# --------------------------------------------------------------------------
+def _is_const_literal(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float, complex, bool))
+    if isinstance(node, ast.UnaryOp) and isinstance(
+        node.op, (ast.USub, ast.UAdd)
+    ):
+        return _is_const_literal(node.operand)
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return bool(node.elts) and all(_is_const_literal(e) for e in node.elts)
+    return False
+
+
+@rule("JX003", "device constant rebuilt on every call/trace")
+def jx003_const_rebuild(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """``jnp.array([ ... literal ... ])`` inside a function body rebuilds
+    (and re-uploads) the same device constant on every call — and every
+    re-trace constant-folds it again, a hidden recompile cost. Hoist the
+    constant to module level as a *numpy* array (np constants don't touch
+    the backend at import, jnp ones would) so it is built once.
+    Module-level constants, arrays built from runtime values, and scalar
+    wraps like ``jnp.asarray(False)`` (idiomatic for lax.cond predicates,
+    no build cost) are fine.
+    """
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        base, _, attr = fname.rpartition(".")
+        if base not in _JNP_BASES or attr not in ("array", "asarray"):
+            continue
+        if not ctx.enclosing_functions(node):
+            continue  # module level: built once, fine
+        arg = _first_arg(node)
+        if (
+            arg is not None
+            and isinstance(arg, (ast.List, ast.Tuple))
+            and _is_const_literal(arg)
+        ):
+            yield ctx.finding(
+                "JX003", node,
+                "jnp.%s of a Python constant inside a function is rebuilt "
+                "every call (and folded every trace); hoist it to module "
+                "scope" % attr,
+            )
+
+
+# --------------------------------------------------------------------------
+_MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "OrderedDict"}
+
+
+def _is_mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        return name.rsplit(".", 1)[-1] in _MUTABLE_CALLS
+    return False
+
+
+@rule("JX004", "mutable default argument in a public function")
+def jx004_mutable_default(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """A mutable default (``[]``, ``{}``, ``set()``, ``dict()``...) is
+    created once at def time and shared across calls — mutations leak
+    between callers. Default to ``None`` and materialize inside the body.
+    Private helpers (leading underscore) are exempt; the public API is not.
+    """
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name.startswith("_"):
+            continue
+        a = node.args
+        pos = a.posonlyargs + a.args
+        for param, default in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+            if _is_mutable_default(default):
+                yield ctx.finding(
+                    "JX004", node,
+                    "mutable default for %r is shared across calls; use "
+                    "None and create it in the body" % param.arg,
+                    detail="param=%s" % param.arg,
+                )
+        for param, default in zip(a.kwonlyargs, a.kw_defaults):
+            if default is not None and _is_mutable_default(default):
+                yield ctx.finding(
+                    "JX004", node,
+                    "mutable default for %r is shared across calls; use "
+                    "None and create it in the body" % param.arg,
+                    detail="param=%s" % param.arg,
+                )
+
+
+# --------------------------------------------------------------------------
+# parameter names that denote large reusable accumulator/output buffers in
+# this codebase (histogram carries, score vectors, donated scratch)
+_BUFFER_RE = re.compile(
+    r"(^|_)(hist\w*|score\w*|\w*buf(fer)?\w*|scratch\w*|carry)($|_)"
+)
+
+
+@rule("JX005", "large-buffer jit argument without donation")
+def jx005_missing_donate(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """A jit function that takes a large accumulator/output buffer
+    (histogram carry, score vector, scratch) without
+    ``donate_argnums``/``donate_argnames`` forces XLA to keep the input
+    alive across the call — doubling peak HBM for buffers that the caller
+    immediately overwrites. Donate the buffer (and have the caller re-adopt
+    the aliased output), or baseline with a justification when the caller
+    genuinely reuses the input. Spelling out ``donate_argnums=()`` (this
+    codebase's explicit "considered, nothing donatable" marker) opts the
+    function out.
+    """
+    for info in ctx.jit_fns.values():
+        if info.donate_declared:
+            # any donate_argnums/argnames spelling (empty included) means
+            # the author made a donation decision — nothing left to flag
+            continue
+        for name in info.traced_params():
+            if _BUFFER_RE.search(name):
+                yield ctx.finding(
+                    "JX005", info.fn,
+                    "jit function %r takes buffer-like argument %r without "
+                    "donate_argnums/donate_argnames; donating avoids a "
+                    "duplicate device allocation" % (info.fn.name, name),
+                    detail="param=%s" % name,
+                )
+
+
+# --------------------------------------------------------------------------
+_FACTORY_DTYPE_POS = {"zeros": 1, "ones": 1, "empty": 1, "full": 2}
+_HOT_PATH_DIRS = ("ops", "parallel")
+
+
+def _in_hot_path(ctx: FileContext) -> bool:
+    # whole path segments, so loops/ or devops/ never match ops
+    return any(seg in _HOT_PATH_DIRS for seg in ctx.rel_path.split("/")[:-1])
+
+
+@rule("JX006", "dtype drift in hot-path compiled code")
+def jx006_dtype_drift(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """Two flavors of accumulator dtype drift inside jit code:
+    (a) ``float64``/``double`` references — TPUs have no f64; with x64
+    disabled they silently downcast, with it enabled they double bandwidth
+    and break bf16/f32 accumulator contracts; (b) in the hot-path dirs
+    (``ops/``, ``parallel/``), jnp array factories without an explicit
+    dtype — the result dtype then flips with the x64 flag, so f32
+    accumulators can silently widen. Always pass dtype in hot-path code.
+    """
+    for node in ast.walk(ctx.tree):
+        if ctx.enclosing_jit(node) is None:
+            continue
+        if isinstance(node, ast.Attribute):
+            base = dotted_name(node.value)
+            if node.attr in ("float64", "double") and (
+                base in _NP_BASES or base in _JNP_BASES
+            ):
+                yield ctx.finding(
+                    "JX006", node,
+                    "%s.%s inside jit: TPU-hostile 64-bit dtype (silent "
+                    "downcast with x64 off, bandwidth/precision drift with "
+                    "it on); use float32/bfloat16 explicitly"
+                    % (base, node.attr),
+                )
+            continue
+        if not isinstance(node, ast.Call) or not _in_hot_path(ctx):
+            continue
+        fname = dotted_name(node.func)
+        base, _, attr = fname.rpartition(".")
+        if base not in _JNP_BASES or attr not in _FACTORY_DTYPE_POS:
+            continue
+        has_dtype = len(node.args) > _FACTORY_DTYPE_POS[attr] or any(
+            kw.arg == "dtype" for kw in node.keywords
+        )
+        if not has_dtype:
+            yield ctx.finding(
+                "JX006", node,
+                "jnp.%s without an explicit dtype in hot-path jit code; "
+                "the result dtype follows the x64 flag — pass the "
+                "accumulator dtype explicitly" % attr,
+            )
+
+
+# --------------------------------------------------------------------------
+_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "all_gather",
+    "all_to_all", "psum_scatter", "axis_index",
+}
+
+
+@rule("JX007", "collective/sharding axis name not declared on any mesh")
+def jx007_undeclared_axis(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """Axis-name strings in ``psum``/``axis_name=``/``PartitionSpec`` must
+    match an axis declared on a ``Mesh`` (parallel/mesh.py). A typo'd axis
+    fails only at run time — deep inside shard_map, on the hardware — so
+    catch it at review time. Skipped when no Mesh declaration is in scope.
+    """
+    declared = project.declared_axes
+    if not declared:
+        return
+
+    def check_strings(node: ast.AST, where: str) -> Iterator[Finding]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                if sub.value not in declared:
+                    yield ctx.finding(
+                        "JX007", sub,
+                        "axis name %r in %s is not declared on any mesh "
+                        "(declared: %s)"
+                        % (sub.value, where, ", ".join(sorted(declared))),
+                        detail="axis=%s" % sub.value,
+                    )
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        attr = fname.rsplit(".", 1)[-1] if fname else ""
+        if attr == "Mesh":
+            continue  # the declaration site itself
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis_names"):
+                yield from check_strings(kw.value, "%s(%s=...)" % (attr, kw.arg))
+        if attr in _COLLECTIVES:
+            # axis_index(axis_name) takes the axis first; the reduction
+            # collectives take (operand, axis_name)
+            pos = 0 if attr == "axis_index" else 1
+            if len(node.args) > pos:
+                yield from check_strings(node.args[pos], "%s(...)" % attr)
+        if attr in ("PartitionSpec", "P"):
+            for arg in node.args:
+                yield from check_strings(arg, "PartitionSpec")
+
+
+# --------------------------------------------------------------------------
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _is_broad(handler_type: Optional[ast.AST]) -> bool:
+    if handler_type is None:
+        return True  # bare except:
+    if isinstance(handler_type, (ast.Name, ast.Attribute)):
+        return dotted_name(handler_type).rsplit(".", 1)[-1] in _BROAD_EXC
+    if isinstance(handler_type, ast.Tuple):
+        return any(_is_broad(el) for el in handler_type.elts)
+    return False
+
+
+@rule("JX008", "broad exception handler silently swallows")
+def jx008_silent_swallow(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """``except Exception: pass`` (or a bare ``except:``) with nothing in
+    the body hides real failures — on this codebase that has masked device
+    tunnel errors as silent CPU fallbacks. Catch the specific exception you
+    expect, or at least log before continuing. Narrow handlers
+    (``except OSError: pass``) are allowed.
+    """
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node.type):
+            continue
+        if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+            type_txt = (
+                ast.unparse(node.type) if node.type is not None else "<bare>"
+            )
+            yield ctx.finding(
+                "JX008", node,
+                "broad `except %s` with a pass-only body swallows every "
+                "failure; catch the specific exception or log it"
+                % type_txt,
+                detail="except=%s" % type_txt,
+            )
