@@ -314,8 +314,29 @@ def _adopt_from_bringup(platform, stages=None):
                 continue
         if best_rate is None or r > best_rate:
             best, best_rate = name, r
+    # device-resident boosting sweep (bench_chunk stage): adopt the measured
+    # winning device_chunk_size. Orthogonal to the grower/histogram knobs —
+    # a chunk>1 winner composes with whichever smoke variant won above.
+    chunk_pars = {}
+    ch = stages.get("bench_chunk", {})
+    chunk_win = None
+    if ch.get("ok") and ch.get("platform") in ("tpu", "axon"):
+        try:
+            chunk_win = int(ch.get("winner_chunk") or 1)
+        except (TypeError, ValueError):
+            chunk_win = None
+        if chunk_win is not None and chunk_win > 1:
+            chunk_pars["device_chunk_size"] = chunk_win
+        else:
+            chunk_win = None
     if best is None:
-        return {}, None
+        if chunk_win is None:
+            return {}, None
+        record = {"winner": "bench_chunk", "measured_at": measured_at,
+                  "device_chunk_size": chunk_win}
+        print("bench: bake-off adoption -> %s" % record, file=sys.stderr,
+              flush=True)
+        return chunk_pars, record
     envs, pars = _BAKEOFF_CANDIDATES[best]
     os.environ.update(envs)
     # provenance: a reader must be able to tell WHEN the winning
@@ -326,8 +347,10 @@ def _adopt_from_bringup(platform, stages=None):
         record["env"] = envs
     if pars:
         record["params"] = pars
+    if chunk_win is not None:
+        record["device_chunk_size"] = chunk_win
     print("bench: bake-off adoption -> %s" % record, file=sys.stderr, flush=True)
-    return dict(pars), record
+    return dict(pars, **chunk_pars), record
 
 
 def _run() -> None:
@@ -459,17 +482,38 @@ def _run() -> None:
     bin_time = time.time() - t0
     print("bench: binned in %.1fs" % bin_time, file=sys.stderr, flush=True)
 
-    # warmup (jit compile)
+    # device-resident chunked boosting (device_chunk_size > 1, usually via
+    # bench_chunk bake-off adoption): iterations dispatch in fused scan
+    # chunks; GBDT falls back to per-iteration updates on its own when the
+    # chunked path cannot engage (e.g. the native CPU learner)
+    chunk = int(params.get("device_chunk_size", 1))
+
+    def run_iters(count: int) -> None:
+        i = 0
+        while i < count:
+            if chunk > 1:
+                done, _ = booster.update_chunk(min(chunk, count - i))
+                i += max(done, 1)
+            else:
+                booster.update()
+                i += 1
+
+    # warmup (jit compile). Chunked runs must compile BOTH programs the
+    # timed loop will use — the sequential first iteration and the full
+    # n=chunk scan — and the timed loop then runs whole chunks only, or the
+    # n=chunk (or tail-size) XLA compile would land inside bench_time and
+    # slow down exactly the configuration the bake-off adopted.
+    warmup_iters = WARMUP_ITERS if chunk <= 1 else max(WARMUP_ITERS, chunk + 1)
+    if chunk > 1:
+        bench_iters = max(bench_iters // chunk, 1) * chunk
     t0 = time.time()
-    for _ in range(WARMUP_ITERS):
-        booster.update()
+    run_iters(warmup_iters)
     jax.block_until_ready(booster._gbdt.scores)
     warmup_time = time.time() - t0
     print("bench: warmed up in %.1fs" % warmup_time, file=sys.stderr, flush=True)
 
     t0 = time.time()
-    for _ in range(bench_iters):
-        booster.update()
+    run_iters(bench_iters)
     # force completion of the last device work. A literal element fetch, not
     # just block_until_ready: on the tunneled TPU backend block_until_ready
     # can return before the enqueued work has executed (measured), and since
@@ -488,22 +532,38 @@ def _run() -> None:
     auc = auc_metric.eval(score, booster._gbdt.objective)[0][1]
 
     # ---- phase breakdown + roofline model (VERDICT r3 item 4) -----------
-    # Phases from 3 extra TIMETAG'd iterations (TIMETAG serializes phases
-    # with blocking waits, so it runs OUTSIDE the headline timing loop).
+    # Phases from a few extra iterations under the SYNC timer opt-in
+    # (utils/timer.py): per phase, `dispatch` is the host wall time spent
+    # issuing the work and `seconds` the synced total — their gap is the
+    # device-compute share, making dispatch overhead a first-class number.
+    # Sync serializes phases, so this runs OUTSIDE the headline timing loop.
+    # Chunked runs instrument exactly one already-compiled n=chunk dispatch
+    # (any other count would trace a fresh scan size and report compile
+    # time as phase cost).
     phases = {}
+    phases_dispatch = {}
     phases_error = None
+    phase_iters = chunk if chunk > 1 else 3
     try:
         gbdt = booster._gbdt
         gbdt.timers.enabled = True
+        gbdt.timers.sync = True
         gbdt.timers.seconds.clear()
         gbdt.timers.counts.clear()
-        for _ in range(3):
-            booster.update()
+        gbdt.timers.dispatch_seconds.clear()
+        run_iters(phase_iters)
         # close the async pipeline before reading the timers (same
         # block-can-lie caveat as the headline loop)
         float(np.asarray(jax.numpy.ravel(booster._gbdt.scores)[0]))
-        phases = {k: round(v / 3, 4) for k, v in gbdt.timers.seconds.items()}
+        phases = {
+            k: round(v / phase_iters, 4) for k, v in gbdt.timers.seconds.items()
+        }
+        phases_dispatch = {
+            k: round(v / phase_iters, 4)
+            for k, v in gbdt.timers.dispatch_seconds.items()
+        }
         gbdt.timers.enabled = False
+        gbdt.timers.sync = False
     except Exception as e:
         # surface the failure in the emitted JSON — the r4 TPU capture lost
         # its phase row silently and the artifact read as "never instrumented"
@@ -593,8 +653,11 @@ def _run() -> None:
                 extra["last_tpu"] = last
         except Exception:
             pass
+    if chunk > 1:
+        extra["device_chunk_size"] = chunk
     if phases:
         extra["phases_s"] = phases
+        extra["phases_dispatch_s"] = phases_dispatch
     elif phases_error:
         extra["phases_error"] = phases_error
     if mfu_estimate is not None:
@@ -610,7 +673,7 @@ def _run() -> None:
     )
     print(
         "bench detail: platform=%s rows=%d bin=%.1fs warmup(%d)=%.1fs bench(%d)=%.1fs train-AUC=%.5f"
-        % (platform, n_rows, bin_time, WARMUP_ITERS, warmup_time, bench_iters, bench_time, auc),
+        % (platform, n_rows, bin_time, warmup_iters, warmup_time, bench_iters, bench_time, auc),
         file=sys.stderr,
     )
 

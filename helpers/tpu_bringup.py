@@ -59,6 +59,7 @@ STAGE_TIMEOUTS = {
     "smoke_xla_radix": 1800,  # same smoke, plain-XLA radix factorization
     "smoke_bf16": 1800,  # same smoke, bf16 MXU operands (AUC delta record)
     "smoke_psplit": 1800,  # opt-in Pallas split-scan kernel (first lowering)
+    "bench_chunk": 3600,   # device-resident boosting sweep at the 1M shape
     "bench": 3600,
 }
 
@@ -275,6 +276,69 @@ SMOKE_PSPLIT = SMOKE.replace(
 assert "SPLIT_IMPL" in SMOKE_PSPLIT
 
 
+# Device-resident boosting sweep (ISSUE 2 tentpole): train the 1M Higgs
+# shape with device_chunk_size in {1, 4, 16} — chunk>1 fuses that many
+# boosting iterations into ONE jitted lax.scan dispatch (GBDT.train_chunk),
+# removing the per-iteration host round-trip the r4 breakdown measured.
+# Records per-iteration host-wall (dispatch) vs pipeline-closed total time
+# so the dispatch gap is a first-class number; bench.py auto-adopts the
+# winning chunk via the "winner_chunk" field, like the r5 grower bake-off.
+BENCH_CHUNK = _COMMON + """
+sys.path.insert(0, %r)
+os.environ.setdefault("LIGHTGBM_TPU_LATTICE", "pow2")
+import lightgbm_tpu as lgb
+
+from bench import make_higgs_like
+
+on_chip = jax.default_backend() in ("tpu", "axon")
+# headline 1M Higgs shape on silicon; the CPU dress rehearsal shrinks to
+# fit the stage timeout (its rates rehearse the mechanism only — platform
+# tagging keeps them out of bench adoption, like every other stage)
+N, LEAVES, ITERS = (1_000_000, 255, 16) if on_chip else (20_000, 31, 8)
+X, y = make_higgs_like(N, 28)
+ds = lgb.Dataset(X, label=y)
+sweep = {}
+best, best_rate = 1, -1.0
+for c in (1, 4, 16):
+    params = {"objective": "binary", "num_leaves": LEAVES, "max_bin": 255,
+              "learning_rate": 0.1, "verbosity": -1, "device_chunk_size": c}
+    bst = lgb.Booster(params=params, train_set=ds)
+
+    def run(count):
+        i = 0
+        while i < count:
+            if c > 1:
+                done, _ = bst.update_chunk(min(c, count - i))
+                i += max(done, 1)
+            else:
+                bst.update()
+                i += 1
+
+    # warmup compiles BOTH programs the measured loop will use: the
+    # sequential first iteration, then one full c-sized chunk
+    run(c + 1)
+    _ = float(jnp.ravel(bst._gbdt.scores)[0])
+    meas = max(ITERS // max(c, 1), 1) * max(c, 1)  # whole chunks only
+    t0 = time.time()
+    run(meas)
+    host_wall_s = time.time() - t0   # time the HOST spent issuing the work
+    _ = float(jnp.ravel(bst._gbdt.scores)[0])  # close the async pipeline
+    total_s = time.time() - t0
+    sweep[str(c)] = {
+        "iters_per_sec": round(meas / total_s, 3),
+        "host_wall_per_iter_s": round(host_wall_s / meas, 5),
+        "total_per_iter_s": round(total_s / meas, 5),
+        "device_gap_per_iter_s": round((total_s - host_wall_s) / meas, 5),
+    }
+    if meas / total_s > best_rate:
+        best, best_rate = c, meas / total_s
+print(json.dumps({"ok": len(sweep) == 3, "winner_chunk": best,
+                  "sweep": sweep, "rows": N, "num_leaves": LEAVES,
+                  "platform": jax.default_backend()}))
+""" % REPO
+assert "device_chunk_size" in BENCH_CHUNK
+
+
 def log_line(stage: str, payload: dict) -> None:
     with open(LOG, "a") as f:
         f.write(json.dumps({"t": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -384,6 +448,9 @@ def main() -> int:
                        ("smoke_bf16", SMOKE_BF16),
                        ("smoke_xla_radix", SMOKE_XLA_RADIX),
                        ("smoke_psplit", SMOKE_PSPLIT),
+                       # chunked-boosting sweep before pack4: it feeds the
+                       # final bench's device_chunk_size auto-adoption
+                       ("bench_chunk", BENCH_CHUNK),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         result = run_bench(stage) if src is None else run_stage(stage, src)

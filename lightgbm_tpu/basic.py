@@ -755,6 +755,20 @@ class Booster:
         grad, hess = fobj(_score_for_custom(score, K), self._train_dataset)
         return self._gbdt.train_one_iter(np.asarray(grad), np.asarray(hess))
 
+    def update_chunk(self, n: int, sync_stop: bool = False):
+        """Up to ``n`` boosting iterations as ONE device-resident dispatch
+        (GBDT.train_chunk — the jitted lax.scan boosting loop); returns
+        (iterations_run, stopped). Falls back to a single update() when
+        chunking cannot engage (device_chunk_fallback_reason), so callers
+        may loop on it unconditionally — except custom-gradient training
+        (objective "none"), which must call update(fobj) per iteration, as
+        there is no gradient source here. ``sync_stop=True`` resolves the
+        deferred no-split check before returning (set it when evaluation
+        follows at this boundary)."""
+        if n <= 1 or self._gbdt.device_chunk_fallback_reason() is not None:
+            return 1, self.update()
+        return self._gbdt.train_chunk(n, sync_stop=sync_stop)
+
     def rollback_one_iter(self) -> "Booster":
         self._gbdt.rollback_one_iter()
         return self

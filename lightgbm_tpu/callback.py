@@ -15,10 +15,32 @@ from typing import Callable, Dict, List
 
 # The tuple layout engine.train builds for every iteration; each evaluation
 # entry is (dataset_name, metric_name, value, is_higher_better[, stdv]).
-CallbackEnv = collections.namedtuple(
+_CallbackEnvBase = collections.namedtuple(
     "CallbackEnv",
     ["model", "params", "iteration", "begin_iteration", "end_iteration", "evaluation_result_list"],
 )
+
+
+class CallbackEnv(_CallbackEnvBase):
+    """The 6-tuple the reference API hands to callbacks, unchanged — user
+    callbacks that unpack it positionally keep working. ``chunk`` rides as
+    an ATTRIBUTE (not a tuple field): the number of boosting iterations
+    this invocation covers — 1 in the per-iteration loop, the executed
+    chunk length under device-resident chunked boosting
+    (device_chunk_size > 1), where callbacks observe only chunk BOUNDARIES
+    and ``iteration`` is the last completed iteration of the window
+    (docs/DeviceResidentBoosting.md)."""
+
+    def __new__(
+        cls, model, params, iteration, begin_iteration, end_iteration,
+        evaluation_result_list, chunk: int = 1,
+    ):
+        self = super().__new__(
+            cls, model, params, iteration, begin_iteration, end_iteration,
+            evaluation_result_list,
+        )
+        self.chunk = chunk
+        return self
 
 
 class EarlyStopException(Exception):
@@ -46,13 +68,19 @@ def _fmt_line(entries, show_stdv: bool = True) -> str:
 
 
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    """Log the evaluation results every ``period`` iterations."""
+    """Log the evaluation results every ``period`` iterations.
+
+    Under chunked boosting, callbacks fire only at chunk boundaries whose
+    iteration numbers need not be period multiples; the line prints whenever
+    the boundary's ``env.chunk``-iteration window crossed one (for chunk=1
+    this is exactly the classic ``shown_iter % period == 0``)."""
 
     def _callback(env: CallbackEnv) -> None:
         if period <= 0 or not env.evaluation_result_list:
             return
         shown_iter = env.iteration + 1
-        if shown_iter % period == 0:
+        step = max(getattr(env, "chunk", 1) or 1, 1)
+        if shown_iter // period > (shown_iter - step) // period:
             print("[%d]\t%s" % (shown_iter, _fmt_line(env.evaluation_result_list, show_stdv)))
 
     _callback.order = 10  # type: ignore[attr-defined]
@@ -184,4 +212,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False, verbos
         stopper(env)
 
     _callback.order = 30  # type: ignore[attr-defined]
+    # engine.train clamps the device chunk to this window so a chunked run
+    # can never overshoot the stop detection by more than the window itself
+    _callback.stopping_rounds = stopping_rounds  # type: ignore[attr-defined]
     return _callback

@@ -165,6 +165,7 @@ PARAM_ALIASES: Dict[str, str] = {
     "valid_init_score": "valid_initscore_filename",
     "max_bins": "max_bin",
     "sigmoid_param": "sigmoid",
+    "device_chunk": "device_chunk_size",
 }
 
 _OBJECTIVE_ALIASES = {
@@ -355,6 +356,15 @@ class Config:
     # grad/hess operands round to bf16, accumulation stays f32 — the
     # reference GPU path's single-precision trade, GPU-Performance.rst:131).
     tpu_hist_dtype: str = "float32"
+    # Device-resident boosting: fuse this many boosting iterations into ONE
+    # jitted lax.scan dispatch (models/gbdt.py train_chunk). 1 = the
+    # per-iteration host loop. >1 trades per-iteration callback/eval
+    # granularity (they run at chunk boundaries) for the removal of the
+    # host dispatch gap between iterations; tree sequences are bit-exact
+    # either way. DART/GOSS/RF, custom objectives, CEGB, parallel learners
+    # and the native CPU learner fall back to 1 automatically
+    # (docs/DeviceResidentBoosting.md).
+    device_chunk_size: int = 1
 
     # resolved, not user-set
     is_parallel: bool = False
@@ -375,6 +385,10 @@ class Config:
             log.fatal("alpha must be > 0, got %g" % self.alpha)
         if self.num_class < 1:
             log.fatal("num_class must be >= 1, got %d" % self.num_class)
+        if self.device_chunk_size < 1:
+            log.fatal(
+                "device_chunk_size must be >= 1, got %d" % self.device_chunk_size
+            )
 
     # -- parsing ---------------------------------------------------------
 

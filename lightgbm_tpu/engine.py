@@ -102,13 +102,38 @@ def train(
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
+    # Device-resident chunked boosting (GBDT.train_chunk): up to
+    # device_chunk_size iterations fuse into one jitted dispatch; callbacks,
+    # eval and early stopping then observe chunk BOUNDARIES only
+    # (docs/DeviceResidentBoosting.md). Custom objectives and
+    # before-iteration callbacks (reset_parameter mutates per-iteration
+    # config) force the per-iteration loop; early stopping clamps the chunk
+    # so a stop can never overshoot its detection window.
+    chunk = 1
+    if fobj is None and not cbs_before:
+        chunk = booster._gbdt.device_chunk()
+        if chunk > 1 and early_stopping_rounds is not None and early_stopping_rounds > 0:
+            chunk = min(chunk, early_stopping_rounds)
+        # an early_stopping() instance handed in via callbacks= carries its
+        # window as an attribute — clamp to it too, or the stop check would
+        # run at chunk granularity instead of the requested one
+        for cb in cbs_after:
+            sr = getattr(cb, "stopping_rounds", 0)
+            if chunk > 1 and isinstance(sr, int) and sr > 0:
+                chunk = min(chunk, sr)
+
     evaluation_result_list: List = []
     with timer_mod.maybe_profile():
         evaluation_result_list = _boost_loop(
             booster, params, fobj, feval, valid_sets, is_valid_contain_train,
             train_data_name, init_iteration, num_boost_round,
-            cbs_before, cbs_after,
+            cbs_before, cbs_after, chunk,
         )
+    # resolve the deferred no-split check before handing the booster back:
+    # a stop inside the FINAL chunk (or final iteration) would otherwise
+    # leave rolled-back-to-be trees visible to num_trees/current_iteration
+    # until something materializes the model
+    booster._gbdt._consume_pending_stop()
     booster._gbdt.timers.report()
 
     booster.best_score = collections.defaultdict(collections.OrderedDict)
@@ -122,10 +147,21 @@ def train(
 def _boost_loop(
     booster, params, fobj, feval, valid_sets, is_valid_contain_train,
     train_data_name, init_iteration, num_boost_round, cbs_before, cbs_after,
+    chunk: int = 1,
 ):
-    """The boosting iteration loop; returns the last evaluation result list."""
+    """The boosting iteration loop; returns the last evaluation result list.
+
+    ``chunk > 1`` steps by device-resident chunks (Booster.update_chunk):
+    eval and after-iteration callbacks run once per chunk boundary with
+    ``iteration`` = the last completed iteration; ``chunk=1`` is the classic
+    per-iteration loop, byte-identical to the pre-chunking behavior."""
     evaluation_result_list: List = []
-    for i in range(init_iteration, init_iteration + num_boost_round):
+    needs_eval = valid_sets is not None or bool(
+        params.get("is_provide_training_metric")
+    )
+    i = init_iteration
+    end = init_iteration + num_boost_round
+    while i < end:
         for cb in cbs_before:
             cb(
                 callback_mod.CallbackEnv(
@@ -133,14 +169,24 @@ def _boost_loop(
                     params=params,
                     iteration=i,
                     begin_iteration=init_iteration,
-                    end_iteration=init_iteration + num_boost_round,
+                    end_iteration=end,
                     evaluation_result_list=None,
                 )
             )
-        finished = booster.update(fobj=fobj)
+        if chunk > 1 and end - i >= chunk:
+            done, finished = booster.update_chunk(chunk, sync_stop=needs_eval)
+            if done == 0:
+                break
+        else:
+            # the tail shorter than a chunk runs per-iteration: a tail-sized
+            # scan would trace + XLA-compile a whole second boosting program
+            # to save at most chunk-1 host round-trips
+            finished = booster.update(fobj=fobj)
+            done = 1
+        i += done
 
         evaluation_result_list = []
-        if valid_sets is not None or params.get("is_provide_training_metric"):
+        if needs_eval:
             if is_valid_contain_train:
                 evaluation_result_list.extend(
                     [(train_data_name, n, v, b) for (_, n, v, b) in booster.eval_train(feval)]
@@ -155,10 +201,11 @@ def _boost_loop(
                     callback_mod.CallbackEnv(
                         model=booster,
                         params=params,
-                        iteration=i,
+                        iteration=i - 1,
                         begin_iteration=init_iteration,
-                        end_iteration=init_iteration + num_boost_round,
+                        end_iteration=end,
                         evaluation_result_list=evaluation_result_list,
+                        chunk=done,
                     )
                 )
         except callback_mod.EarlyStopException as es:

@@ -28,7 +28,7 @@ from ..dataset import BinnedDataset
 from ..metric import Metric
 from ..objective import ObjectiveFunction
 from ..ops import grow_native
-from ..ops.grow import grow_tree
+from ..ops.grow import grow_tree, grow_tree_scan
 from ..ops.predict import PredictTree, make_predict_tree, tree_predict_value
 from ..ops.split import CegbParams, SplitParams
 from ..utils import log
@@ -158,6 +158,8 @@ class GBDT:
         self._bagging_active = False
         self._finish_fns = {}  # jitted renew+shrink+score-update steps per class
         self._pending_stop = None  # last iteration's device num_leaves scalars
+        self._pending_chunk = None  # last chunk's stacked [n, K] num_leaves
+        self._chunk_fns = {}  # jitted n-iteration boosting scans (train_chunk)
         self._stopped = False
         # variants with state-mutating _after_train_iter hooks set this False
         # to run the no-split stop check synchronously (see train_one_iter)
@@ -381,6 +383,26 @@ class GBDT:
         mask[idx] = True
         return jnp.asarray(mask)
 
+    def _sample_feature_masks(self, n: int) -> jax.Array:
+        """The next ``n`` iterations' feature_fraction masks pre-drawn with
+        the SAME host RNG stream and draw order the per-iteration path uses
+        (iteration-major, class-minor — so tree sequences stay bit-exact)
+        and uploaded ONCE as a stacked [n, K, F] bool array: one transfer
+        per chunk instead of one per tree, and none at feature_fraction=1
+        (the cached all-ones mask broadcasts without a host copy)."""
+        cfg = self.config
+        F = self.train_set.num_features
+        K = self.num_tree_per_iteration
+        if cfg.feature_fraction >= 1.0:
+            return jnp.broadcast_to(self._fmask_all, (n, K, F))
+        k = max(1, int(cfg.feature_fraction * F))
+        masks = np.zeros((n, K, F), bool)
+        for i in range(n):
+            for c in range(K):
+                idx = self._feat_rng.choice(F, size=k, replace=False)
+                masks[i, c, idx] = True
+        return jnp.asarray(masks)
+
     # ------------------------------------------------------------------
     def train_one_iter(
         self, gradients: Optional[np.ndarray] = None, hessians: Optional[np.ndarray] = None
@@ -421,17 +443,19 @@ class GBDT:
             tree_arrays = None
             leaf_id = None
             if self.class_need_train[k] and self.train_set.num_features > 0:
-                with timers.phase("tree growth"):
+                # ph.mark records host dispatch time; it only BLOCKS under
+                # the LIGHTGBM_TPU_TIMERS=sync opt-in — an always-on sync
+                # here serialized every phase whenever timing was enabled,
+                # destroying the pipelining being measured (utils/timer.py)
+                with timers.phase("tree growth") as ph:
                     tree_arrays, leaf_id = self._train_tree(grad[k], hess[k])
-                    if timers.enabled:
-                        jax.block_until_ready(tree_arrays)
+                    ph.mark(tree_arrays)
             if tree_arrays is not None:
                 nl_dev = tree_arrays.num_leaves
-                with timers.phase("renew+score update"):
+                with timers.phase("renew+score update") as ph:
                     # one jitted dispatch: renew + shrink + masked score add
                     tree_arrays = self._finish_tree(tree_arrays, leaf_id, k, nl_dev)
-                    if timers.enabled:
-                        jax.block_until_ready(self.scores)
+                    ph.mark(self.scores)
                 with timers.phase("valid scores"):
                     self._update_valid_scores(tree_arrays, k)
                 if abs(init_scores[k]) > K_EPSILON:
@@ -505,7 +529,34 @@ class GBDT:
     def _consume_pending_stop(self) -> bool:
         """Inspect the previous iteration's (async-copied) num_leaves scalars;
         roll back that iteration and stop if no class managed a split —
-        the deferred twin of gbdt.cpp:375-400."""
+        the deferred twin of gbdt.cpp:375-400. Chunked boosting
+        (train_chunk) generalizes the record to a [n, K] num_leaves array:
+        the first iteration where NO class split starts the rollback, and
+        everything from it to the chunk's end is popped (those trailing
+        iterations would never have run sequentially)."""
+        chunk_pend = getattr(self, "_pending_chunk", None)
+        if chunk_pend is not None:
+            self._pending_chunk = None
+            nl_dev, n = chunk_pend
+            K = self.num_tree_per_iteration
+            nl = np.asarray(nl_dev).reshape(n, K)
+            grew = (nl > 1).any(axis=1)
+            if bool(grew.all()):
+                return False
+            drop = n - int(np.argmax(~grew))
+            log.warning(
+                "Stopped training because there are no more leaves that meet"
+                " the split requirements"
+            )
+            # a chunk never contains the first-ever iteration (train_chunk
+            # runs it sequentially), so there are always >= K earlier trees
+            # and the first-iteration init-score re-add cannot apply here
+            for _ in range(drop * K):
+                self.models.pop()
+                self._device_trees.pop()
+            self.iter_ -= drop
+            self._stopped = True
+            return True
         # getattr: model-string-loaded boosters skip the training __init__
         pend = getattr(self, "_pending_stop", None)
         if not pend:
@@ -538,6 +589,224 @@ class GBDT:
                             )
         self._stopped = True
         return True
+
+    # ------------------------------------------------------------------
+    # device-resident chunked boosting (TrainOneIter x n as ONE dispatch)
+    # ------------------------------------------------------------------
+
+    def device_chunk_fallback_reason(self) -> Optional[str]:
+        """Why train_chunk must run iterations one at a time (None = the
+        chunked lax.scan can engage). Every condition names per-iteration
+        HOST state the scan body cannot carry; the chunk=1 path stays the
+        reference semantics and the two are bit-exact where both apply
+        (tests/test_device_chunk.py)."""
+        cfg = self.config
+        if cfg.device_chunk_size <= 1:
+            return "device_chunk_size <= 1"
+        if type(self) is not GBDT:
+            return "%s overrides per-iteration hooks" % type(self).__name__
+        if self.objective is None:
+            return "custom objective (host-computed gradients)"
+        if not getattr(self.objective, "supports_device_chunk", False):
+            return "objective %r keeps host state per iteration" % (
+                self.objective.name,
+            )
+        if self.train_set is None or self.train_set.num_features == 0:
+            return "no usable features (constant-tree path is host-side)"
+        if not all(self.class_need_train):
+            return "untrained constant class (class_need_train=False)"
+        if self.cegb_params.enabled:
+            return "CEGB carries cross-tree acquisition state on the host"
+        if self._learner_kind() != "serial":
+            return "parallel learner (sharding is applied per dispatch)"
+        if (
+            grow_native.unsupported_reason(
+                cfg, self.feature_meta, self._forced_splits, self.cegb_params,
+                self.num_bins, self.num_group_bins,
+            )
+            is None
+        ):
+            return "native host learner in use (device_type=cpu)"
+        return None
+
+    def device_chunk(self) -> int:
+        """Effective chunk size for the engine's boosting loop (1 = the
+        per-iteration host loop; reasons via device_chunk_fallback_reason)."""
+        if self.device_chunk_fallback_reason() is not None:
+            return 1
+        return self.config.device_chunk_size
+
+    def train_chunk(self, n: int, sync_stop: bool = False):
+        """Run up to ``n`` boosting iterations; returns (iterations_run,
+        stopped).
+
+        When the chunked path is available (device_chunk_fallback_reason is
+        None) and ``n > 1``, the whole block — gradients, bagging draw, tree
+        growth, renew/shrink/score update, for every iteration and class —
+        executes as ONE jitted ``lax.scan`` dispatch, eliminating the
+        per-iteration host round-trips train_one_iter pays (the ~66ms TPU
+        tunnel gap its docstring documents). Arithmetic and RNG streams are
+        identical to the sequential path, so the produced trees and scores
+        are bit-exact (tests/test_device_chunk.py).
+
+        The no-split stop check generalizes from 1 deferred iteration to
+        the chunk boundary: the [n, K] num_leaves array starts a host-async
+        copy here and is inspected at the NEXT boundary, unless
+        ``sync_stop=True`` (set when an eval follows at this boundary) or
+        validation sets are attached — then it resolves before returning so
+        rolled-back trees can never touch evaluation state. Iterations a
+        chunk runs PAST a mid-chunk stop contribute exact zeros on device
+        (the scan body's ``stopped`` carry forces the finish step's
+        num_leaves mask), so train scores stay bitwise equal to the
+        sequential path even across stops (docs/DeviceResidentBoosting.md)."""
+        if n <= 1 or self.device_chunk_fallback_reason() is not None:
+            return 1, self.train_one_iter()
+        if self._consume_pending_stop() or self._stopped:
+            return 0, True
+        if not self._device_trees:
+            # the FIRST iteration keeps the sequential path: boost_from_average,
+            # init-score leaf folding and zero-feature constant trees are
+            # host-side decisions that exist only there (gbdt.cpp:308-413)
+            return 1, self.train_one_iter()
+        K = self.num_tree_per_iteration
+        timers = self.timers
+        with timers.phase("chunked boosting") as ph:
+            fmasks = self._sample_feature_masks(n)
+            fn = self._chunk_fn(n)
+            self.scores, self._bag_mask, trees_out, nl_dev = fn(
+                self.scores, self._bag_mask, jnp.int32(self.iter_), fmasks,
+                self._finish_scalar(0),
+            )
+            ph.mark(nl_dev)
+        try:
+            nl_dev.copy_to_host_async()  # [n, K]
+        except AttributeError:
+            pass
+        base = len(self._device_trees)
+        for idx, ta in enumerate(trees_out):  # iteration-major, class-minor
+            self._device_trees.append((ta, idx % K))
+            self.models.append(None)  # lazily converted
+        self.iter_ += n
+        self._pending_chunk = (nl_dev, n)
+        if sync_stop or hasattr(self, "valid_scores"):
+            stopped = self._consume_pending_stop()
+            with timers.phase("valid scores"):
+                # the SURVIVING trees of this chunk (a stop pops its no-split
+                # tail first, so rolled-back trees never touch valid scores;
+                # the sequential path's popped trees contributed exact zeros)
+                for ta, k in self._device_trees[base:]:
+                    self._update_valid_scores(ta, k)
+            if stopped:
+                return n, True
+        return n, False
+
+    def _chunk_fn(self, n: int):
+        """Build (and cache) the jitted ``n``-iteration boosting scan. The
+        cache key pins every trace-time constant the closure bakes in, so a
+        reset_parameter between train() calls can never reuse a stale
+        program. ``scores`` and the bag mask are donated — the caller
+        re-adopts both from the outputs."""
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        N = self.num_data
+        bag_on = cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0
+        if bag_on:
+            self._bagging_active = True
+        bag_cnt = int(cfg.bagging_fraction * N) if bag_on else N
+        freq = cfg.bagging_freq
+        finish = [self._finish_step(k) for k in range(K)]
+        slots = self._hist_pool_slots()
+        key = (
+            n, K, N, bag_on, bag_cnt, freq, slots,
+            tuple(fk for fk, _ in finish),
+            cfg.num_leaves, cfg.max_depth, self.num_bins, self.num_group_bins,
+            self.split_params, cfg.tpu_hist_chunk, cfg.tpu_hist_dtype,
+            cfg.tpu_hist_mode, self._two_way, self._forced_splits,
+        )
+        fn = self._chunk_fns.get(key)
+        if fn is not None:
+            return fn
+        obj = self.objective
+        bins = self.bins_dev
+        feature_meta = self.feature_meta
+        bag_key = self._bag_key
+        steps = [s for _, s in finish]
+        grow_kwargs = dict(
+            num_leaves=cfg.num_leaves, max_depth=cfg.max_depth,
+            num_bins=self.num_bins, num_group_bins=self.num_group_bins,
+            params=self.split_params, chunk=cfg.tpu_hist_chunk,
+            hist_dtype=cfg.tpu_hist_dtype, hist_mode=cfg.tpu_hist_mode,
+            two_way=self._two_way, forced_splits=self._forced_splits,
+            cegb=self.cegb_params, cegb_state=None, hist_buf=None,
+            bins_nf=self.bins_dev_nf, hist_pool_slots=slots,
+        )
+
+        def chunk_fn(scores, bag_mask, it0, fmasks, rate):
+            def body(carry, xs):
+                scores, bag, stopped = carry
+                it, fmask_k = xs
+                # _compute_gradients' exact shape logic, on the carry scores
+                grad, hess = obj.get_gradients(scores if K > 1 else scores[0])
+                if K == 1:
+                    grad, hess = grad[None, :], hess[None, :]
+                if bag_on:
+                    # same draw the sequential _bagging makes, keyed by the
+                    # global iteration counter (fold_in is integer-exact, so
+                    # the mask sequence is bit-identical)
+                    bag = jax.lax.cond(
+                        it % freq == 0,
+                        lambda: _device_bag_mask(
+                            jax.random.fold_in(bag_key, it), N, bag_cnt
+                        ),
+                        lambda: bag,
+                    )
+                trees = []
+                for k in range(K):
+                    ta, leaf_id = grow_tree_scan(
+                        bins, grad[k], hess[k], bag, fmask_k[k], feature_meta,
+                        **grow_kwargs,
+                    )
+                    # once an earlier iteration of this chunk failed to split
+                    # in every class, the sequential loop would have stopped:
+                    # force the finish step's num_leaves mask so every later
+                    # iteration contributes EXACT zeros — scores stay bitwise
+                    # equal to the sequential path across mid-chunk stops
+                    # (the trees themselves are popped by the boundary check)
+                    nl_eff = jnp.where(stopped, jnp.int32(1), ta.num_leaves)
+                    scores, leaf_value, internal_value = steps[k](
+                        scores, ta.leaf_value, ta.internal_value, leaf_id,
+                        bag, nl_eff, rate,
+                    )
+                    trees.append(
+                        ta._replace(
+                            leaf_value=leaf_value, internal_value=internal_value
+                        )
+                    )
+                stopped = stopped | jnp.all(
+                    jnp.stack([t.num_leaves for t in trees]) <= 1
+                )
+                stacked_k = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *trees
+                )
+                return (scores, bag, stopped), stacked_k
+
+            its = it0 + jnp.arange(n, dtype=jnp.int32)
+            (scores, bag_mask, _), stacked = jax.lax.scan(
+                body, (scores, bag_mask, jnp.bool_(False)), (its, fmasks)
+            )
+            # unstack INSIDE the jit: one dispatch yields n*K per-tree
+            # output buffers (iteration-major), instead of n*K*15 tiny
+            # host-issued slice dispatches per chunk boundary
+            trees_out = [
+                jax.tree_util.tree_map(lambda a: a[i, k], stacked)
+                for i in range(n)
+                for k in range(K)
+            ]
+            return scores, bag_mask, trees_out, stacked.num_leaves
+
+        fn = jax.jit(chunk_fn, donate_argnums=(0, 1))
+        self._chunk_fns[key] = fn
+        return fn
 
     def _finish_tree(self, tree_arrays, leaf_id, k: int, nl_dev):
         """Renew + shrinkage + num_leaves-masked score update as ONE jitted
@@ -1034,6 +1303,13 @@ class GBDT:
         """RollbackOneIter (gbdt.cpp:415-431)."""
         if self.iter_ <= 0:
             return
+        if getattr(self, "_pending_chunk", None) is not None:
+            # resolve the chunk's deferred check first: a no-split tail always
+            # includes the last iteration, so when it fires the rollback this
+            # call was asked for has already happened (and more, as the
+            # sequential path would never have trained past the stop)
+            if self._consume_pending_stop():
+                return
         # a pending deferred stop check refers to the iteration being rolled
         # back — consuming it later would pop a SECOND (healthy) iteration
         self._pending_stop = None

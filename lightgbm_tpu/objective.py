@@ -169,6 +169,12 @@ class ObjectiveFunction:
     """Interface mirror of objective_function.h."""
 
     name = "none"
+    # True when get_gradients / renew_leaf_outputs_device are pure device
+    # (jnp) programs of the score and init-time state, safe to trace inside
+    # the chunked boosting scan (models/gbdt.py train_chunk). An objective
+    # that reads or mutates HOST state per iteration must set this False to
+    # force the per-iteration loop.
+    supports_device_chunk = True
 
     def __init__(self, config: Config) -> None:
         self.config = config
@@ -223,6 +229,27 @@ class ObjectiveFunction:
         self, score, leaf_id, bag_mask, num_leaves: int, leaf_outputs
     ):
         return leaf_outputs
+
+    def _renew_weights(self):
+        """Renew weight vector for RenewTreeOutput-style objectives (None =
+        unweighted); overridden where renewal applies."""
+        return self.weight
+
+    def _renew_weights_dev(self):
+        """Device copy of the renew weights, uploaded ONCE per training.
+        A per-call jnp.asarray would re-upload an N-sized array every tree —
+        and inside the chunked boosting scan (models/gbdt.py train_chunk)
+        re-embed it as a trace constant per chunk shape. Lives on the base
+        class because renew_leaf_outputs_device is borrowed across sibling
+        classes (RegressionQuantileLoss reuses RegressionL1Loss's)."""
+        w = self._renew_weights()
+        if w is None:
+            return None
+        cached = getattr(self, "_renew_w_dev", None)
+        if cached is None or cached.shape[0] != len(w):
+            cached = jnp.asarray(w, jnp.float32)
+            self._renew_w_dev = cached
+        return cached
 
     def class_need_train(self, class_id: int) -> bool:
         return True
@@ -322,7 +349,7 @@ class RegressionL1Loss(RegressionL2Loss):
         """Device-side RenewTreeOutput: segment percentiles, no host round-trip
         of N-sized arrays between boosting iterations."""
         w = self._renew_weights()
-        w_dev = None if w is None else jnp.asarray(w, jnp.float32)
+        w_dev = self._renew_weights_dev()
         residual = self._label_dev - score
         sel = (
             jnp.ones(residual.shape, bool) if bag_mask is None else bag_mask > 0

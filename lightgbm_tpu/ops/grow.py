@@ -1711,3 +1711,14 @@ def grow_tree(
     if hist_buf is not None:
         out = out + (final.hist,)  # aliases the donated buffer (zero-copy)
     return out
+
+
+# Scan-invocable entry: the UNDECORATED grow body, for embedding inside an
+# outer jit — the device-resident boosting loop (models/gbdt.py train_chunk)
+# calls it from a lax.scan body, where the grow must trace into the caller's
+# program instead of standing alone behind its own jit/donation boundary.
+# jax.jit preserves the wrapped function via functools.wraps; every "static"
+# argument is then an ordinary Python value closed over at trace time, and
+# ``hist_buf`` donation does not apply (pass None — XLA reuses the per-
+# iteration scratch across scan steps on its own).
+grow_tree_scan = grow_tree.__wrapped__

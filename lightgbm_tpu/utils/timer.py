@@ -6,8 +6,20 @@ the compile-time TIMETAG flag and prints them at teardown
 src/boosting/gbdt.cpp:29-42). Here whole-tree growth is one fused XLA program,
 so the observable phases are the training-loop stages around it; enable with
 the LIGHTGBM_TPU_TIMETAG=1 environment variable (the runtime analogue of the
-reference's compile-time switch). Timed blocks block_until_ready their results
-so device work is attributed to the phase that launched it.
+reference's compile-time switch).
+
+Two numbers are recorded per phase:
+
+ * ``dispatch_seconds`` — host wall time up to the phase's ``mark()`` call,
+   i.e. the time the host spent ISSUING the work (async launch cost). This is
+   always cheap to record and never perturbs pipelining.
+ * ``seconds`` — total phase wall time. With ``LIGHTGBM_TPU_TIMERS=sync`` the
+   ``mark()`` call additionally ``block_until_ready``s the phase's result, so
+   ``seconds`` becomes host-attributed DEVICE time and ``seconds -
+   dispatch_seconds`` is the per-phase device-compute gap. Without the sync
+   opt-in no blocking happens: timing a pipelined run no longer serializes
+   every phase (the pre-r6 behavior, which destroyed the very dispatch
+   overlap being measured).
 
 For kernel-level breakdowns use LIGHTGBM_TPU_PROFILE=<dir> instead, which
 wraps training in a ``jax.profiler`` trace readable in TensorBoard/Perfetto —
@@ -18,11 +30,12 @@ from __future__ import annotations
 import contextlib
 import os
 import time
-from typing import Dict
+from typing import Dict, Optional
 
 from . import log
 
 ENV_FLAG = "LIGHTGBM_TPU_TIMETAG"
+ENV_SYNC = "LIGHTGBM_TPU_TIMERS"
 ENV_PROFILE = "LIGHTGBM_TPU_PROFILE"
 
 
@@ -30,36 +43,90 @@ def timetag_enabled() -> bool:
     return os.environ.get(ENV_FLAG, "") not in ("", "0")
 
 
+def sync_enabled() -> bool:
+    """LIGHTGBM_TPU_TIMERS=sync opts into blocking per-phase device syncs
+    (implies timing on). Any other value leaves phases async."""
+    return os.environ.get(ENV_SYNC, "") == "sync"
+
+
+class _PhaseHandle:
+    """Yielded by ``PhaseTimers.phase``; ``mark(result)`` records the host
+    dispatch time and — under the sync opt-in — blocks on ``result`` so the
+    enclosing phase's total attributes device work to it."""
+
+    __slots__ = ("_sync", "_t0", "dispatch")
+
+    def __init__(self, sync: bool, t0: float) -> None:
+        self._sync = sync
+        self._t0 = t0
+        self.dispatch: Optional[float] = None
+
+    def mark(self, result=None) -> None:
+        self.dispatch = time.time() - self._t0
+        if self._sync and result is not None:
+            import jax
+
+            jax.block_until_ready(result)
+
+
+class _NoopHandle:
+    __slots__ = ()
+
+    def mark(self, result=None) -> None:
+        pass
+
+
+_NOOP = _NoopHandle()
+
+
 class PhaseTimers:
     """Accumulates wall seconds per named phase; no-op unless enabled."""
 
-    def __init__(self, enabled: bool | None = None) -> None:
-        self.enabled = timetag_enabled() if enabled is None else enabled
+    def __init__(
+        self, enabled: bool | None = None, sync: bool | None = None
+    ) -> None:
+        self.sync = sync_enabled() if sync is None else sync
+        self.enabled = (
+            (timetag_enabled() or self.sync) if enabled is None else enabled
+        )
         self.seconds: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        self.dispatch_seconds: Dict[str, float] = {}
 
     @contextlib.contextmanager
     def phase(self, name: str):
         if not self.enabled:
-            yield
+            yield _NOOP
             return
         t0 = time.time()
+        handle = _PhaseHandle(self.sync, t0)
         try:
-            yield
+            yield handle
         finally:
             dt = time.time() - t0
             self.seconds[name] = self.seconds.get(name, 0.0) + dt
             self.counts[name] = self.counts.get(name, 0) + 1
+            # a phase that never mark()ed is all host work: dispatch == total
+            host = handle.dispatch if handle.dispatch is not None else dt
+            self.dispatch_seconds[name] = (
+                self.dispatch_seconds.get(name, 0.0) + host
+            )
 
     def report(self) -> None:
         if not self.enabled or not self.seconds:
             return
         total = sum(self.seconds.values())
-        log.info("phase timing (TIMETAG):")
+        log.info(
+            "phase timing (TIMETAG%s):" % (", synced" if self.sync else "")
+        )
         for name, secs in sorted(self.seconds.items(), key=lambda kv: -kv[1]):
+            disp = self.dispatch_seconds.get(name, secs)
             log.info(
-                "  %-18s %8.3fs  (%5.1f%%, %d calls)"
-                % (name, secs, 100.0 * secs / max(total, 1e-12), self.counts[name])
+                "  %-18s %8.3fs  (%5.1f%%, %d calls, dispatch %.3fs)"
+                % (
+                    name, secs, 100.0 * secs / max(total, 1e-12),
+                    self.counts[name], disp,
+                )
             )
         log.info("  %-18s %8.3fs" % ("total", total))
 

@@ -105,6 +105,56 @@ def test_cpu_measured_stages_never_adopted():
     assert rec["winner"] == "smoke"
 
 
+def test_bench_chunk_winner_adopted():
+    """The bench_chunk sweep's winner composes with the smoke bake-off: the
+    headline run gets BOTH the env knobs and device_chunk_size."""
+    stages = {
+        "smoke": _st(2.0),
+        "smoke_seq": _st(3.5),
+        "bench_chunk": {"ok": True, "platform": "tpu", "winner_chunk": 4},
+    }
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert rec["winner"] == "smoke_seq"
+    assert pars == {"device_chunk_size": 4}
+    assert rec["device_chunk_size"] == 4
+
+
+def test_bench_chunk_winner_1_is_a_noop():
+    stages = {
+        "smoke": _st(2.0),
+        "smoke_seq": _st(1.0),
+        "bench_chunk": {"ok": True, "platform": "tpu", "winner_chunk": 1},
+    }
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert pars == {}
+    assert "device_chunk_size" not in rec
+
+
+def test_bench_chunk_cpu_rehearsal_ignored():
+    """A CPU-measured bench_chunk sweep (dress rehearsal) must never steer
+    the real chip window, like every other off-chip rate."""
+    stages = {
+        "smoke": _st(2.0),
+        "smoke_seq": _st(1.0),
+        "bench_chunk": {"ok": True, "platform": "cpu", "winner_chunk": 16},
+    }
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert pars == {}
+
+
+def test_bench_chunk_alone_still_adopts():
+    """All smoke stages failed but the chunk sweep landed: its winner is
+    still worth the headline run."""
+    stages = {
+        "smoke": _st(None, ok=False),
+        "smoke_seq": _st(None, ok=False),
+        "bench_chunk": {"ok": True, "platform": "tpu", "winner_chunk": 16},
+    }
+    pars, rec = bench._adopt_from_bringup("tpu", stages)
+    assert pars == {"device_chunk_size": 16}
+    assert rec["winner"] == "bench_chunk"
+
+
 def test_preset_env_knob_blocks_adoption():
     """The orchestrator's crash-recovery retry injects
     LIGHTGBM_TPU_HIST_IMPL=xla; adoption must never clobber it with the

@@ -12,7 +12,7 @@ import helpers.tpu_bringup as tb
 
 STAGES = (
     "MATMUL", "PALLAS", "PACK4", "SMOKE", "SMOKE_SEQ", "SMOKE_PALLAS",
-    "SMOKE_XLA_RADIX", "SMOKE_BF16", "SMOKE_PSPLIT",
+    "SMOKE_XLA_RADIX", "SMOKE_BF16", "SMOKE_PSPLIT", "BENCH_CHUNK",
 )
 
 
@@ -26,7 +26,7 @@ def test_stage_table_complete():
     assert set(tb.STAGE_TIMEOUTS) == {
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "bench_early",
         "smoke_pallas", "smoke_xla_radix", "smoke_bf16", "smoke_psplit",
-        "bench",
+        "bench_chunk", "bench",
     }
 
 
@@ -49,6 +49,18 @@ def test_env_overrides_precede_import():
     for src in (tb.SMOKE_SEQ, tb.SMOKE_PALLAS, tb.SMOKE_XLA_RADIX,
                 tb.SMOKE_PSPLIT):
         assert src.index("os.environ[") < src.index("import lightgbm_tpu")
+    assert tb.BENCH_CHUNK.index("LIGHTGBM_TPU_LATTICE") < tb.BENCH_CHUNK.index(
+        "import lightgbm_tpu"
+    )
+
+
+def test_bench_chunk_sweeps_and_reports_winner():
+    """bench.py's adoption contract: the stage must sweep {1, 4, 16} and
+    emit winner_chunk + per-chunk host-wall/total split."""
+    for needle in ("for c in (1, 4, 16)", "winner_chunk",
+                   "host_wall_per_iter_s", "device_gap_per_iter_s",
+                   "update_chunk"):
+        assert needle in tb.BENCH_CHUNK, needle
 
 
 def test_timeloop_protocol_in_common():

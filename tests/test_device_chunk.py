@@ -1,0 +1,242 @@
+"""Device-resident chunked boosting (GBDT.train_chunk) differential suite.
+
+``device_chunk_size = n`` fuses n boosting iterations into ONE jitted
+lax.scan dispatch; since no arithmetic and no RNG stream changes, the
+produced trees, train scores and validation scores must be BIT-exact
+against the sequential per-iteration path (chunk=1) — which these tests
+pin across the configs named by ISSUE 2: bagging on/off,
+feature_fraction < 1, multiclass K > 1, a renew objective, and the
+mid-training early-stop-on-no-split rollback (linear trees do not exist in
+this port, so "linear tree off" is the only state). DART and GOSS assert
+the chunk=1 fallback engages. Contract: docs/DeviceResidentBoosting.md.
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+N_ROWS, N_FEAT, ROUNDS = 500, 5, 9
+
+
+def _data(seed=0, nclass=None):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(N_ROWS, N_FEAT)
+    if nclass is None:
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    else:
+        y = (np.abs(X[:, 0] * 2 + X[:, 1]).astype(int) % nclass).astype(float)
+    return X, y
+
+
+def _strip_params(model_str):
+    """Trees + feature metadata only: the trailing parameters dump echoes
+    device_chunk_size itself and legitimately differs."""
+    return model_str.split("parameters:")[0]
+
+
+def _train(params, X, y, chunk, rounds, valid=False):
+    p = dict(params)
+    p.setdefault("verbosity", -1)
+    p["device_chunk_size"] = chunk
+    kw = {}
+    evals = {}
+    if valid:
+        kw = dict(
+            valid_sets=[lgb.Dataset(X, label=y)],
+            valid_names=["v0"],
+            evals_result=evals,
+            verbose_eval=False,
+        )
+    bst = lgb.train(p, lgb.Dataset(X, label=y), rounds, **kw)
+    return bst, evals
+
+
+def _boundaries(total, chunk):
+    """Iteration counts at the chunked loop's eval boundaries: the first
+    iteration runs sequentially, then whole chunks, and a tail shorter
+    than a chunk runs per-iteration (engine._boost_loop — a tail-sized
+    scan would compile a second boosting program)."""
+    out, i = [], 0
+    while i < total:
+        if chunk > 1 and total - i >= chunk:
+            i += 1 if not out else chunk
+        else:
+            i += 1
+        out.append(i)
+    return out
+
+
+def _assert_bitwise(params, chunks, rounds=ROUNDS, nclass=None, valid=False,
+                    seed=0):
+    X, y = _data(seed, nclass)
+    ref, ref_ev = _train(params, X, y, 1, rounds, valid)
+    ref_model = _strip_params(ref.model_to_string())
+    ref_scores = np.asarray(ref._gbdt.scores)
+    for c in chunks:
+        got, got_ev = _train(params, X, y, c, rounds, valid)
+        assert got._gbdt.device_chunk_fallback_reason() is None
+        assert got.num_trees() == ref.num_trees(), "chunk=%d" % c
+        assert _strip_params(got.model_to_string()) == ref_model, (
+            "chunk=%d trees differ" % c
+        )
+        assert np.array_equal(np.asarray(got._gbdt.scores), ref_scores), (
+            "chunk=%d scores differ" % c
+        )
+        if valid:
+            assert np.array_equal(
+                np.asarray(got._gbdt.valid_scores[0]),
+                np.asarray(ref._gbdt.valid_scores[0]),
+            ), "chunk=%d valid scores differ" % c
+            # chunked eval history = the sequential one sampled at the
+            # chunk boundaries, value-for-value (bit-exact floats)
+            for dname, metrics in got_ev.items():
+                for mname, vals in metrics.items():
+                    seq = ref_ev[dname][mname]
+                    picks = [seq[b - 1] for b in _boundaries(rounds, c)]
+                    assert vals == picks, "chunk=%d eval history" % c
+    return ref
+
+
+_BINARY = {"objective": "binary", "num_leaves": 6, "min_data_in_leaf": 5}
+
+
+def test_plain_binary_chunks_2_4_8():
+    _assert_bitwise(_BINARY, chunks=(2, 4, 8))
+
+
+def test_bagging_chunks():
+    _assert_bitwise(
+        dict(_BINARY, bagging_fraction=0.6, bagging_freq=2), chunks=(2, 4),
+        seed=1,
+    )
+
+
+def test_feature_fraction_chunks():
+    _assert_bitwise(
+        dict(_BINARY, feature_fraction=0.5), chunks=(4, 8), seed=2
+    )
+
+
+def test_multiclass_chunks():
+    _assert_bitwise(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 6,
+         "min_data_in_leaf": 5},
+        chunks=(2, 4), nclass=3, seed=3,
+    )
+
+
+def test_renew_objective_chunks():
+    # regression_l1 exercises the device renew hook inside the scan body
+    _assert_bitwise(
+        {"objective": "regression_l1", "num_leaves": 6, "min_data_in_leaf": 5},
+        chunks=(4,), seed=4,
+    )
+
+
+def test_valid_eval_at_chunk_boundaries():
+    _assert_bitwise(_BINARY, chunks=(4,), valid=True, seed=5)
+
+
+def test_no_split_stop_mid_chunk():
+    """A gain threshold the data outgrows mid-training: the chunked loop
+    must roll back to exactly the sequential stop point."""
+    params = dict(_BINARY, min_gain_to_split=18.0)
+    ref = _assert_bitwise(params, chunks=(4,), rounds=24, seed=6)
+    assert 1 <= ref.num_trees() < 24, (
+        "config no longer stops mid-training; retune min_gain_to_split"
+    )
+
+
+def test_no_split_stop_with_bagging():
+    """With bagging, iterations AFTER a mid-chunk stop can find splits the
+    stop iteration could not (different bag) — the scan body's ``stopped``
+    carry must zero their score contributions so train scores stay bitwise
+    equal to the sequential path, which never trained them."""
+    params = dict(
+        _BINARY, bagging_fraction=0.6, bagging_freq=1, min_gain_to_split=30.0
+    )
+    ref = _assert_bitwise(params, chunks=(4,), rounds=20, seed=10)
+    assert 1 <= ref.num_trees() < 20, (
+        "config no longer stops mid-training; retune min_gain_to_split"
+    )
+
+
+def test_no_split_stop_with_valid_eval():
+    """A mid-chunk stop with a valid set attached: the chunk's SURVIVING
+    trees must still reach the validation scores (a stop that early-returns
+    before the valid update leaves eval state stale), and rolled-back trees
+    must never touch them — final valid scores bit-equal to sequential."""
+    X, y = _data(6)
+    params = dict(_BINARY, min_gain_to_split=18.0, verbosity=-1)
+    boosters = []
+    for c in (1, 4):
+        p = dict(params, device_chunk_size=c)
+        bst = lgb.train(
+            p, lgb.Dataset(X, label=y), 24,
+            valid_sets=[lgb.Dataset(X, label=y)], valid_names=["v0"],
+            verbose_eval=False,
+        )
+        boosters.append(bst)
+    ref, got = boosters
+    assert 1 <= ref.num_trees() < 24
+    assert got.num_trees() == ref.num_trees()
+    assert _strip_params(got.model_to_string()) == _strip_params(
+        ref.model_to_string()
+    )
+    assert np.array_equal(
+        np.asarray(got._gbdt.valid_scores[0]),
+        np.asarray(ref._gbdt.valid_scores[0]),
+    )
+
+
+def test_variant_fallback_to_chunk1():
+    """DART/GOSS keep per-iteration host hooks: chunking must decline and
+    training must still work through the sequential path."""
+    X, y = _data(7)
+    for boosting in ("dart", "goss"):
+        p = {"objective": "binary", "boosting": boosting, "num_leaves": 6,
+             "min_data_in_leaf": 5, "verbosity": -1, "device_chunk_size": 4}
+        bst = lgb.train(p, lgb.Dataset(X, label=y), 4)
+        g = bst._gbdt
+        reason = g.device_chunk_fallback_reason()
+        assert reason is not None and boosting.upper() in reason.upper()
+        assert g.device_chunk() == 1
+        assert bst.num_trees() >= 1
+
+
+def test_custom_fobj_falls_back():
+    """fobj callers get host gradients per iteration: the engine must keep
+    the per-iteration loop even with device_chunk_size set."""
+    X, y = _data(8)
+
+    def fobj(preds, ds):
+        preds = np.asarray(preds, np.float64)
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - y, p * (1.0 - p)
+
+    params = dict(_BINARY, device_chunk_size=4, verbosity=-1)
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 3, fobj=fobj)
+    assert bst.num_trees() == 3
+    assert bst._gbdt.device_chunk_fallback_reason() is not None
+
+
+def test_manual_update_chunk_matches_update_loop():
+    """Booster.update_chunk is the manual API (the bench loop); a chunked
+    manual loop must reproduce the per-update loop bit-exactly, including
+    the deferred boundary stop check with no valid sets attached."""
+    X, y = _data(9)
+    pa = dict(_BINARY, verbosity=-1, device_chunk_size=1)
+    pb = dict(_BINARY, verbosity=-1, device_chunk_size=4)
+    a = lgb.Booster(params=pa, train_set=lgb.Dataset(X, label=y))
+    for _ in range(ROUNDS):
+        a.update()
+    b = lgb.Booster(params=pb, train_set=lgb.Dataset(X, label=y))
+    i = 0
+    while i < ROUNDS:
+        done, stopped = b.update_chunk(min(4, ROUNDS - i))
+        i += max(done, 1)
+        if stopped:
+            break
+    assert _strip_params(b.model_to_string()) == _strip_params(
+        a.model_to_string()
+    )
+    assert np.array_equal(np.asarray(a._gbdt.scores), np.asarray(b._gbdt.scores))

@@ -78,3 +78,31 @@ def test_phase_timers_accumulate(monkeypatch):
     assert timers.enabled
     assert timers.seconds.get("tree growth", 0.0) > 0.0
     assert timers.counts.get("boosting(grad)", 0) == 3
+    # host-wall dispatch time is recorded alongside, and without the sync
+    # opt-in nothing blocks: dispatch can never exceed the phase total
+    assert not timers.sync
+    assert 0.0 < timers.dispatch_seconds["tree growth"] <= (
+        timers.seconds["tree growth"] + 1e-9
+    )
+
+
+def test_phase_timers_sync_opt_in(monkeypatch):
+    """LIGHTGBM_TPU_TIMERS=sync implies timing on AND blocks each phase on
+    its marked result, so seconds become device-attributed wall time while
+    dispatch_seconds keep the pure launch cost (the gap is the benchable
+    dispatch overhead; utils/timer.py)."""
+    monkeypatch.delenv("LIGHTGBM_TPU_TIMETAG", raising=False)
+    monkeypatch.setenv("LIGHTGBM_TPU_TIMERS", "sync")
+    rng = np.random.RandomState(1)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 4, "verbose": -1},
+        lgb.Dataset(X, label=y),
+        num_boost_round=2,
+    )
+    timers = bst._gbdt.timers
+    assert timers.enabled and timers.sync
+    assert timers.seconds.get("tree growth", 0.0) > 0.0
+    assert timers.dispatch_seconds.get("tree growth", 0.0) > 0.0
+    timers.report()  # must not raise with the dispatch column
