@@ -627,7 +627,60 @@ def _run() -> None:
     except Exception as e:
         print("bench: roofline model failed: %s" % e, file=sys.stderr)
 
+    # ---- packed-inference serving bench (lightgbm_tpu/serve, ISSUE 3) ----
+    # rows/s of the fused single-dispatch predictor at a big batch, plus
+    # p50/p99 dispatch latency for mixed 200-1024-row batches through the
+    # pow2 bucket cache AFTER warmup — the steady-state serving numbers.
+    predict_rec = {}
+    try:
+        import jax.numpy as jnp
+
+        from lightgbm_tpu.serve.cache import BucketedDispatcher
+
+        t0 = time.time()
+        pk = booster.to_packed()
+        pack_s = time.time() - t0
+        big = min(n_rows, 1 << 17)
+        xd = jax.device_put(jnp.asarray(X[:big].astype(np.float32)))
+        out = pk.fused_scores(xd)
+        _ = float(np.asarray(jnp.ravel(out))[0])  # compile + close pipeline
+        reps = 5
+        t0 = time.time()
+        for _ in range(reps):
+            out = pk.fused_scores(xd)
+        _ = float(np.asarray(jnp.ravel(out))[0])
+        pred_rows_per_sec = big * reps / (time.time() - t0)
+        disp = BucketedDispatcher(
+            lambda x: np.asarray(pk.fused_scores(jnp.asarray(x))), min_rows=256
+        )
+        for b in (256, 512, 1024):  # warm every bucket the loop can hit
+            disp(X[:b].astype(np.float32))
+        warm_traces = disp.retraces
+        lat = []
+        lrng = np.random.RandomState(0)
+        for _ in range(40):
+            nb = int(lrng.randint(200, 1025))
+            t1 = time.time()
+            disp(X[:nb].astype(np.float32))
+            lat.append(time.time() - t1)
+        lat.sort()
+        predict_rec = {
+            "mode": "fused",
+            "pack_s": round(pack_s, 2),
+            "rows_per_sec": round(pred_rows_per_sec, 1),
+            "throughput_batch_rows": big,
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+            "p99_ms": round(lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3, 3),
+            "retraces_after_warmup": disp.retraces - warm_traces,
+            "num_trees": pk.num_trees,
+        }
+    except Exception as e:
+        predict_rec = {"error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+        print("bench: predict bench failed: %s" % e, file=sys.stderr)
+
     extra = {"platform": platform, "train_auc": round(float(auc), 6)}
+    if predict_rec:
+        extra["predict"] = predict_rec
     if adopt_record is not None:
         extra["bakeoff_adopted"] = adopt_record
     if platform not in ("tpu", "axon"):

@@ -8,6 +8,9 @@
 #   helpers/check.sh            # graftlint + ruff/mypy (if installed) + tier-1
 #   helpers/check.sh --quick    # same lint gate, then the quick pytest tier
 #   helpers/check.sh --lint     # lint gate only, no pytest
+#   helpers/check.sh --serve    # lint gate, then the serving smoke: boot
+#                               # `python -m lightgbm_tpu.serve`, hit
+#                               # /healthz + one /predict, shut down
 #
 # ruff/mypy are optional: the container may not ship them (no network
 # installs); when absent they are skipped with a notice — graftlint and
@@ -17,9 +20,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint) ;;
+    full|--quick|--lint|--serve) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick or --lint)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint or --serve)" >&2
         exit 2
         ;;
 esac
@@ -53,6 +56,11 @@ fi
 if [ "$MODE" = "--lint" ]; then
     echo "check.sh: lint gate clean"
     exit 0
+fi
+
+if [ "$MODE" = "--serve" ]; then
+    echo "== serve smoke (boot server, /healthz + /predict, shut down) =="
+    exec env JAX_PLATFORMS=cpu python helpers/serve_smoke.py
 fi
 
 if [ "$MODE" = "--quick" ]; then

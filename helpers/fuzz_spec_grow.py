@@ -4,9 +4,17 @@ configs (the r4 close-out's fuzz-sweep pattern, pointed at the r5 grower).
 Each trial draws a random config (leaves, depth, bagging, feature fraction,
 regularization, monotone, categorical, missing density, EFB, weights,
 objective, learner) and trains twice — LIGHTGBM_TPU_GROW=seq vs spec — and
-compares model strings byte for byte. Near-ties can legitimately flip under
-different f32 chunk groupings, so a mismatch triggers a prediction-
-equivalence check before being counted as a failure.
+compares model strings byte for byte, in one of two tiers (ADVICE r5 #3):
+
+- "byte" tier (even trials): forces LIGHTGBM_TPU_SPEC_HIST=flat plus the
+  xla histogram impl — the configuration test_spec_grow's exact-equality
+  contract covers. ANY model-string mismatch is a FAIL; there is no
+  tie-flip tolerance, so a prefix-validation bug that produces a
+  plausible-looking tree cannot be absorbed as benign.
+- "lanes" tier (odd trials): forces the lanes batched histogram, whose
+  vmapped common-max regrouping makes spec trees only empirically equal to
+  seq. A mismatch here falls back to the prediction-allclose check and
+  counts as "tie-flip" when predictions agree.
 
 Run: JAX_PLATFORMS=cpu python helpers/fuzz_spec_grow.py [n_trials]
 """
@@ -20,11 +28,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def one_trial(i: int):
+def one_trial(i: int, tier: str = "byte"):
     import jax
 
     import lightgbm_tpu as lgb
     import lightgbm_tpu.ops.grow as grow_mod
+    import lightgbm_tpu.ops.histogram as hist_mod
 
     rng = np.random.RandomState(1000 + i)
     n = int(rng.choice([700, 1500, 3000]))
@@ -77,19 +86,35 @@ def one_trial(i: int):
         dskw["categorical_feature"] = cat_cols
     rounds = int(rng.choice([2, 4]))
 
+    hist_prev = hist_mod._ENV_IMPL
     models = {}
-    for mode in ("seq", "spec"):
-        grow_mod._ENV_GROW = mode
-        jax.clear_caches()
-        bst = lgb.train(params, lgb.Dataset(X.copy(), label=y, **dict(dskw)), rounds)
-        models[mode] = bst
-    grow_mod._ENV_GROW = ""
+    try:
+        if tier == "byte":
+            # byte-exact tier: flat batched hist + xla impl — the combo whose
+            # equality IS structural (test_spec_grow's contract)
+            grow_mod._ENV_SPEC_HIST = "flat"
+            hist_mod._ENV_IMPL = "xla"
+        else:
+            grow_mod._ENV_SPEC_HIST = "lanes"
+        for mode in ("seq", "spec"):
+            grow_mod._ENV_GROW = mode
+            jax.clear_caches()
+            bst = lgb.train(params, lgb.Dataset(X.copy(), label=y, **dict(dskw)), rounds)
+            models[mode] = bst
+    finally:
+        grow_mod._ENV_GROW = ""
+        grow_mod._ENV_SPEC_HIST = ""
+        hist_mod._ENV_IMPL = hist_prev
     s = models["seq"].model_to_string()
     a = models["spec"].model_to_string()
     if s == a:
         return "exact"
-    # predict on the RAW matrix (NaNs included) so missing-default-direction
-    # divergence cannot hide behind the tie-flip classification
+    if tier == "byte":
+        # no tolerance in this tier: flat+xla spec must match seq bit for bit
+        print("FAIL(byte) trial %d params=%s dskw_keys=%s" % (i, params, list(dskw)))
+        return "FAIL"
+    # lanes tier: predict on the RAW matrix (NaNs included) so missing-
+    # default-direction divergence cannot hide behind the tie-flip label
     p1 = models["seq"].predict(X)
     p2 = models["spec"].predict(X)
     if np.allclose(p1, p2, rtol=5e-3, atol=5e-4):
@@ -102,11 +127,13 @@ def main():
     n_trials = int(sys.argv[1]) if len(sys.argv) > 1 else 30
     counts = {}
     for i in range(n_trials):
-        r = one_trial(i)
-        counts[r] = counts.get(r, 0) + 1
-        print("trial %d: %s  (totals %s)" % (i, r, counts), flush=True)
+        tier = "byte" if i % 2 == 0 else "lanes"
+        r = one_trial(i, tier)
+        key = "%s:%s" % (tier, r)
+        counts[key] = counts.get(key, 0) + 1
+        print("trial %d [%s]: %s  (totals %s)" % (i, tier, r, counts), flush=True)
     print("DONE", counts)
-    sys.exit(1 if counts.get("FAIL") else 0)
+    sys.exit(1 if any(k.endswith(":FAIL") for k in counts) else 0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,103 @@
+"""Serving smoke: boot the real server process, hit it, verify, shut down.
+
+The check.sh --serve gate. Trains a tiny model, saves it, launches
+``python -m lightgbm_tpu.serve`` as a SUBPROCESS (the same entry point an
+operator uses, port 0 = ephemeral), reads the startup JSON line for the
+port, then over real HTTP: /healthz must report ready, and one /predict
+must return bit-identical values to Booster.predict. Exits nonzero on any
+mismatch; always tears the server down.
+
+Run: JAX_PLATFORMS=cpu python helpers/serve_smoke.py
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def _read_startup_line(proc, timeout_s: float = 180.0):
+    """First stdout line, read on a thread so a wedged boot can't hang us."""
+    box = {}
+
+    def read():
+        box["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return box.get("line")
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y), 4,
+    )
+    Xt = rng.randn(8, 5)
+    expected = bst.predict(Xt)
+
+    with tempfile.TemporaryDirectory() as td:
+        model_path = os.path.join(td, "smoke_model.txt")
+        bst.save_model(model_path)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "lightgbm_tpu.serve", model_path,
+             "--port", "0", "--max-delay-ms", "1"],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            line = _read_startup_line(proc)
+            if not line:
+                print("serve_smoke: server never printed its startup line")
+                return 1
+            startup = json.loads(line)
+            port = startup["port"]
+            base = "http://127.0.0.1:%d" % port
+
+            with urllib.request.urlopen(base + "/healthz", timeout=30) as r:
+                health = json.loads(r.read())
+            assert health["status"] == "ok" and health["ready"], health
+
+            req = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"rows": Xt.tolist()}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = json.loads(r.read())
+            got = np.asarray(body["predictions"])
+            if not np.array_equal(expected, got):
+                print("serve_smoke: /predict mismatch vs Booster.predict")
+                print("  max abs diff:", float(np.abs(expected - got).max()))
+                return 1
+            print(json.dumps({
+                "serve_smoke": "PASS", "port": port,
+                "backend": startup["backend"], "n": body["n"],
+            }))
+            return 0
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

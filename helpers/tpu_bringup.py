@@ -60,6 +60,7 @@ STAGE_TIMEOUTS = {
     "smoke_bf16": 1800,  # same smoke, bf16 MXU operands (AUC delta record)
     "smoke_psplit": 1800,  # opt-in Pallas split-scan kernel (first lowering)
     "bench_chunk": 3600,   # device-resident boosting sweep at the 1M shape
+    "bench_predict": 1800,  # packed-inference serving bench (ISSUE 3)
     "bench": 3600,
 }
 
@@ -218,9 +219,15 @@ bench_s = time.time() - t0
 score = bst._gbdt._train_score_np()
 m = AUCMetric(bst.config); m.init(ds._binned.metadata, ds.num_data())
 auc = float(m.eval(score, bst._gbdt.objective)[0][1])
+# model_hash feeds the spec-vs-seq on-chip exactness check (ADVICE r5 #1):
+# smoke and smoke_seq train the same data/seed under the two growers, so
+# their model strings must match bit for bit — _check_spec_seq_match below
+# compares the hashes once both stages have run
+from lightgbm_tpu.models.model_text import model_fingerprint
 print(json.dumps({"ok": auc > 0.70, "first_iter_s": round(compile_s, 1),
                   "iters_per_sec": round(10 / bench_s, 3),
                   "train_auc_11_iters": round(auc, 5),
+                  "model_hash": model_fingerprint(bst.model_to_string()),
                   "platform": jax.default_backend()}))
 """ % (REPO, REPO)
 
@@ -339,6 +346,92 @@ print(json.dumps({"ok": len(sweep) == 3, "winner_chunk": best,
 assert "device_chunk_size" in BENCH_CHUNK
 
 
+# Packed-inference serving bench (ISSUE 3 tentpole): train a model at the
+# bench feature shape, compile it to a PackedEnsemble (serve/packed.py), and
+# measure the two serving numbers that matter — fused-path throughput
+# (rows/s at a big batch, single dispatch each) and bucket-cached dispatch
+# latency (p50/p99 over mixed 200-1024-row batches AFTER warmup, when the
+# shape-bucket cache guarantees zero retraces). bench.py records the same
+# pair into the headline BENCH json; this stage is the on-chip capture.
+BENCH_PREDICT = _COMMON + """
+sys.path.insert(0, %r)
+os.environ.setdefault("LIGHTGBM_TPU_LATTICE", "pow2")
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve.cache import BucketedDispatcher
+
+from bench import make_higgs_like
+
+on_chip = jax.default_backend() in ("tpu", "axon")
+N, ITERS, LEAVES = (1_000_000, 16, 255) if on_chip else (20_000, 6, 31)
+X, y = make_higgs_like(N, 28)
+params = {"objective": "binary", "num_leaves": LEAVES, "max_bin": 255,
+          "learning_rate": 0.1, "verbosity": -1}
+bst = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+for _ in range(ITERS):
+    bst.update()
+pk = bst.to_packed()
+
+# throughput: fused (bin+traverse+sum on device) at a big resident batch
+BIG = min(N, 1 << 17)
+xd = jax.device_put(jnp.asarray(X[:BIG].astype(np.float32)))
+out = pk.fused_scores(xd)
+_ = float(jnp.ravel(out)[0])  # compile + close the pipeline
+reps = 8
+t0 = time.time()
+for _ in range(reps):
+    out = pk.fused_scores(xd)
+_ = float(jnp.ravel(out)[0])
+rows_per_sec = BIG * reps / (time.time() - t0)
+
+# latency: mixed-size batches through the pow2 bucket cache; warm the three
+# buckets first so the measured loop is the steady state (zero retraces)
+disp = BucketedDispatcher(
+    lambda x: np.asarray(pk.fused_scores(jnp.asarray(x))), min_rows=256)
+for b in (256, 512, 1024):
+    disp(X[:b].astype(np.float32))
+warm_traces = disp.retraces
+lat = []
+lrng = np.random.RandomState(0)
+for _ in range(60):
+    n = int(lrng.randint(200, 1025))
+    t1 = time.time()
+    disp(X[:n].astype(np.float32))
+    lat.append(time.time() - t1)
+lat.sort()
+print(json.dumps({
+    "ok": rows_per_sec > 0 and disp.retraces == warm_traces,
+    "rows_per_sec": round(rows_per_sec, 1),
+    "throughput_batch_rows": BIG,
+    "predict_p50_ms": round(lat[len(lat) // 2] * 1e3, 3),
+    "predict_p99_ms": round(lat[min(int(len(lat) * 0.99), len(lat) - 1)] * 1e3, 3),
+    "retraces_after_warmup": disp.retraces - warm_traces,
+    "num_trees": pk.num_trees,
+    "platform": jax.default_backend()}))
+""" % REPO
+assert "fused_scores" in BENCH_PREDICT
+
+
+def _check_spec_seq_match(summary: dict) -> None:
+    """ADVICE r5 #1: the smoke/smoke_seq pair trains the same data and seed
+    under the spec and sequential growers — their model strings must agree
+    bit for bit. On TPU the flat batched histogram's f32 regrouping COULD
+    silently diverge (the exactness claim is only CPU-verified); comparing
+    the two stages' model hashes turns that into a loud bringup failure
+    instead of a silently-wrong exactness guarantee."""
+    stages = summary.get("stages", {})
+    ha = stages.get("smoke", {}).get("model_hash")
+    hb = stages.get("smoke_seq", {}).get("model_hash")
+    if not ha or not hb:
+        return  # a stage failed before hashing; its own ok=False tells why
+    summary["spec_seq_model_match"] = ha == hb
+    if ha != hb:
+        stages["smoke_seq"]["ok"] = False
+        stages["smoke_seq"]["error"] = (
+            "spec-vs-seq model divergence: grower model hashes differ on "
+            "this backend (f32 histogram regrouping? see ADVICE.md #1)"
+        )
+
+
 def log_line(stage: str, payload: dict) -> None:
     with open(LOG, "a") as f:
         f.write(json.dumps({"t": time.strftime("%Y-%m-%dT%H:%M:%S"),
@@ -451,10 +544,14 @@ def main() -> int:
                        # chunked-boosting sweep before pack4: it feeds the
                        # final bench's device_chunk_size auto-adoption
                        ("bench_chunk", BENCH_CHUNK),
+                       # serving throughput/latency capture (ISSUE 3)
+                       ("bench_predict", BENCH_PREDICT),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         result = run_bench(stage) if src is None else run_stage(stage, src)
         summary["stages"][stage] = result
+        if stage == "smoke_seq":
+            _check_spec_seq_match(summary)
         _dump(summary)
         print("bringup: %s -> %s" % (stage, json.dumps(result)), flush=True)
         if not result.get("ok"):

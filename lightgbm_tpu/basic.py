@@ -1069,6 +1069,16 @@ class Booster:
         """Output of one leaf (LGBM_BoosterGetLeafValue, c_api.h)."""
         return float(self._gbdt.trees()[tree_id].leaf_value[leaf_id])
 
+    def to_packed(self, num_iteration: int = -1):
+        """Compile this model into a :class:`~lightgbm_tpu.serve.PackedEnsemble`
+        for device-resident batch inference (serve/packed.py): one vmapped
+        dispatch per request batch instead of a host walk per tree. The exact
+        path of the returned object reproduces ``predict`` bit for bit; see
+        docs/Serving.md."""
+        from .serve.packed import pack_booster
+
+        return pack_booster(self, num_iteration=num_iteration)
+
     def __getstate__(self):
         return {"model_str": self.model_to_string(), "params": self.params}
 

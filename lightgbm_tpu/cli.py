@@ -169,6 +169,25 @@ def run_convert_model(config: Config, params: Dict[str, str]) -> None:
     log.info("Finished converting model; source saved to %s" % config.convert_model)
 
 
+def run_serve(config: Config, params: Dict[str, str]) -> None:
+    """task=serve: stand up the inference server on ``input_model``
+    (lightgbm_tpu/serve). Extra knobs ride in as raw params:
+    serve_host / serve_port / serve_mode / max_batch_rows / max_delay_ms."""
+    if not config.input_model:
+        log.fatal("No model file specified (input_model=...)")
+    from .serve.__main__ import main as serve_main
+
+    argv = [config.input_model,
+            "--host", params.get("serve_host", "127.0.0.1"),
+            "--port", params.get("serve_port", "8080"),
+            "--mode", params.get("serve_mode", "exact")]
+    if "max_batch_rows" in params:
+        argv += ["--max-batch-rows", params["max_batch_rows"]]
+    if "max_delay_ms" in params:
+        argv += ["--max-delay-ms", params["max_delay_ms"]]
+    serve_main(argv)
+
+
 def run_refit(config: Config, params: Dict[str, str]) -> None:
     """task=refit (application.cpp:214-239): load model, predict leaves on
     data, refit leaf values on its labels, save."""
@@ -203,6 +222,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             run_convert_model(config, params)
         elif config.task == "refit":
             run_refit(config, params)
+        elif config.task == "serve":
+            run_serve(config, params)
         else:
             log.fatal("Unknown task: %s" % config.task)
     except LightGBMError as e:

@@ -9,8 +9,9 @@ feature_infos, tree_sizes), same per-tree blocks (Tree::ToString), same footers
 """
 from __future__ import annotations
 
+import hashlib
 import json
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -18,6 +19,49 @@ from ..utils import log
 from .tree import Tree, _short_float
 
 MODEL_VERSION = "v2"
+
+
+def model_fingerprint(text: str) -> str:
+    """Stable identity of a model: sha1 of its serialized text.
+
+    Shared by the serving registry (hot-swap version reporting,
+    serve/server.py), the generated-C++ provenance header (model_codegen.py)
+    and the bringup spec-vs-seq equality check (helpers/tpu_bringup.py) — one
+    hash, so "same model" means the same thing everywhere.
+    """
+    return hashlib.sha1(text.encode("utf-8")).hexdigest()
+
+
+def peek_model_header(text: str) -> Dict[str, object]:
+    """Cheap header scan of LightGBM model text — no tree parsing.
+
+    Returns num_class / num_tree_per_iteration / max_feature_idx / objective /
+    feature_names / num_trees (from tree_sizes) / average_output. The serving
+    registry uses this to validate and describe a model file before paying the
+    full ``Booster(model_file=...)`` parse, and /models reports it.
+    """
+    out: Dict[str, object] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("Tree="):
+            break
+        if line == "average_output":
+            out["average_output"] = True
+        elif "=" in line:
+            k, v = line.split("=", 1)
+            if k in ("num_class", "num_tree_per_iteration", "max_feature_idx"):
+                out[k] = int(v)
+            elif k == "objective":
+                out[k] = v
+            elif k == "feature_names":
+                out[k] = v.split()
+            elif k == "tree_sizes":
+                out["num_trees"] = len(v.split())
+    out.setdefault("average_output", False)
+    for key in ("num_class", "num_tree_per_iteration", "max_feature_idx"):
+        if key not in out:
+            raise ValueError("Model text doesn't specify %s" % key)
+    return out
 
 
 def _feature_infos(gbdt) -> List[str]:

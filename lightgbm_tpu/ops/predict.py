@@ -152,3 +152,164 @@ def ensemble_predict_leaves(bins_t: jax.Array, trees: PredictTree) -> jax.Array:
 
     _, leaves = jax.lax.scan(body, None, trees)
     return leaves.T
+
+
+# ---------------------------------------------------------------------------
+# Packed-ensemble inference (lightgbm_tpu.serve)
+#
+# The training-side PredictTree above traverses in *training-bin* space and
+# needs the dataset's BinMappers — unavailable for a model loaded from text.
+# The serving path instead packs the whole ensemble into dense [T, max_nodes]
+# tensors in *rank* space: every numerical feature gets a sorted lattice of
+# the thresholds the model actually uses (serve/packed.py), rows are converted
+# raw -> rank once, and each node decision is an integer compare. Because the
+# lattice is built from the model's own float64 thresholds,
+# ``rank(x) <= rank(thr)  <=>  x <= thr`` holds exactly, so leaf indices are
+# bit-identical to the host Tree.predict_fast walk while the traversal itself
+# is one vmapped device dispatch over all T trees (the FIL-style dense layout,
+# PAPERS.md).
+# ---------------------------------------------------------------------------
+
+
+class PackedTrees(NamedTuple):
+    """Dense rank-space ensemble: node fields [T, M], leaves [T, L].
+
+    ``M = max(num_leaves) - 1`` split slots (min 1); padded slots are inert
+    (left = right = -1). ``cat_words`` is one flat uint32 bitset pool shared by
+    every categorical node; a node addresses it with (cat_off, cat_n).
+    ``cat_n == 0`` on a categorical node marks the legacy single-category
+    equality decision (pre-bitset model files) with the raw category value in
+    ``thr_rank``. Per-feature rank metadata (rank0/zero_lo/zero_hi) encodes
+    NaN->0.0 replacement and the kZeroThreshold window in rank space.
+    """
+
+    feature: jax.Array  # [T, M] int32 split feature (original column)
+    thr_rank: jax.Array  # [T, M] int32 threshold rank (or legacy cat value)
+    default_left: jax.Array  # [T, M] bool
+    missing_type: jax.Array  # [T, M] int32
+    left_child: jax.Array  # [T, M] int32 (negative = -(leaf+1))
+    right_child: jax.Array  # [T, M] int32
+    is_cat: jax.Array  # [T, M] bool
+    cat_off: jax.Array  # [T, M] int32 word offset into cat_words
+    cat_n: jax.Array  # [T, M] int32 word count (0 = legacy equality)
+    leaf_value: jax.Array  # [T, L] f32
+    num_leaves: jax.Array  # [T] int32
+    cat_words: jax.Array  # [W] uint32 flat bitset pool (W >= 1)
+    rank0: jax.Array  # [F] int32 rank of 0.0 per feature
+    zero_lo: jax.Array  # [F] int32 rank of -kZeroThreshold
+    zero_hi: jax.Array  # [F] int32 rank of +kZeroThreshold
+
+
+def _packed_tree_leaf(codes, isnan, packed: PackedTrees, t) -> jax.Array:
+    """Leaf index per row for tree ``t`` (vmapped over ``t`` by the callers).
+
+    ``codes``: [N, F] int32 — threshold rank for numerical features, truncated
+    integer category for categorical ones. ``isnan``: [N, F] bool.
+    Decision semantics mirror Tree.predict_fast (models/tree.py) node by node.
+    """
+    N = codes.shape[0]
+    feature = packed.feature[t]
+    thr = packed.thr_rank[t]
+    dl = packed.default_left[t]
+    miss = packed.missing_type[t]
+    left = packed.left_child[t]
+    right = packed.right_child[t]
+    is_cat = packed.is_cat[t]
+    cat_off = packed.cat_off[t]
+    cat_n = packed.cat_n[t]
+    n_words = packed.cat_words.shape[0]
+
+    def cond(state):
+        node, _ = state
+        return jnp.any(node >= 0)
+
+    def body(state):
+        node, _ = state
+        active = node >= 0
+        nsafe = jnp.maximum(node, 0)
+        f = feature[nsafe]
+        c = jnp.take_along_axis(codes, f[:, None], axis=1)[:, 0]
+        nan = jnp.take_along_axis(isnan, f[:, None], axis=1)[:, 0]
+        m = miss[nsafe]
+        # numerical: NaN -> 0.0 (rank0) unless missing==NaN, then the
+        # kZeroThreshold window / NaN default routing, else rank compare
+        eff = jnp.where(nan & (m != MISSING_NAN), packed.rank0[f], c)
+        in_band = (eff > packed.zero_lo[f]) & (eff <= packed.zero_hi[f])
+        use_default = ((m == MISSING_ZERO) & in_band) | ((m == MISSING_NAN) & nan)
+        num_left = jnp.where(use_default, dl[nsafe], eff <= thr[nsafe])
+        # categorical bitset membership (FindInBitset, common.h:943)
+        iv = jnp.where(nan, 0, c)
+        w = iv >> 5
+        nw = cat_n[nsafe]
+        in_range = (iv >= 0) & (w < nw)
+        widx = cat_off[nsafe] + jnp.clip(w, 0, jnp.maximum(nw - 1, 0))
+        word = packed.cat_words[jnp.clip(widx, 0, n_words - 1)]
+        bit = jnp.right_shift(word, (iv & 31).astype(jnp.uint32)) & jnp.uint32(1)
+        cat_left = in_range & (bit > 0) & ~(nan & (m == MISSING_NAN))
+        # legacy single-category equality (cat_n == 0): int(fval) == value
+        cat_left = jnp.where(
+            is_cat[nsafe] & (nw == 0), (~nan) & (c == thr[nsafe]), cat_left
+        )
+        go_left = jnp.where(is_cat[nsafe], cat_left, num_left)
+        nxt = jnp.where(go_left, left[nsafe], right[nsafe])
+        node = jnp.where(active, nxt, node)
+        return node, active
+
+    is_stump = packed.num_leaves[t] <= 1
+    init = jnp.where(is_stump, -1, 0) * jnp.ones((N,), jnp.int32)
+    node, _ = jax.lax.while_loop(cond, body, (init, jnp.ones((N,), bool)))
+    return -(node + 1)
+
+
+@jax.jit
+def packed_predict_leaves(codes, isnan, packed: PackedTrees) -> jax.Array:
+    """[T, N] leaf indices for the whole ensemble — ONE device dispatch."""
+    T = packed.num_leaves.shape[0]
+    return jax.vmap(
+        lambda t: _packed_tree_leaf(codes, isnan, packed, t)
+    )(jnp.arange(T, dtype=jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("num_class", "average_output"))
+def packed_predict_values(
+    codes, isnan, packed: PackedTrees, num_class: int = 1,
+    average_output: bool = False,
+) -> jax.Array:
+    """[K, N] f32 raw scores, fused leaf gather + class-wise sum on device.
+
+    Tree i contributes to class i % K (gbdt_prediction.cpp:13 ordering). The
+    f32 tree-sum reduction is the serving fast path; the bit-exact-vs-host
+    contract belongs to the leaf indices + float64 host finalize
+    (serve/packed.py PackedEnsemble.predict).
+    """
+    leaves = packed_predict_leaves(codes, isnan, packed)  # [T, N]
+    vals = jnp.take_along_axis(packed.leaf_value, leaves, axis=1)  # [T, N]
+    T = vals.shape[0]
+    iters = max(T // max(num_class, 1), 1)
+    out = vals.reshape(iters, num_class, -1).sum(axis=0)
+    if average_output:
+        out = out / iters
+    return out
+
+
+@jax.jit
+def packed_bin_rows(X, bounds, is_cat_feat) -> tuple:
+    """On-device raw -> code conversion for the fused serving path.
+
+    ``X``: [N, F] f32 raw rows. ``bounds``: [F, Bmax] f32 per-feature
+    threshold lattice padded with +inf. Numerical features get their rank via
+    searchsorted; categorical features get the truncated integer category.
+    f32 precision: rows within one float32 ulp of a threshold may rank
+    differently from the float64 host path — the exact path does this
+    conversion on the host instead (serve/packed.py).
+    """
+    isnan = jnp.isnan(X)
+    ranks = jax.vmap(
+        lambda b, x: jnp.searchsorted(b, x, side="left"), in_axes=(0, 1),
+        out_axes=1,
+    )(bounds, jnp.where(isnan, jnp.float32(0.0), X)).astype(jnp.int32)
+    cat = jnp.trunc(jnp.clip(jnp.where(isnan, 0.0, X), -2.0e9, 2.0e9)).astype(
+        jnp.int32
+    )
+    codes = jnp.where(is_cat_feat[None, :], cat, ranks)
+    return codes, isnan

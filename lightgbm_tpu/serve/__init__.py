@@ -1,0 +1,33 @@
+"""TPU-native inference serving.
+
+A trained :class:`~lightgbm_tpu.basic.Booster` walks host ``Tree`` objects one
+tree at a time (models/tree.py); fine for offline scoring, hopeless for
+serving heavy traffic. This subsystem packs the ensemble into dense device
+tensors and wraps them in a serving stack:
+
+- ``packed``  — ``PackedEnsemble``: rank-space tensor ensemble, bit-exact
+  vs ``Booster.predict`` (exact path) plus a fused all-device f32 fast path
+- ``cache``   — shape-bucketed jit cache: pads batches to power-of-two row
+  buckets so steady-state traffic never retraces
+- ``batcher`` — micro-batcher coalescing concurrent requests into one
+  device dispatch
+- ``server``  — stdlib-only threaded HTTP JSON endpoint with a hot-swap
+  model registry
+- ``metrics`` — latency percentiles, QPS, queue depth, bucket counters
+
+Entry points: ``Booster.to_packed()``, ``python -m lightgbm_tpu.serve``.
+See docs/Serving.md.
+"""
+from .batcher import MicroBatcher
+from .cache import BucketedDispatcher, next_bucket
+from .metrics import ServeMetrics
+from .packed import PackedEnsemble, pack_booster
+
+__all__ = [
+    "BucketedDispatcher",
+    "MicroBatcher",
+    "PackedEnsemble",
+    "ServeMetrics",
+    "next_bucket",
+    "pack_booster",
+]

@@ -1,0 +1,89 @@
+"""``python -m lightgbm_tpu.serve`` — run the inference server.
+
+    python -m lightgbm_tpu.serve model.txt
+    python -m lightgbm_tpu.serve prod=model_a.txt canary=model_b.txt \
+        --port 8080 --max-batch-rows 4096 --max-delay-ms 2 --warmup-rows 1024
+
+Each positional argument is ``name=path`` (bare paths get the file stem as
+name). See docs/Serving.md for tuning guidance.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..utils import log
+from .server import ServeApp, make_server
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.serve",
+        description="TPU-native LightGBM inference server (stdlib HTTP/JSON)",
+    )
+    p.add_argument("models", nargs="+", metavar="NAME=PATH",
+                   help="model-text files to serve (bare PATH uses the stem)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="0 picks a free port (printed at startup)")
+    p.add_argument("--mode", choices=("exact", "fused"), default="exact",
+                   help="exact: bit-identical to Booster.predict; fused: "
+                        "all-device f32 fast path")
+    p.add_argument("--max-batch-rows", type=int, default=4096)
+    p.add_argument("--max-delay-ms", type=float, default=2.0)
+    p.add_argument("--min-bucket-rows", type=int, default=16)
+    p.add_argument("--no-batch", action="store_true",
+                   help="dispatch each request directly (debugging)")
+    p.add_argument("--warmup-rows", type=int, default=0,
+                   help="precompile row buckets up to this size at startup")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    app = ServeApp(
+        mode=args.mode,
+        batch=not args.no_batch,
+        max_batch_rows=args.max_batch_rows,
+        max_delay_ms=args.max_delay_ms,
+        min_bucket_rows=args.min_bucket_rows,
+    )
+    for spec in args.models:
+        if "=" in spec:
+            name, path = spec.split("=", 1)
+        else:
+            name, path = os.path.splitext(os.path.basename(spec))[0], spec
+        served = app.registry.load(name, path)
+        if args.warmup_rows > 0:
+            buckets = served.warmup(args.warmup_rows)
+            log.info("serve: warmed %r buckets %s" % (name, buckets))
+    httpd = make_server(args.host, args.port, app)
+    host, port = httpd.server_address[:2]
+    print(
+        json.dumps(
+            {
+                "serving": True,
+                "host": host,
+                "port": port,
+                "backend": app.backend,
+                "mode": app.mode,
+                "models": [str(i["name"]) for i in app.registry.list()],
+            }
+        ),
+        flush=True,
+    )
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+        app.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

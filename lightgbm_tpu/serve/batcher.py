@@ -1,0 +1,148 @@
+"""Micro-batcher: coalesce concurrent requests into one device dispatch.
+
+A TPU dispatch has a fixed host/launch cost that dwarfs the marginal cost of
+extra rows; serving one 8-row request per dispatch wastes almost the whole
+launch. The batcher runs ONE worker thread draining a queue: it opens a batch
+with the first waiting request, then keeps accepting compatible requests
+until ``max_batch_rows`` rows are gathered or the oldest request has waited
+``max_delay_ms`` — then concatenates rows, dispatches once, and fans results
+back out through per-request futures. The single worker also serializes
+device access, which is exactly what a one-chip server wants.
+
+Requests are grouped by an opaque ``key`` (model name + version + output
+kind, serve/server.py); a key change flushes the open batch so results can
+never mix models. Occupancy (batch rows / max_batch_rows) is recorded per
+dispatch — the measured answer to "is the delay window doing anything".
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .metrics import ServeMetrics
+
+
+class _Request:
+    __slots__ = ("key", "rows", "future", "t_enqueue")
+
+    def __init__(self, key, rows: np.ndarray) -> None:
+        self.key = key
+        self.rows = rows
+        self.future: Future = Future()
+        self.t_enqueue = time.time()
+
+
+_CLOSE = object()
+
+
+class MicroBatcher:
+    """Queue + worker thread. ``dispatch(key, X)`` does the actual predict."""
+
+    def __init__(
+        self,
+        dispatch: Callable[[object, np.ndarray], np.ndarray],
+        max_batch_rows: int = 4096,
+        max_delay_ms: float = 2.0,
+        metrics: Optional[ServeMetrics] = None,
+    ) -> None:
+        self.dispatch = dispatch
+        self.max_batch_rows = max_batch_rows
+        self.max_delay_s = max_delay_ms / 1e3
+        self.metrics = metrics or ServeMetrics()
+        self._q: "queue.Queue" = queue.Queue()
+        self.metrics.queue_depth_fn = self._q.qsize
+        self._worker = threading.Thread(
+            target=self._loop, name="lgbtpu-serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # -- producer side ----------------------------------------------------
+
+    def submit(self, key, rows: np.ndarray) -> Future:
+        """Enqueue one request; resolve the returned Future with its slice of
+        the batched result (row-leading), or the dispatch exception."""
+        req = _Request(key, rows)
+        self._q.put(req)
+        return req.future
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._q.put(_CLOSE)
+        self._worker.join(timeout=timeout)
+
+    # -- worker side ------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            req = self._q.get()
+            if req is _CLOSE:
+                return
+            if self._gather_and_dispatch(req) is _CLOSE:
+                return
+
+    def _gather_and_dispatch(self, first: _Request):
+        """Collect compatible requests behind ``first``, dispatch, fan out.
+        Returns _CLOSE if the shutdown sentinel was swallowed mid-gather."""
+        while True:
+            batch: List[_Request] = [first]
+            rows = first.rows.shape[0]
+            deadline = first.t_enqueue + self.max_delay_s
+            closing = None
+            carry = None
+            while rows < self.max_batch_rows:
+                wait = deadline - time.time()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=wait)
+                except queue.Empty:
+                    break
+                if nxt is _CLOSE:
+                    closing = _CLOSE
+                    break
+                if nxt.key != first.key:
+                    # incompatible request: flush what we have, then open a
+                    # new batch for it (strict FIFO across keys keeps tail
+                    # latency bounded under interleaved-model traffic)
+                    carry = nxt
+                    break
+                batch.append(nxt)
+                rows += nxt.rows.shape[0]
+            self._dispatch(batch, rows)
+            if carry is None:
+                return closing
+            first = carry
+
+    def _dispatch(self, batch: List[_Request], rows: int) -> None:
+        t0 = time.time()
+        try:
+            # the concat is INSIDE the try: two same-key requests with
+            # mismatched widths must fail their own futures, not kill the
+            # (only) worker thread and hang every request after them
+            X = (
+                batch[0].rows
+                if len(batch) == 1
+                else np.concatenate([r.rows for r in batch], axis=0)
+            )
+            out = self.dispatch(batch[0].key, X)
+        except BaseException as e:  # fan the failure out, keep the worker up
+            for r in batch:
+                r.future.set_exception(e)
+            self.metrics.incr("batch_errors")
+            return
+        dt = time.time() - t0
+        m = self.metrics
+        m.dispatch_latency.record(dt)
+        m.batch_occupancy.record(min(rows / self.max_batch_rows, 1.0))
+        m.incr("batches")
+        m.incr("batched_requests", len(batch))
+        m.rows_per_sec.record(rows)
+        off = 0
+        for r in batch:
+            n = r.rows.shape[0]
+            r.future.set_result(out[off : off + n])
+            off += n

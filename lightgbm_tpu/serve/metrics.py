@@ -1,0 +1,122 @@
+"""Serving metrics: latency percentiles, QPS, queue depth, batch occupancy.
+
+Stdlib-only and lock-guarded; the HTTP handler threads, the batcher worker
+and the /metrics endpoint all touch these concurrently. Percentiles come
+from a bounded reservoir of the most recent observations (ring buffer, not a
+decaying histogram — at serving rates the last few thousand samples ARE the
+steady state, and the p99 of a ring is exact where a log-bucketed histogram
+is approximate).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class LatencyWindow:
+    """Ring buffer of recent latencies (seconds in, milliseconds out)."""
+
+    def __init__(self, size: int = 4096) -> None:
+        self._buf = np.zeros(size, np.float64)
+        self._n = 0  # total ever recorded
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self._buf[self._n % len(self._buf)] = seconds
+            self._n += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            n = min(self._n, len(self._buf))
+            if n == 0:
+                return {"count": 0}
+            window = np.sort(self._buf[:n])
+            total = self._n
+        def pct(p):
+            return round(float(window[min(int(p * n), n - 1)]) * 1e3, 4)
+        return {
+            "count": total,
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99),
+            "max_ms": round(float(window[-1]) * 1e3, 4),
+            "mean_ms": round(float(window.mean()) * 1e3, 4),
+        }
+
+
+class RateMeter:
+    """Sliding-window event rate (QPS / rows-per-second)."""
+
+    def __init__(self, window_s: float = 60.0) -> None:
+        self.window_s = window_s
+        self._events: deque = deque()  # (t, weight)
+        self._lock = threading.Lock()
+
+    def record(self, weight: float = 1.0, now: Optional[float] = None) -> None:
+        t = time.time() if now is None else now
+        with self._lock:
+            self._events.append((t, weight))
+            self._trim(t)
+
+    def _trim(self, now: float) -> None:
+        cutoff = now - self.window_s
+        while self._events and self._events[0][0] < cutoff:
+            self._events.popleft()
+
+    def rate(self, now: Optional[float] = None) -> float:
+        t = time.time() if now is None else now
+        with self._lock:
+            self._trim(t)
+            if not self._events:
+                return 0.0
+            span = max(t - self._events[0][0], 1e-9)
+            # a single burst shorter than the window divides by its true
+            # span, not the full window, so cold-start rates aren't diluted
+            return sum(w for _, w in self._events) / min(span, self.window_s)
+
+
+class ServeMetrics:
+    """The server's one metrics hub (serve/server.py wires everything here)."""
+
+    def __init__(self) -> None:
+        self.request_latency = LatencyWindow()  # full request wall time
+        self.dispatch_latency = LatencyWindow()  # device dispatch only
+        self.qps = RateMeter()
+        self.rows_per_sec = RateMeter()
+        self.batch_occupancy = LatencyWindow(1024)  # 0..1, reuses the ring
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.queue_depth_fn = lambda: 0  # wired to the batcher's queue
+
+    def incr(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def snapshot(self, dispatcher_stats: Optional[Dict] = None) -> Dict[str, object]:
+        occ = self.batch_occupancy.snapshot()
+        out: Dict[str, object] = {
+            "request_latency": self.request_latency.snapshot(),
+            "dispatch_latency": self.dispatch_latency.snapshot(),
+            "qps": round(self.qps.rate(), 3),
+            "rows_per_sec": round(self.rows_per_sec.rate(), 1),
+            "queue_depth": int(self.queue_depth_fn()),
+            "counters": self.counters(),
+            "batch_occupancy": {
+                # the ring stores occupancy fractions; rename the ms fields
+                "count": occ.get("count", 0),
+                "mean": round(occ.get("mean_ms", 0.0) / 1e3, 4),
+                "p50": round(occ.get("p50_ms", 0.0) / 1e3, 4),
+            },
+        }
+        if dispatcher_stats:
+            out["buckets"] = dispatcher_stats
+        return out

@@ -1,0 +1,342 @@
+"""Packed tensor ensemble: compile a Booster into device arrays for serving.
+
+``Booster.predict`` walks host ``Tree`` objects one tree at a time in float64
+(models/tree.py predict_fast) — the per-request cost is O(T) numpy passes. For
+serving, the whole ensemble is packed once into dense ``[T, max_nodes]``
+tensors and every request becomes ONE vmapped device dispatch
+(ops/predict.py ``packed_predict_leaves``), the dense-forest layout GPU
+forest inference uses (RAPIDS FIL; PAPERS.md).
+
+Exactness. Device floats are f32; thresholds are f64 — comparing raw values
+on device would drift near thresholds. Instead every numerical feature gets a
+*threshold lattice*: the sorted unique float64 thresholds the model actually
+splits that feature on, plus the +/-kZeroThreshold sentinels that bound
+LightGBM's missing-zero window. Rows convert raw -> rank with float64 host
+searchsorted, and each node decision becomes the integer compare
+``rank(x) <= rank(thr)`` — exactly equivalent to ``x <= thr`` because the
+lattice contains ``thr`` itself. Leaf indices therefore match
+``Booster.predict`` bit for bit; the float64 per-class tree sum runs on the
+host in the same tree order as GBDT.predict_raw, so values, raw scores and
+probabilities are bit-exact too (tests/test_serve_packed.py).
+
+The fused path (``predict_fused``) trades that guarantee for throughput: the
+raw->rank conversion (``packed_bin_rows``), traversal and the f32 tree sum
+all run in a single jitted dispatch. Rows within one f32 ulp of a threshold
+may bin differently; the sum regroups in f32. It is the TPU serving hot path
+and is validated against the exact path by allclose, not equality.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.model_text import model_fingerprint, save_model_to_string
+from ..models.tree import (
+    K_CATEGORICAL_MASK,
+    K_DEFAULT_LEFT_MASK,
+    K_ZERO_THRESHOLD,
+)
+from ..ops.predict import (
+    PackedTrees,
+    packed_bin_rows,
+    packed_predict_leaves,
+    packed_predict_values,
+)
+from ..utils.log import LightGBMError
+
+_INT32_MAX = 2**31 - 1
+
+
+def _decode_nodes(tree):
+    """(missing_type, default_left, is_cat) int/bool arrays for one tree."""
+    dt = tree.decision_type.astype(np.int32)
+    return (dt >> 2) & 3, (dt & K_DEFAULT_LEFT_MASK) > 0, (dt & K_CATEGORICAL_MASK) > 0
+
+
+class PackedEnsemble:
+    """A Booster compiled for device-resident batch inference.
+
+    Build with :func:`pack_booster` / ``Booster.to_packed()``. The object owns
+    the device ``PackedTrees``, the host float64 lattices + leaf values for
+    the exact path, and enough model metadata (objective, class count,
+    average_output) to reproduce ``Booster.predict`` output end to end.
+    """
+
+    def __init__(
+        self,
+        packed: PackedTrees,
+        feat_bounds: List[np.ndarray],
+        is_cat_feat: np.ndarray,
+        leaf_value64: np.ndarray,
+        num_class: int,
+        num_tree_per_iteration: int,
+        average_output: bool,
+        objective,
+        fingerprint: str,
+        feature_names: Optional[List[str]] = None,
+    ) -> None:
+        self.packed = packed
+        self.feat_bounds = feat_bounds
+        self.is_cat_feat = is_cat_feat
+        self.leaf_value64 = leaf_value64
+        self.num_class = num_class
+        self.num_tree_per_iteration = num_tree_per_iteration
+        self.average_output = average_output
+        self.objective = objective
+        self.fingerprint = fingerprint
+        self.feature_names = feature_names or []
+        self.num_features = len(feat_bounds)
+        self.num_trees = int(leaf_value64.shape[0])
+        # fused-path device constants (built once, reused every dispatch)
+        bmax = max(max((len(b) for b in feat_bounds), default=1), 1)
+        bounds = np.full((self.num_features, bmax), np.inf, np.float32)
+        for f, b in enumerate(feat_bounds):
+            bounds[f, : len(b)] = b.astype(np.float32)
+        self.bounds_dev = jnp.asarray(bounds)
+        self.is_cat_dev = jnp.asarray(is_cat_feat)
+
+    # -- host raw -> code conversion (float64-exact) ----------------------
+
+    def _host_codes(self, X: np.ndarray):
+        """[N, F] int32 codes + [N, F] bool NaN mask, float64 semantics."""
+        isnan = np.isnan(X)
+        codes = np.empty(X.shape, np.int32)
+        for f in range(self.num_features):
+            col = np.where(isnan[:, f], 0.0, X[:, f])
+            if self.is_cat_feat[f]:
+                iv = np.trunc(col)
+                codes[:, f] = np.clip(iv, -(2.0**31), float(_INT32_MAX)).astype(
+                    np.int32
+                )
+            else:
+                codes[:, f] = np.searchsorted(
+                    self.feat_bounds[f], col, side="left"
+                ).astype(np.int32)
+        return codes, isnan
+
+    def _check_width(self, X: np.ndarray) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        if X.ndim != 2:
+            raise LightGBMError("Input numpy.ndarray must be 2 dimensional")
+        if X.shape[1] != self.num_features:
+            raise LightGBMError(
+                "The number of features in data (%d) is not the same as it "
+                "was in training data (%d)" % (X.shape[1], self.num_features)
+            )
+        return X
+
+    # -- exact path (bit-identical to Booster.predict) --------------------
+
+    def predict_leaves(self, X: np.ndarray) -> np.ndarray:
+        """[N, T] int32 leaf indices (== Booster.predict(pred_leaf=True))."""
+        X = self._check_width(X)
+        codes, isnan = self._host_codes(X)
+        leaves = packed_predict_leaves(
+            jnp.asarray(codes), jnp.asarray(isnan), self.packed
+        )
+        return np.asarray(leaves).T.astype(np.int32)
+
+    def predict_raw(self, X: np.ndarray) -> np.ndarray:
+        """Raw scores [N] / [N, K], float64-exact vs GBDT.predict_raw."""
+        leaves = self.predict_leaves(X)  # [N, T]
+        return self._finalize_raw(leaves)
+
+    def _finalize_raw(self, leaves: np.ndarray) -> np.ndarray:
+        N = leaves.shape[0]
+        K = self.num_tree_per_iteration
+        out = np.zeros((K, N), np.float64)
+        # same accumulation order as GBDT.predict_raw: tree i into class i%K,
+        # increasing i — f64 addition is order-sensitive and the bit-exact
+        # contract includes the sum
+        for i in range(self.num_trees):
+            out[i % K] += self.leaf_value64[i][leaves[:, i]]
+        if self.average_output and self.num_trees > 0:
+            out /= max(self.num_trees // K, 1)
+        return out[0] if K == 1 else out.T
+
+    def predict(
+        self, X: np.ndarray, raw_score: bool = False, pred_leaf: bool = False
+    ) -> np.ndarray:
+        """Bit-exact counterpart of ``Booster.predict`` (no contrib/early-stop)."""
+        if pred_leaf:
+            return self.predict_leaves(X)
+        raw = self.predict_raw(X)
+        if raw_score or self.objective is None:
+            return raw
+        return self.objective.convert_output(raw)
+
+    # -- fused path (all-device f32, single dispatch) ----------------------
+
+    def fused_scores(self, X_dev: jax.Array) -> jax.Array:
+        """[K, N] f32 scores from f32 raw rows — one jitted dispatch
+        (bin + traverse + sum). Device in, device out; callers slice/convert."""
+        codes, isnan = packed_bin_rows(X_dev, self.bounds_dev, self.is_cat_dev)
+        return packed_predict_values(
+            codes, isnan, self.packed,
+            num_class=self.num_tree_per_iteration,
+            average_output=self.average_output,
+        )
+
+    def finalize_fused(self, out: np.ndarray, raw_score: bool = False) -> np.ndarray:
+        """[K, N] f32 device scores -> the ``predict`` output convention
+        (class reshaping + objective transform). Shared by ``predict_fused``
+        and the server's batched fused path so they cannot drift."""
+        out = np.asarray(out).astype(np.float64)
+        raw = out[0] if self.num_tree_per_iteration == 1 else out.T
+        if raw_score or self.objective is None:
+            return raw
+        return self.objective.convert_output(raw)
+
+    def predict_fused(self, X: np.ndarray, raw_score: bool = False) -> np.ndarray:
+        """Fast-path prediction: f32 end to end on device. Approximately (not
+        bit-) equal to ``predict`` — see the module docstring."""
+        X = self._check_width(X)
+        return self.finalize_fused(
+            self.fused_scores(jnp.asarray(X.astype(np.float32))), raw_score
+        )
+
+
+def pack_booster(booster, num_iteration: int = -1) -> PackedEnsemble:
+    """Compile ``booster`` (trained in-process OR loaded from model text)
+    into a :class:`PackedEnsemble`. ``num_iteration`` clips the ensemble the
+    same way ``Booster.predict`` does."""
+    gbdt = booster._gbdt
+    trees = gbdt.trees()
+    K = max(gbdt.num_tree_per_iteration, 1)
+    use = len(trees)
+    if num_iteration is not None and num_iteration > 0:
+        use = min(use, num_iteration * K)
+    trees = trees[:use]
+    if not trees:
+        raise LightGBMError("Cannot pack a model with no trees")
+    F = gbdt.max_feature_idx + 1
+
+    # per-feature threshold lattice (float64, model-derived) + kind
+    thr_lists: List[List[float]] = [[] for _ in range(F)]
+    is_cat_feat = np.zeros(F, bool)
+    is_num_feat = np.zeros(F, bool)
+    for t in trees:
+        miss, dl, cat = _decode_nodes(t)
+        for n in range(max(t.num_leaves - 1, 0)):
+            f = int(t.split_feature[n])
+            if cat[n]:
+                is_cat_feat[f] = True
+            else:
+                is_num_feat[f] = True
+                thr_lists[f].append(float(t.threshold[n]))
+    both = is_cat_feat & is_num_feat
+    if both.any():
+        raise LightGBMError(
+            "Feature %d is split both numerically and categorically; "
+            "cannot build a rank lattice" % int(np.nonzero(both)[0][0])
+        )
+    feat_bounds = []
+    for f in range(F):
+        vals = thr_lists[f] + [-K_ZERO_THRESHOLD, K_ZERO_THRESHOLD]
+        feat_bounds.append(np.unique(np.asarray(vals, np.float64)))
+    rank0 = np.asarray(
+        [np.searchsorted(b, 0.0, side="left") for b in feat_bounds], np.int32
+    )
+    zero_lo = np.asarray(
+        [np.searchsorted(b, -K_ZERO_THRESHOLD, side="left") for b in feat_bounds],
+        np.int32,
+    )
+    zero_hi = np.asarray(
+        [np.searchsorted(b, K_ZERO_THRESHOLD, side="left") for b in feat_bounds],
+        np.int32,
+    )
+
+    # dense node/leaf tensors
+    T = len(trees)
+    M = max(max(t.num_leaves - 1 for t in trees), 1)
+    L = max(t.num_leaves for t in trees)
+    feature = np.zeros((T, M), np.int32)
+    thr_rank = np.zeros((T, M), np.int32)
+    default_left = np.zeros((T, M), bool)
+    missing_type = np.zeros((T, M), np.int32)
+    left = np.full((T, M), -1, np.int32)
+    right = np.full((T, M), -1, np.int32)
+    is_cat_node = np.zeros((T, M), bool)
+    cat_off = np.zeros((T, M), np.int32)
+    cat_n = np.zeros((T, M), np.int32)
+    leaf32 = np.zeros((T, L), np.float32)
+    leaf64 = np.zeros((T, L), np.float64)
+    num_leaves = np.zeros(T, np.int32)
+    cat_words: List[np.ndarray] = []
+    n_cat_words = 0
+    for ti, t in enumerate(trees):
+        n = t.num_leaves
+        num_leaves[ti] = n
+        leaf64[ti, :n] = t.leaf_value[:n]
+        leaf32[ti, :n] = t.leaf_value[:n].astype(np.float32)
+        m = max(n - 1, 0)
+        if m == 0:
+            continue
+        miss, dl, cat = _decode_nodes(t)
+        feature[ti, :m] = t.split_feature[:m]
+        default_left[ti, :m] = dl[:m]
+        missing_type[ti, :m] = miss[:m]
+        left[ti, :m] = t.left_child[:m]
+        right[ti, :m] = t.right_child[:m]
+        is_cat_node[ti, :m] = cat[:m]
+        for ni in range(m):
+            thr = float(t.threshold[ni])
+            if not cat[ni]:
+                thr_rank[ti, ni] = np.searchsorted(
+                    feat_bounds[int(t.split_feature[ni])], thr, side="left"
+                )
+            elif t.num_cat > 0:
+                ci = int(thr)
+                lo, hi = int(t.cat_boundaries[ci]), int(t.cat_boundaries[ci + 1])
+                words = np.asarray(t.cat_threshold[lo:hi], np.uint32)
+                cat_off[ti, ni] = n_cat_words
+                cat_n[ti, ni] = len(words)
+                cat_words.append(words)
+                n_cat_words += len(words)
+            else:
+                # legacy single-category equality node: cat_n stays 0 (the
+                # kernel's legacy marker), value rides in thr_rank
+                thr_rank[ti, ni] = int(np.clip(thr, -(2.0**31), float(_INT32_MAX)))
+    pool = (
+        np.concatenate(cat_words).astype(np.uint32)
+        if cat_words
+        else np.zeros(1, np.uint32)
+    )
+
+    packed = PackedTrees(
+        feature=jnp.asarray(feature),
+        thr_rank=jnp.asarray(thr_rank),
+        default_left=jnp.asarray(default_left),
+        missing_type=jnp.asarray(missing_type),
+        left_child=jnp.asarray(left),
+        right_child=jnp.asarray(right),
+        is_cat=jnp.asarray(is_cat_node),
+        cat_off=jnp.asarray(cat_off),
+        cat_n=jnp.asarray(cat_n),
+        leaf_value=jnp.asarray(leaf32),
+        num_leaves=jnp.asarray(num_leaves),
+        cat_words=jnp.asarray(pool),
+        rank0=jnp.asarray(rank0),
+        zero_lo=jnp.asarray(zero_lo),
+        zero_hi=jnp.asarray(zero_hi),
+    )
+    # hash the bare model text (no pandas_categorical trailer) over exactly
+    # the packed iteration range — the same string model_codegen.py hashes, so
+    # a deployed .cpp and a /models fingerprint agree on "same model"
+    fingerprint = model_fingerprint(save_model_to_string(gbdt, 0, num_iteration))
+    return PackedEnsemble(
+        packed=packed,
+        feat_bounds=feat_bounds,
+        is_cat_feat=is_cat_feat,
+        leaf_value64=leaf64,
+        num_class=gbdt.num_class,
+        num_tree_per_iteration=K,
+        average_output=bool(getattr(gbdt, "average_output", False)),
+        objective=gbdt.objective,
+        fingerprint=fingerprint,
+        feature_names=booster.feature_name(),
+    )

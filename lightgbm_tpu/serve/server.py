@@ -1,0 +1,407 @@
+"""Threaded HTTP JSON serving endpoint with a hot-swap model registry.
+
+Stdlib only (http.server + threading + json): the serving tier must not grow
+dependencies the training container doesn't have. One process serves one JAX
+backend; the request path is
+
+    HTTP thread -> MicroBatcher queue -> worker thread -> BucketedDispatcher
+    (pad to pow2 rows) -> packed device dispatch -> fan results back out
+
+Endpoints:
+  GET  /healthz   liveness + backend + model readiness
+  GET  /metrics   ServeMetrics snapshot + per-model bucket/retrace stats
+  GET  /models    registry listing (fingerprint, version, shape, objective)
+  POST /models    {"name": ..., "path": ...} — load or atomically hot-swap
+  POST /predict   {"rows": [[...]], "model"?, "raw_score"?, "pred_leaf"?,
+                   "fused"?} -> {"predictions": ...}
+
+Hot swap is atomic by construction: a swap builds the complete ServedModel
+(parse, pack, dispatchers) OFF the registry lock, then replaces the dict
+entry under it; in-flight batches keep serving the object they were keyed to
+(the batch key carries the ServedModel instance, not the name), so a request
+never sees half a model. When no accelerator initializes, the registry pins
+JAX to CPU and keeps serving — same code path, slower dispatch.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..models.model_text import model_fingerprint, peek_model_header
+from ..utils import log
+from ..utils.log import LightGBMError
+from ..utils.vfile import vopen
+from .batcher import MicroBatcher
+from .cache import BucketedDispatcher
+from .metrics import ServeMetrics
+from .packed import PackedEnsemble
+
+PREDICT_TIMEOUT_S = 120.0
+
+
+def ensure_backend() -> str:
+    """Return the JAX backend serving will run on, falling back to CPU when
+    no accelerator can initialize (dead TPU tunnel, no plugin, ...)."""
+    import jax
+
+    try:
+        jax.devices()
+        return jax.default_backend()
+    except RuntimeError as e:
+        log.warning(
+            "serve: accelerator backend failed to initialize (%s); "
+            "falling back to CPU" % str(e)[:200]
+        )
+        jax.config.update("jax_platforms", "cpu")
+        return jax.default_backend()
+
+
+class ServedModel:
+    """One immutable registry entry: packed model + its shape-bucketed
+    dispatchers. Replaced wholesale on hot swap, never mutated."""
+
+    def __init__(
+        self,
+        name: str,
+        path: str,
+        ensemble: PackedEnsemble,
+        file_sha: str,
+        version: int,
+        min_bucket_rows: int = 16,
+    ) -> None:
+        import jax.numpy as jnp
+
+        from ..ops.predict import packed_predict_leaves
+
+        self.name = name
+        self.path = path
+        self.ensemble = ensemble
+        self.file_sha = file_sha
+        self.version = version
+        self.loaded_at = time.time()
+        ens = ensemble
+        self.leaves_disp = BucketedDispatcher(
+            lambda codes, isnan: np.asarray(
+                packed_predict_leaves(
+                    jnp.asarray(codes), jnp.asarray(isnan), ens.packed
+                )
+            ),
+            min_rows=min_bucket_rows,
+        )
+        self.fused_disp = BucketedDispatcher(
+            lambda X: np.asarray(ens.fused_scores(jnp.asarray(X))),
+            min_rows=min_bucket_rows,
+        )
+
+    # -- prediction kinds (all return row-LEADING arrays for the batcher) --
+
+    def run(self, kind: str, X: np.ndarray) -> np.ndarray:
+        ens = self.ensemble
+        X = ens._check_width(X)
+        if kind == "fused" or kind == "fused_raw":
+            return ens.finalize_fused(
+                self.fused_disp(X.astype(np.float32)),
+                raw_score=(kind == "fused_raw"),
+            )
+        codes, isnan = ens._host_codes(X)
+        leaves = self.leaves_disp(codes, isnan).T.astype(np.int32)  # [N, T]
+        if kind == "leaf":
+            return leaves
+        raw = ens._finalize_raw(leaves)
+        if kind == "raw" or ens.objective is None:
+            return raw
+        return ens.objective.convert_output(raw)
+
+    def warmup(self, max_rows: int) -> List[int]:
+        F = self.ensemble.num_features
+        exact = self.leaves_disp.warmup(
+            lambda n: (np.zeros((n, F), np.int32), np.zeros((n, F), bool)),
+            max_rows=max_rows,
+        )
+        self.fused_disp.warmup(
+            lambda n: (np.zeros((n, F), np.float32),), max_rows=max_rows
+        )
+        return exact
+
+    def info(self) -> Dict[str, object]:
+        ens = self.ensemble
+        return {
+            "name": self.name,
+            "path": self.path,
+            "version": self.version,
+            "fingerprint": ens.fingerprint,
+            "file_sha": self.file_sha,
+            "num_trees": ens.num_trees,
+            "num_features": ens.num_features,
+            "num_class": ens.num_class,
+            "objective": ens.objective.to_string() if ens.objective else "",
+            "average_output": ens.average_output,
+            "loaded_at": self.loaded_at,
+        }
+
+
+class ModelRegistry:
+    """name -> ServedModel with atomic hot swap."""
+
+    def __init__(self, min_bucket_rows: int = 16) -> None:
+        self._models: Dict[str, ServedModel] = {}
+        self._lock = threading.Lock()
+        self.min_bucket_rows = min_bucket_rows
+
+    def load(self, name: str, path: str) -> ServedModel:
+        """Load (or atomically replace) ``name`` from a model-text file. The
+        whole build happens off-lock; a failed load leaves the old model
+        serving."""
+        from ..basic import Booster
+
+        with vopen(path) as fh:
+            text = fh.read()
+        peek_model_header(text)  # cheap validation before the full parse
+        booster = Booster(model_str=text)
+        ensemble = booster.to_packed()
+        file_sha = model_fingerprint(text)
+        # the whole build — parse, pack, dispatchers — happens OFF the lock;
+        # only the version stamp + dict swap hold it, so concurrent predicts
+        # never block behind a hot swap
+        served = ServedModel(
+            name, path, ensemble, file_sha, 0, self.min_bucket_rows
+        )
+        with self._lock:
+            served.version = (
+                self._models[name].version + 1 if name in self._models else 1
+            )
+            self._models[name] = served
+        log.info(
+            "serve: model %r v%d loaded from %s (%d trees, %d features)"
+            % (name, served.version, path, ensemble.num_trees, ensemble.num_features)
+        )
+        return served
+
+    def get(self, name: Optional[str]) -> ServedModel:
+        with self._lock:
+            if name is None:
+                if len(self._models) == 1:
+                    return next(iter(self._models.values()))
+                raise LightGBMError(
+                    "Request must name a model (server has %d loaded)"
+                    % len(self._models)
+                )
+            if name not in self._models:
+                raise LightGBMError("Unknown model: %s" % name)
+            return self._models[name]
+
+    def list(self) -> List[Dict[str, object]]:
+        with self._lock:
+            models = list(self._models.values())
+        return [m.info() for m in models]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._models)
+
+
+class ServeApp:
+    """Registry + batcher + metrics behind a plain-python predict() — the
+    HTTP handler is a thin shell over this (and tests drive it directly)."""
+
+    def __init__(
+        self,
+        mode: str = "exact",
+        batch: bool = True,
+        max_batch_rows: int = 4096,
+        max_delay_ms: float = 2.0,
+        min_bucket_rows: int = 16,
+    ) -> None:
+        if mode not in ("exact", "fused"):
+            raise LightGBMError("serve mode must be 'exact' or 'fused'")
+        self.mode = mode
+        self.backend = ensure_backend()
+        self.metrics = ServeMetrics()
+        self.registry = ModelRegistry(min_bucket_rows)
+        self.batcher = (
+            MicroBatcher(
+                self._dispatch,
+                max_batch_rows=max_batch_rows,
+                max_delay_ms=max_delay_ms,
+                metrics=self.metrics,
+            )
+            if batch
+            else None
+        )
+        self.started_at = time.time()
+
+    def _kind(self, raw_score: bool, pred_leaf: bool, fused: Optional[bool]) -> str:
+        if pred_leaf:
+            return "leaf"
+        use_fused = self.mode == "fused" if fused is None else fused
+        if use_fused:
+            return "fused_raw" if raw_score else "fused"
+        return "raw" if raw_score else "value"
+
+    def _dispatch(self, key: Tuple[ServedModel, str], X: np.ndarray) -> np.ndarray:
+        model, kind = key
+        return model.run(kind, X)
+
+    def predict(
+        self,
+        X: np.ndarray,
+        model: Optional[str] = None,
+        raw_score: bool = False,
+        pred_leaf: bool = False,
+        fused: Optional[bool] = None,
+    ) -> Tuple[np.ndarray, ServedModel]:
+        served = self.registry.get(model)
+        kind = self._kind(raw_score, pred_leaf, fused)
+        key = (served, kind)
+        if self.batcher is not None:
+            out = self.batcher.submit(key, X).result(timeout=PREDICT_TIMEOUT_S)
+        else:
+            out = self._dispatch(key, X)
+        return out, served
+
+    def dispatcher_stats(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        for info in self.registry.list():
+            name = str(info["name"])
+            served = self.registry.get(name)
+            out[name] = {
+                "exact": served.leaves_disp.stats(),
+                "fused": served.fused_disp.stats(),
+            }
+        return out
+
+    def close(self) -> None:
+        if self.batcher is not None:
+            self.batcher.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-serve/1.0"
+
+    @property
+    def app(self) -> ServeApp:
+        return self.server.app  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # route http.server chatter to debug
+        log.debug("serve: " + fmt % args)
+
+    def _json(self, code: int, payload: Dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> Dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            return {}
+        raw = self.rfile.read(length)
+        obj = json.loads(raw.decode("utf-8"))
+        if not isinstance(obj, dict):
+            raise ValueError("request body must be a JSON object")
+        return obj
+
+    # -- routes ------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server API)
+        app = self.app
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            self._json(
+                200,
+                {
+                    "status": "ok",
+                    "backend": app.backend,
+                    "mode": app.mode,
+                    "batching": app.batcher is not None,
+                    "ready": len(app.registry) > 0,
+                    "models": [str(i["name"]) for i in app.registry.list()],
+                    "uptime_s": round(time.time() - app.started_at, 1),
+                },
+            )
+        elif path == "/metrics":
+            self._json(200, app.metrics.snapshot(app.dispatcher_stats()))
+        elif path == "/models":
+            self._json(200, {"models": app.registry.list()})
+        else:
+            self._json(404, {"error": "unknown path %s" % path})
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        app = self.app
+        path = self.path.split("?", 1)[0]
+        t0 = time.time()
+        try:
+            body = self._body()
+            if path == "/predict":
+                rows = body.get("rows")
+                if not rows:
+                    self._json(400, {"error": "missing 'rows'"})
+                    return
+                X = np.asarray(rows, np.float64)
+                if X.ndim == 1:
+                    X = X[None, :]
+                out, served = app.predict(
+                    X,
+                    model=body.get("model"),
+                    raw_score=bool(body.get("raw_score", False)),
+                    pred_leaf=bool(body.get("pred_leaf", False)),
+                    fused=body.get("fused"),
+                )
+                app.metrics.qps.record()
+                app.metrics.incr("requests")
+                app.metrics.incr("rows", X.shape[0])
+                app.metrics.request_latency.record(time.time() - t0)
+                self._json(
+                    200,
+                    {
+                        "model": served.name,
+                        "version": served.version,
+                        "fingerprint": served.ensemble.fingerprint,
+                        "n": int(X.shape[0]),
+                        "predictions": np.asarray(out).tolist(),
+                    },
+                )
+            elif path == "/models":
+                name = body.get("name")
+                mpath = body.get("path")
+                if not name or not mpath:
+                    self._json(400, {"error": "need 'name' and 'path'"})
+                    return
+                served = app.registry.load(str(name), str(mpath))
+                app.metrics.incr("model_loads")
+                self._json(200, {"loaded": served.info()})
+            else:
+                self._json(404, {"error": "unknown path %s" % path})
+        except (LightGBMError, ValueError, TypeError, OSError) as e:
+            # TypeError covers np.asarray on malformed rows (e.g. JSON null
+            # in a row) — a client fault, not a server one
+            app.metrics.incr("errors")
+            self._json(400, {"error": str(e)})
+        except Exception as e:  # keep the server up; surface the cause
+            app.metrics.incr("errors")
+            log.warning("serve: internal error: %r" % (e,))
+            self._json(500, {"error": "%s: %s" % (type(e).__name__, e)})
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr, app: ServeApp) -> None:
+        super().__init__(addr, _Handler)
+        self.app = app
+
+
+def make_server(
+    host: str = "127.0.0.1", port: int = 8080, app: Optional[ServeApp] = None,
+    **app_kwargs,
+) -> ServeHTTPServer:
+    """Build (but don't start) the HTTP server; ``port=0`` picks a free port
+    (``server.server_address[1]`` tells which)."""
+    return ServeHTTPServer((host, port), app or ServeApp(**app_kwargs))

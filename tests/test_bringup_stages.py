@@ -13,6 +13,7 @@ import helpers.tpu_bringup as tb
 STAGES = (
     "MATMUL", "PALLAS", "PACK4", "SMOKE", "SMOKE_SEQ", "SMOKE_PALLAS",
     "SMOKE_XLA_RADIX", "SMOKE_BF16", "SMOKE_PSPLIT", "BENCH_CHUNK",
+    "BENCH_PREDICT",
 )
 
 
@@ -26,7 +27,7 @@ def test_stage_table_complete():
     assert set(tb.STAGE_TIMEOUTS) == {
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "bench_early",
         "smoke_pallas", "smoke_xla_radix", "smoke_bf16", "smoke_psplit",
-        "bench_chunk", "bench",
+        "bench_chunk", "bench_predict", "bench",
     }
 
 
@@ -61,6 +62,46 @@ def test_bench_chunk_sweeps_and_reports_winner():
                    "host_wall_per_iter_s", "device_gap_per_iter_s",
                    "update_chunk"):
         assert needle in tb.BENCH_CHUNK, needle
+
+
+def test_bench_predict_measures_serving_numbers():
+    """bench_predict must report the two serving headline numbers (rows/s,
+    p99) and prove the bucket cache held (zero retraces after warmup)."""
+    for needle in ("rows_per_sec", "predict_p99_ms", "retraces_after_warmup",
+                   "fused_scores", "BucketedDispatcher"):
+        assert needle in tb.BENCH_PREDICT, needle
+    assert tb.BENCH_PREDICT.index("LIGHTGBM_TPU_LATTICE") < tb.BENCH_PREDICT.index(
+        "import lightgbm_tpu"
+    )
+
+
+def test_smoke_emits_model_hash():
+    """Both grower smokes must hash their model for the spec-vs-seq
+    exactness check (ADVICE #1); the derived stage inherits via .replace."""
+    assert "model_hash" in tb.SMOKE
+    assert "model_hash" in tb.SMOKE_SEQ
+
+
+def test_spec_seq_match_check():
+    """_check_spec_seq_match: equal hashes pass, differing hashes fail the
+    smoke_seq stage loudly, missing hashes stay silent."""
+    s = {"stages": {"smoke": {"ok": True, "model_hash": "aa"},
+                    "smoke_seq": {"ok": True, "model_hash": "aa"}}}
+    tb._check_spec_seq_match(s)
+    assert s["spec_seq_model_match"] is True
+    assert s["stages"]["smoke_seq"]["ok"]
+
+    s = {"stages": {"smoke": {"ok": True, "model_hash": "aa"},
+                    "smoke_seq": {"ok": True, "model_hash": "bb"}}}
+    tb._check_spec_seq_match(s)
+    assert s["spec_seq_model_match"] is False
+    assert not s["stages"]["smoke_seq"]["ok"]
+    assert "divergence" in s["stages"]["smoke_seq"]["error"]
+
+    s = {"stages": {"smoke": {"ok": False}, "smoke_seq": {"ok": True,
+                                                          "model_hash": "bb"}}}
+    tb._check_spec_seq_match(s)
+    assert "spec_seq_model_match" not in s
 
 
 def test_timeloop_protocol_in_common():

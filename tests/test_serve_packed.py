@@ -1,0 +1,360 @@
+"""Parity + unit tests for the serving subsystem (lightgbm_tpu/serve).
+
+The headline contract: the packed predictor's exact path is BIT-identical to
+``Booster.predict`` (values, raw scores, leaf indices, probabilities) for
+every model type — binary, multiclass, L1/renew, random forest, categorical,
+NaN-laden, and text-round-tripped models. Fused (all-device f32) is allclose.
+Plus the shape-bucket cache's zero-retrace-after-warmup guarantee and the
+micro-batcher's coalescing semantics.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Booster
+from lightgbm_tpu.serve.batcher import MicroBatcher
+from lightgbm_tpu.serve.cache import BucketedDispatcher, next_bucket
+from lightgbm_tpu.serve.metrics import LatencyWindow, RateMeter, ServeMetrics
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def _data(rng, n=1200, f=7, cat_col=None, nan_frac=0.06):
+    X = rng.randn(n, f)
+    if cat_col is not None:
+        X[:, cat_col] = rng.randint(0, 12, n)
+    if nan_frac:
+        X[rng.rand(n, f) < nan_frac] = np.nan
+    return X
+
+
+def _assert_parity(bst, X, multiclass=False):
+    pk = bst.to_packed()
+    assert np.array_equal(bst.predict(X), pk.predict(X))
+    assert np.array_equal(
+        bst.predict(X, raw_score=True), pk.predict(X, raw_score=True)
+    )
+    leaf_ref = bst.predict(X, pred_leaf=True)
+    leaf_got = pk.predict(X, pred_leaf=True)
+    assert leaf_got.dtype == np.int32
+    assert np.array_equal(leaf_ref, leaf_got)
+    if multiclass:
+        assert pk.predict(X).shape == (X.shape[0], pk.num_class)
+    return pk
+
+
+@pytest.fixture(scope="module")
+def rng_m():
+    return np.random.RandomState(7)
+
+
+def test_binary_parity_with_nan_and_categorical(rng_m):
+    X = _data(rng_m, cat_col=3)
+    y = (np.nan_to_num(X[:, 0] + 0.5 * X[:, 1]) > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "verbosity": -1, "seed": 3},
+        lgb.Dataset(X, label=y, categorical_feature=[3]),
+        8,
+    )
+    Xt = _data(rng_m, n=500, cat_col=3, nan_frac=0.1)
+    Xt[:5, 3] = 25  # unseen categories route right, both paths
+    pk = _assert_parity(bst, Xt)
+    # fused f32 fast path: approximately equal, never used for the contract
+    assert np.allclose(bst.predict(Xt), pk.predict_fused(Xt), rtol=1e-4, atol=1e-5)
+    assert np.allclose(
+        bst.predict(Xt, raw_score=True), pk.predict_fused(Xt, raw_score=True),
+        rtol=1e-4, atol=1e-4,
+    )
+
+
+def test_multiclass_parity(rng_m):
+    X = _data(rng_m)
+    y = rng_m.randint(0, 3, X.shape[0]).astype(float)
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+         "verbosity": -1},
+        lgb.Dataset(X, label=y),
+        5,
+    )
+    Xt = _data(rng_m, n=300)
+    pk = _assert_parity(bst, Xt, multiclass=True)
+    assert np.allclose(bst.predict(Xt), pk.predict_fused(Xt), rtol=1e-4, atol=1e-5)
+
+
+def test_renew_l1_parity(rng_m):
+    X = _data(rng_m)
+    y = np.nan_to_num(X[:, 0]) + 0.1 * rng_m.randn(X.shape[0])
+    bst = lgb.train(
+        {"objective": "regression_l1", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y),
+        5,
+    )
+    _assert_parity(bst, _data(rng_m, n=300))
+
+
+def test_rf_average_output_parity(rng_m):
+    X = _data(rng_m, nan_frac=0)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "boosting": "rf", "bagging_fraction": 0.7,
+         "bagging_freq": 1, "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y),
+        5,
+    )
+    pk = _assert_parity(bst, _data(rng_m, n=200, nan_frac=0))
+    assert pk.average_output
+
+
+def test_loaded_model_parity(rng_m):
+    """Pack of a text-round-tripped model == pack of the live model."""
+    X = _data(rng_m, cat_col=2)
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y, categorical_feature=[2]),
+        4,
+    )
+    loaded = Booster(model_str=bst.model_to_string())
+    Xt = _data(rng_m, n=300, cat_col=2)
+    pk = loaded.to_packed()
+    assert np.array_equal(loaded.predict(Xt), pk.predict(Xt))
+    assert np.array_equal(bst.predict(Xt), pk.predict(Xt))
+    assert pk.fingerprint == bst.to_packed().fingerprint
+
+
+def test_num_iteration_clip(rng_m):
+    X = _data(rng_m, nan_frac=0)
+    y = X[:, 0] + 0.1 * rng_m.randn(X.shape[0])
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y),
+        6,
+    )
+    Xt = _data(rng_m, n=100, nan_frac=0)
+    pk = bst.to_packed(num_iteration=3)
+    assert pk.num_trees == 3
+    assert np.array_equal(bst.predict(Xt, num_iteration=3), pk.predict(Xt))
+
+
+def test_fingerprint_matches_codegen(rng_m):
+    """One fingerprint means one model everywhere: the packed ensemble and
+    the generated C++ provenance comment hash the same model text."""
+    from lightgbm_tpu.models.model_codegen import save_model_to_ifelse
+
+    X = _data(rng_m, n=200, nan_frac=0)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), 2,
+    )
+    fp = bst.to_packed().fingerprint
+    cpp = save_model_to_ifelse(bst._gbdt)
+    assert cpp.splitlines()[0] == "// model_fingerprint: %s" % fp
+
+
+def test_input_validation(rng_m):
+    X = _data(rng_m, n=200, nan_frac=0)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y),
+        2,
+    )
+    pk = bst.to_packed()
+    with pytest.raises(LightGBMError):
+        pk.predict(np.zeros(7))  # 1-d is ambiguous, like Booster.predict
+    with pytest.raises(LightGBMError):
+        pk.predict(np.zeros((3, 9)))  # wrong width
+
+
+# ---------------------------------------------------------------------------
+# shape-bucketed jit cache
+# ---------------------------------------------------------------------------
+
+
+def test_next_bucket():
+    assert next_bucket(1, 16) == 16
+    assert next_bucket(16, 16) == 16
+    assert next_bucket(17, 16) == 32
+    assert next_bucket(1000, 16) == 1024
+    assert next_bucket(1024, 16) == 1024
+    # a non-pow2 floor is rounded up at construction, keeping the pow2
+    # ladder (and warmup's bucket list) truthful
+    assert BucketedDispatcher(lambda x: x, min_rows=24).min_rows == 32
+
+
+def test_bucket_cache_zero_retrace_after_warmup():
+    """Mixed-batch-size load against a REAL jitted function: after warmup,
+    no new XLA traces and no new buckets (the ISSUE acceptance criterion)."""
+    import jax
+
+    traces = []
+
+    @jax.jit
+    def fn(x):
+        traces.append(1)  # appended at TRACE time only — counts compiles
+        return (x * 2.0).T
+
+    disp = BucketedDispatcher(lambda x: np.asarray(fn(x)), min_rows=16)
+    warmed = disp.warmup(lambda n: (np.ones((n, 3), np.float32),), max_rows=256)
+    assert warmed == [16, 32, 64, 128, 256]
+    traces_after_warmup = len(traces)
+    assert disp.retraces == len(warmed)
+
+    rng = np.random.RandomState(0)
+    for n in rng.randint(1, 257, size=40):
+        x = rng.rand(n, 3).astype(np.float32)
+        out = disp(x)
+        assert out.shape == (3, n)
+        assert np.allclose(out, (x * 2).T)
+    assert len(traces) == traces_after_warmup  # ZERO retraces under load
+    assert disp.retraces == len(warmed)
+    stats = disp.stats()
+    assert stats["calls"] == len(warmed) + 40
+    assert set(stats["buckets"]) == set(warmed)
+
+
+def test_bucket_cache_splits_oversized_requests():
+    """A request above max_rows is chunked at the cap — bounded buckets,
+    correct re-concatenated output, no ever-larger pow2 compiles."""
+    disp = BucketedDispatcher(lambda x: (x * 2.0).T, min_rows=8, max_rows=32)
+    x = np.arange(80, dtype=np.float64)[:, None]
+    out = disp(x)
+    assert out.shape == (1, 80)
+    assert np.array_equal(out, (x * 2).T)
+    assert set(disp.stats()["buckets"]) == {32, 16}  # 32+32+16, no 128 bucket
+
+
+def test_bucket_cache_pads_and_slices_rows_axis0():
+    disp = BucketedDispatcher(lambda x: x + 0.0, min_rows=8, rows_axis=0)
+    x = np.arange(5, dtype=np.float64)[:, None]
+    out = disp(x)
+    assert out.shape == (5, 1)
+    assert np.array_equal(out, x)
+
+
+# ---------------------------------------------------------------------------
+# micro-batcher
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_coalesces_requests():
+    calls = []
+
+    def dispatch(key, X):
+        calls.append((key, X.shape[0]))
+        return X[:, 0] * 10.0
+
+    m = ServeMetrics()
+    b = MicroBatcher(dispatch, max_batch_rows=1000, max_delay_ms=250.0, metrics=m)
+    try:
+        futs = [
+            b.submit("k", np.full((n, 2), float(i)))
+            for i, n in enumerate((3, 4, 5))
+        ]
+        outs = [f.result(timeout=10) for f in futs]
+        for i, (n, out) in enumerate(zip((3, 4, 5), outs)):
+            assert out.shape == (n,)
+            assert np.all(out == i * 10.0)
+        # all three rode one dispatch (the delay window coalesced them)
+        assert len(calls) == 1 and calls[0][1] == 12
+        assert m.counters()["batches"] == 1
+        assert m.counters()["batched_requests"] == 3
+        occ = m.batch_occupancy.snapshot()
+        assert occ["count"] == 1
+    finally:
+        b.close()
+
+
+def test_batcher_separates_keys():
+    def dispatch(key, X):
+        return X[:, 0] + (100.0 if key == "b" else 0.0)
+
+    b = MicroBatcher(dispatch, max_batch_rows=1000, max_delay_ms=20.0)
+    try:
+        fa = b.submit("a", np.ones((2, 1)))
+        fb = b.submit("b", np.ones((3, 1)))
+        assert np.all(fa.result(timeout=10) == 1.0)
+        assert np.all(fb.result(timeout=10) == 101.0)
+    finally:
+        b.close()
+
+
+def test_batcher_survives_mismatched_width_coalesce():
+    """Two same-key requests with different widths fail THEIR futures (the
+    concat error), but the worker thread survives and serves later traffic —
+    a one-bad-request permanent hang would be a serving DoS."""
+    def dispatch(key, X):
+        return X[:, 0]
+
+    b = MicroBatcher(dispatch, max_batch_rows=1000, max_delay_ms=150.0)
+    try:
+        f1 = b.submit("k", np.ones((2, 3)))
+        f2 = b.submit("k", np.ones((2, 5)))  # coalesces, concat must fail
+        with pytest.raises(ValueError):
+            f1.result(timeout=10)
+        with pytest.raises(ValueError):
+            f2.result(timeout=10)
+        f3 = b.submit("k", np.full((2, 4), 7.0))  # worker still alive
+        assert np.all(f3.result(timeout=10) == 7.0)
+    finally:
+        b.close()
+
+
+def test_batcher_propagates_errors():
+    def dispatch(key, X):
+        raise RuntimeError("device on fire")
+
+    b = MicroBatcher(dispatch, max_batch_rows=10, max_delay_ms=1.0)
+    try:
+        f = b.submit("k", np.ones((2, 1)))
+        with pytest.raises(RuntimeError, match="device on fire"):
+            f.result(timeout=10)
+    finally:
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+def test_latency_window_percentiles():
+    w = LatencyWindow(size=100)
+    for ms in range(1, 101):
+        w.record(ms / 1e3)
+    s = w.snapshot()
+    assert s["count"] == 100
+    assert s["p50_ms"] == pytest.approx(51.0)
+    assert s["p99_ms"] == pytest.approx(100.0)
+    assert s["max_ms"] == pytest.approx(100.0)
+
+
+def test_rate_meter():
+    m = RateMeter(window_s=10.0)
+    t0 = time.time()
+    for i in range(20):
+        m.record(now=t0 + i * 0.1)
+    assert m.rate(now=t0 + 2.0) == pytest.approx(10.0, rel=0.2)
+
+
+def test_batcher_queue_depth_wired():
+    m = ServeMetrics()
+    gate = threading.Event()
+
+    def dispatch(key, X):
+        gate.wait(5)
+        return X[:, 0]
+
+    b = MicroBatcher(dispatch, max_batch_rows=1, max_delay_ms=1.0, metrics=m)
+    try:
+        futs = [b.submit("k", np.ones((1, 1))) for _ in range(4)]
+        assert m.snapshot()["queue_depth"] >= 0  # gauge is live, not stale
+        gate.set()
+        for f in futs:
+            f.result(timeout=10)
+    finally:
+        gate.set()
+        b.close()

@@ -1,0 +1,210 @@
+"""HTTP server round-trip tests: spawn the real ThreadingHTTPServer on an
+ephemeral port, POST rows, and compare against Booster.predict — the wire
+format is JSON floats (repr round-trips float64 exactly), so even the HTTP
+path is held to bit-exactness. Plus registry hot-swap and error surfaces.
+"""
+import json
+import http.client
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve.server import ServeApp, make_server
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """(server, port, app, boosters-by-name, test rows) running for the module."""
+    import threading
+
+    rng = np.random.RandomState(11)
+    X = rng.randn(800, 5)
+    X[rng.rand(800, 5) < 0.05] = np.nan
+    y = (np.nan_to_num(X[:, 0]) > 0).astype(float)
+    y3 = rng.randint(0, 3, 800).astype(float)
+    tmp = tmp_path_factory.mktemp("serve")
+
+    bin_bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y), 4,
+    )
+    mc_bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "verbosity": -1},
+        lgb.Dataset(X, label=y3), 3,
+    )
+    bin_path = str(tmp / "bin.txt")
+    mc_path = str(tmp / "mc.txt")
+    bin_bst.save_model(bin_path)
+    mc_bst.save_model(mc_path)
+
+    app = ServeApp(max_delay_ms=1.0, min_bucket_rows=8)
+    app.registry.load("bin", bin_path)
+    app.registry.load("mc", mc_path)
+    srv = make_server("127.0.0.1", 0, app)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    Xt = rng.randn(9, 5)
+    Xt[0, 2] = np.nan
+    yield srv, port, app, {"bin": bin_bst, "mc": mc_bst}, {"tmp": tmp, "Xt": Xt}
+    srv.shutdown()
+    srv.server_close()
+    app.close()
+
+
+def _call(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request(
+            method, path,
+            None if body is None else json.dumps(body),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        return r.status, json.loads(r.read().decode("utf-8"))
+    finally:
+        conn.close()
+
+
+def test_healthz(served):
+    _, port, app, _, _ = served
+    status, body = _call(port, "GET", "/healthz")
+    assert status == 200
+    assert body["status"] == "ok" and body["ready"]
+    assert set(body["models"]) == {"bin", "mc"}
+    assert body["backend"] == app.backend
+
+
+def test_predict_round_trip_bit_exact(served):
+    _, port, app, boosters, extra = served
+    Xt = extra["Xt"]
+    status, body = _call(port, "POST", "/predict",
+                         {"rows": Xt.tolist(), "model": "bin"})
+    assert status == 200
+    assert body["n"] == Xt.shape[0]
+    assert body["version"] == app.registry.get("bin").version
+    # JSON float repr round-trips float64: the HTTP answer IS the predict()
+    assert np.array_equal(boosters["bin"].predict(Xt), np.asarray(body["predictions"]))
+
+
+def test_predict_raw_and_leaf(served):
+    _, port, _, boosters, extra = served
+    Xt = extra["Xt"]
+    _, body = _call(port, "POST", "/predict",
+                    {"rows": Xt.tolist(), "model": "bin", "raw_score": True})
+    assert np.array_equal(
+        boosters["bin"].predict(Xt, raw_score=True), np.asarray(body["predictions"])
+    )
+    _, body = _call(port, "POST", "/predict",
+                    {"rows": Xt.tolist(), "model": "bin", "pred_leaf": True})
+    assert np.array_equal(
+        boosters["bin"].predict(Xt, pred_leaf=True), np.asarray(body["predictions"])
+    )
+
+
+def test_predict_multiclass_and_single_row(served):
+    _, port, _, boosters, extra = served
+    Xt = extra["Xt"]
+    _, body = _call(port, "POST", "/predict", {"rows": Xt.tolist(), "model": "mc"})
+    assert np.array_equal(boosters["mc"].predict(Xt), np.asarray(body["predictions"]))
+    status, body = _call(port, "POST", "/predict",
+                         {"rows": Xt[0].tolist(), "model": "bin"})
+    assert status == 200 and body["n"] == 1  # a bare vector is one row
+
+
+def test_predict_fused_close(served):
+    _, port, _, boosters, extra = served
+    Xt = extra["Xt"]
+    _, body = _call(port, "POST", "/predict",
+                    {"rows": Xt.tolist(), "model": "bin", "fused": True})
+    assert np.allclose(
+        boosters["bin"].predict(Xt), np.asarray(body["predictions"]),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_metrics_and_models_endpoints(served):
+    _, port, _, _, _ = served
+    status, m = _call(port, "GET", "/metrics")
+    assert status == 200
+    assert m["counters"].get("requests", 0) >= 1
+    assert "request_latency" in m and "buckets" in m
+    status, models = _call(port, "GET", "/models")
+    by_name = {i["name"]: i for i in models["models"]}
+    assert by_name["bin"]["num_trees"] == 4
+    assert by_name["mc"]["num_class"] == 3
+    assert len(by_name["bin"]["fingerprint"]) == 40
+
+
+def test_hot_swap_atomic(served):
+    _, port, app, boosters, extra = served
+    Xt = extra["Xt"]
+    rng = np.random.RandomState(5)
+    X = rng.randn(400, 5)
+    y = (X[:, 1] > 0).astype(float)
+    swapped = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), 6,
+    )
+    path = str(extra["tmp"] / "swap.txt")
+    swapped.save_model(path)
+    v0 = app.registry.get("bin").version
+    status, body = _call(port, "POST", "/models", {"name": "bin", "path": path})
+    assert status == 200
+    assert body["loaded"]["version"] == v0 + 1
+    assert body["loaded"]["num_trees"] == 6
+    _, body = _call(port, "POST", "/predict", {"rows": Xt.tolist(), "model": "bin"})
+    assert body["version"] == v0 + 1
+    assert np.array_equal(swapped.predict(Xt), np.asarray(body["predictions"]))
+    # restore for any later test: swap back
+    orig = str(extra["tmp"] / "bin.txt")
+    boosters["bin"].save_model(orig)
+    _call(port, "POST", "/models", {"name": "bin", "path": orig})
+
+
+def test_error_surfaces(served):
+    _, port, _, _, extra = served
+    Xt = extra["Xt"]
+    status, body = _call(port, "POST", "/predict",
+                         {"rows": Xt.tolist(), "model": "nope"})
+    assert status == 400 and "Unknown model" in body["error"]
+    status, body = _call(port, "POST", "/predict", {"model": "bin"})
+    assert status == 400 and "rows" in body["error"]
+    status, body = _call(port, "POST", "/predict",
+                         {"rows": [[1.0, 2.0]], "model": "bin"})
+    assert status == 400  # wrong feature count
+    status, body = _call(port, "POST", "/predict",
+                         {"rows": [[None, 1, 2, 3, 4]], "model": "bin"})
+    assert status == 200  # JSON null = missing value (NaN), like NaN inputs
+    status, body = _call(port, "POST", "/predict",
+                         {"rows": [["x", 1, 2, 3, 4]], "model": "bin"})
+    assert status == 400  # genuinely non-numeric rows are a client fault
+    status, body = _call(port, "POST", "/models", {"name": "x"})
+    assert status == 400
+    status, _ = _call(port, "GET", "/nope")
+    assert status == 404
+
+
+def test_unbatched_app_direct():
+    """ServeApp with batching off serves the same answers (debug path)."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), 3,
+    )
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "m.txt")
+        bst.save_model(path)
+        app = ServeApp(batch=False)
+        app.registry.load("m", path)
+        Xt = rng.randn(5, 4)
+        out, served = app.predict(Xt)
+        assert served.name == "m"
+        assert np.array_equal(bst.predict(Xt), out)
+        app.close()
