@@ -377,6 +377,7 @@ def _run() -> None:
     n_shards = 1
     if platform not in ("tpu", "axon"):
         from lightgbm_tpu import native as _native
+        from lightgbm_tpu.utils import log as _log
 
         if _native.get_lib() is None:
             n_shards = min(8, os.cpu_count() or 1)
@@ -385,10 +386,12 @@ def _run() -> None:
                 os.environ["XLA_FLAGS"] = (
                     flags + " --xla_force_host_platform_device_count=%d" % n_shards
                 ).strip()
-                print(
+                # rate-limited: retry loops re-enter _run and the repeated
+                # fallback line was burying the first (informative) one
+                _log.warn_once(
+                    "bench-native-fallback",
                     "bench: native library unavailable - falling back to the "
                     "%d-shard virtual-mesh data-parallel learner" % n_shards,
-                    file=sys.stderr, flush=True,
                 )
     if platforms is not None:
         # apply in-process: the env var alone is overridden by sitecustomize's
@@ -442,21 +445,26 @@ def _run() -> None:
                 "BENCH_WORKER_BUDGET_S", os.environ.get("BENCH_TIMEOUT_S", 2400)
             )
         ) - (time.time() - _WATCHDOG_T0)
+        from lightgbm_tpu.utils import log as _log
+
+        # distinct keys per branch: a retry that flips to the sliced
+        # workload must still announce its 1/10 scaling, not be silenced
+        # by the earlier full-rows line having consumed the key
         if remaining > 300:
             bench_iters = max(BENCH_ITERS // 2, 10)
-            print(
+            _log.warn_once(
+                "bench-cpu-fallback-full",
                 "bench: CPU fallback — full %d rows, %d iters"
                 % (n_rows, bench_iters),
-                file=sys.stderr, flush=True,
             )
         else:
             n_rows, bench_iters, scaled = (
                 N_ROWS // 10, max(BENCH_ITERS // 6, 3), 10.0,
             )
-            print(
-                "bench: CPU fallback (tight budget %.0fs) — measuring %d rows, "
-                "scaling 1/%g" % (remaining, n_rows, scaled),
-                file=sys.stderr, flush=True,
+            _log.warn_once(
+                "bench-cpu-fallback-scaled",
+                "bench: CPU fallback (tight budget %.0fs) — measuring %d "
+                "rows, scaling 1/%g" % (remaining, n_rows, scaled),
             )
 
     X, y = make_higgs_like(n_rows, N_FEATURES)
@@ -681,6 +689,24 @@ def _run() -> None:
     extra = {"platform": platform, "train_auc": round(float(auc), 6)}
     if predict_rec:
         extra["predict"] = predict_rec
+    # the shared structured run report (obs/registry.py): phase gauges, jit
+    # trace counts, bucket retraces, device-memory gauges — the same block
+    # helpers/tpu_bringup.py embeds, so artifacts are cross-comparable
+    try:
+        from lightgbm_tpu.obs import REGISTRY as _obs_registry
+        from lightgbm_tpu.obs import memwatch as _memwatch
+
+        booster._gbdt.timers.publish()
+        snap = _memwatch.snapshot("post_bench")
+        extra["obs_report"] = _obs_registry.run_report()
+        extra["memwatch"] = {
+            k: v for k, v in snap.items() if k not in ("devices", "t")
+        }
+        extra["memwatch"]["attribution"] = _memwatch.attribute_training(
+            booster._gbdt
+        )
+    except Exception as e:
+        print("bench: obs report failed: %s" % e, file=sys.stderr)
     if adopt_record is not None:
         extra["bakeoff_adopted"] = adopt_record
     if platform not in ("tpu", "axon"):

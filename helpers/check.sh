@@ -11,6 +11,9 @@
 #   helpers/check.sh --serve    # lint gate, then the serving smoke: boot
 #                               # `python -m lightgbm_tpu.serve`, hit
 #                               # /healthz + one /predict, shut down
+#   helpers/check.sh --obs      # lint gate, then the observability smoke:
+#                               # traced mini-train + serve, validate the
+#                               # Chrome-trace JSON + Prometheus /metrics
 #
 # ruff/mypy are optional: the container may not ship them (no network
 # installs); when absent they are skipped with a notice — graftlint and
@@ -20,9 +23,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve) ;;
+    full|--quick|--lint|--serve|--obs) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint or --serve)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve or --obs)" >&2
         exit 2
         ;;
 esac
@@ -61,6 +64,11 @@ fi
 if [ "$MODE" = "--serve" ]; then
     echo "== serve smoke (boot server, /healthz + /predict, shut down) =="
     exec env JAX_PLATFORMS=cpu python helpers/serve_smoke.py
+fi
+
+if [ "$MODE" = "--obs" ]; then
+    echo "== obs smoke (traced mini-train + serve, validate trace + /metrics) =="
+    exec env JAX_PLATFORMS=cpu python helpers/obs_smoke.py
 fi
 
 if [ "$MODE" = "--quick" ]; then

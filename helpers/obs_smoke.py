@@ -1,0 +1,116 @@
+"""Observability smoke: traced mini-train + serve, then validate the outputs.
+
+What `helpers/check.sh --obs` runs. In-process, on CPU:
+
+  1. trains a tiny booster with ``LIGHTGBM_TPU_TRACE`` pointed at a temp
+     file, runs one packed-serving predict through a ServeApp, and stops
+     the tracer;
+  2. validates the emitted Chrome-trace JSON structurally — pid/tid/ph/ts
+     on every event, >= 3 distinct training-phase spans, >= 1 serve request
+     span, and phase spans time-nested inside an iteration span;
+  3. validates the Prometheus exposition: parses every sample line, and
+     requires latency quantiles, qps, the jit-trace gauges and the
+     device-memory gauge to be present;
+  4. checks memwatch shape math against the actual donated hist buffer.
+
+Exit 0 on success with an OK line; any failure raises (nonzero exit).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE\+\-\.]+$"
+)
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    trace_path = os.path.join(tempfile.mkdtemp(prefix="lgbtpu_obs_"), "trace.json")
+    os.environ["LIGHTGBM_TPU_TRACE"] = trace_path
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import memwatch, trace
+    from lightgbm_tpu.serve.server import ServeApp
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=3,
+    )
+
+    model_path = os.path.join(os.path.dirname(trace_path), "m.txt")
+    bst.save_model(model_path)
+    app = ServeApp(max_delay_ms=1.0, min_bucket_rows=8)
+    app.registry.load("m", model_path)
+    out, _ = app.predict(rng.randn(5, 4))
+    assert out.shape[0] == 5
+
+    # --- trace structure ---------------------------------------------------
+    path = trace.stop()
+    assert path == trace_path, (path, trace_path)
+    doc = json.load(open(path))
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert events, "no complete events in the trace"
+    for e in events:
+        for field in ("pid", "tid", "ph", "ts", "dur", "name"):
+            assert field in e, (field, e)
+    names = {e["name"] for e in events}
+    phases = names & {
+        "boosting(grad)", "bagging", "tree growth", "renew+score update",
+    }
+    assert len(phases) >= 3, "phase spans missing: %s" % sorted(names)
+    assert "train.iteration" in names
+    assert "serve.request" in names, sorted(names)
+    # nesting: some phase span lies inside an iteration span on one thread
+    iters = [e for e in events if e["name"] == "train.iteration"]
+    nested = any(
+        it["ts"] <= e["ts"] and e["ts"] + e["dur"] <= it["ts"] + it["dur"]
+        and e["tid"] == it["tid"]
+        for it in iters
+        for e in events
+        if e["name"] in phases
+    )
+    assert nested, "no phase span nests inside an iteration span"
+
+    # --- prometheus exposition --------------------------------------------
+    text = app.prometheus_metrics()
+    app.close()
+    for line in text.strip().splitlines():
+        if line.startswith("#") or not line:
+            continue
+        assert _PROM_LINE.match(line), "bad exposition line: %r" % line
+    for needle in (
+        'lgbtpu_request_latency_seconds{quantile="0.5"}',
+        "lgbtpu_qps",
+        "lgbtpu_jit_traces_total",
+        "lgbtpu_device_peak_bytes",
+        "lgbtpu_requests_total",
+    ):
+        assert needle in text, "missing %r in /metrics exposition" % needle
+
+    # --- memwatch shape math ----------------------------------------------
+    attr = memwatch.attribute_training(bst._gbdt)
+    hist = bst._gbdt._hist_buf
+    assert hist is not None and attr["hist_carry"]["bytes"] == hist.nbytes
+
+    print("obs smoke OK: %d trace events, phases=%s" % (
+        len(events), sorted(phases),
+    ))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,6 +195,7 @@ print(json.dumps(out))
 SMOKE = _COMMON + """
 sys.path.insert(0, %r)
 os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"   # cap first-contact compile cost
+os.environ["LIGHTGBM_TPU_TIMETAG"] = "1"  # async phase accumulators -> obs_report
 import lightgbm_tpu as lgb
 from lightgbm_tpu.metric import AUCMetric
 
@@ -224,10 +225,17 @@ auc = float(m.eval(score, bst._gbdt.objective)[0][1])
 # their model strings must match bit for bit — _check_spec_seq_match below
 # compares the hashes once both stages have run
 from lightgbm_tpu.models.model_text import model_fingerprint
+# the same structured run-report block bench.py emits (obs/registry.py):
+# phase seconds, jit trace counts, device-memory gauges — per stage
+from lightgbm_tpu.obs import REGISTRY as _obs_registry
+from lightgbm_tpu.obs import memwatch as _memwatch
+bst._gbdt.timers.publish()
+_memwatch.snapshot("post_smoke")
 print(json.dumps({"ok": auc > 0.70, "first_iter_s": round(compile_s, 1),
                   "iters_per_sec": round(10 / bench_s, 3),
                   "train_auc_11_iters": round(auc, 5),
                   "model_hash": model_fingerprint(bst.model_to_string()),
+                  "obs_report": _obs_registry.run_report(),
                   "platform": jax.default_backend()}))
 """ % (REPO, REPO)
 
@@ -452,8 +460,30 @@ def _parse_result(out: str):
     return None
 
 
+def _trace_path() -> str:
+    return os.environ.get("LIGHTGBM_TPU_TRACE", "")
+
+
+def _stage_span(stage: str):
+    """Driver-side obs span per bringup stage (no-op without
+    LIGHTGBM_TPU_TRACE; the import stays conditional so the driver process
+    never pulls jax in on the no-trace path)."""
+    if not _trace_path():
+        import contextlib
+
+        return contextlib.nullcontext()
+    from lightgbm_tpu.obs import trace as trace_mod
+
+    return trace_mod.span("bringup.%s" % stage, cat="bringup")
+
+
 def _run_child(stage: str, argv, env=None) -> dict:
     t0 = time.time()
+    if _trace_path():
+        # one trace file per PROCESS: a child inheriting the driver's path
+        # would clobber it at exit — each stage writes <path>.stage_<name>
+        env = dict(os.environ if env is None else env)
+        env["LIGHTGBM_TPU_TRACE"] = "%s.stage_%s" % (_trace_path(), stage)
     proc = subprocess.Popen(
         argv, cwd=REPO, env=env, start_new_session=True,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -548,7 +578,8 @@ def main() -> int:
                        ("bench_predict", BENCH_PREDICT),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
-        result = run_bench(stage) if src is None else run_stage(stage, src)
+        with _stage_span(stage):
+            result = run_bench(stage) if src is None else run_stage(stage, src)
         summary["stages"][stage] = result
         if stage == "smoke_seq":
             _check_spec_seq_match(summary)
@@ -564,10 +595,15 @@ def main() -> int:
                 _dump(summary)
                 return 1
     print("bringup: stage bench ...", flush=True)
-    summary["stages"]["bench"] = run_bench()
+    with _stage_span("bench"):
+        summary["stages"]["bench"] = run_bench()
     ok = summary["stages"]["bench"].get("ok", False)
     summary["verdict"] = "ok" if ok else "bench failed"
     _dump(summary)
+    if _trace_path():
+        from lightgbm_tpu.obs import trace as trace_mod
+
+        trace_mod.stop()  # write the driver's stage-span timeline
     print("bringup: done -> %s" % json.dumps(summary["stages"]["bench"]), flush=True)
     return 0 if ok else 1
 

@@ -104,6 +104,31 @@ def record_evaluation(eval_result: Dict) -> Callable:
     return _callback
 
 
+def record_metrics(registry=None) -> Callable:
+    """Publish each boundary's evaluation results into the obs metrics
+    registry (docs/Observability.md): gauge ``eval_metric`` labeled by
+    dataset + metric, gauge ``train_last_iteration``, counter
+    ``train_eval_boundaries``. The registry defaults to the process-wide
+    one, so a serving process that also trains exposes training progress on
+    the same /metrics endpoint.
+    """
+    from .obs import registry as registry_mod
+
+    reg = registry if registry is not None else registry_mod.REGISTRY
+    g_eval = reg.gauge("eval_metric")
+    g_iter = reg.gauge("train_last_iteration")
+    c_bound = reg.counter("train_eval_boundaries")
+
+    def _callback(env: CallbackEnv) -> None:
+        g_iter.set(env.iteration + 1)
+        c_bound.inc()
+        for entry in env.evaluation_result_list or []:
+            g_eval.set(float(entry[2]), dataset=entry[0], metric=entry[1])
+
+    _callback.order = 25  # type: ignore[attr-defined]
+    return _callback
+
+
 def reset_parameter(**kwargs) -> Callable:
     """Re-set model parameters per boosting round.
 

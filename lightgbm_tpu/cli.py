@@ -18,6 +18,7 @@ import numpy as np
 
 from . import callback as callback_mod
 from .basic import Booster, Dataset
+from .obs import trace as trace_mod
 from .config import Config, load_config_file
 from .engine import train as train_api
 from .io import load_sidecar, load_text_file
@@ -214,18 +215,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         params = parse_args(argv)
         config = Config.from_params(params)
-        if config.task == "train":
-            run_train(config, params)
-        elif config.task in ("predict", "prediction", "test"):
-            run_predict(config, params)
-        elif config.task == "convert_model":
-            run_convert_model(config, params)
-        elif config.task == "refit":
-            run_refit(config, params)
-        elif config.task == "serve":
-            run_serve(config, params)
-        else:
-            log.fatal("Unknown task: %s" % config.task)
+        # task-level obs span: with LIGHTGBM_TPU_TRACE set, the whole CLI
+        # task becomes the root span the training/serving spans nest under
+        with trace_mod.span("cli.%s" % config.task, cat="cli"):
+            if config.task == "train":
+                run_train(config, params)
+            elif config.task in ("predict", "prediction", "test"):
+                run_predict(config, params)
+            elif config.task == "convert_model":
+                run_convert_model(config, params)
+            elif config.task == "refit":
+                run_refit(config, params)
+            elif config.task == "serve":
+                run_serve(config, params)
+            else:
+                log.fatal("Unknown task: %s" % config.task)
     except LightGBMError as e:
         # application_main's catch block ("Met Exceptions", main.cpp): a clean
         # message + nonzero exit, not a traceback

@@ -14,6 +14,8 @@ import numpy as np
 
 from . import callback as callback_mod
 from .basic import Booster, Dataset
+from .obs import registry as obs_registry
+from .obs import trace as trace_mod
 from .utils import timer as timer_mod
 from .config import Config
 from .utils import log
@@ -135,6 +137,9 @@ def train(
     # until something materializes the model
     booster._gbdt._consume_pending_stop()
     booster._gbdt.timers.report()
+    # same numbers, machine-readable: phase totals land in the metrics
+    # registry so /metrics, bench JSON and bringup reports all agree
+    booster._gbdt.timers.publish()
 
     booster.best_score = collections.defaultdict(collections.OrderedDict)
     for (dname, ename, v, _) in evaluation_result_list or []:
@@ -161,6 +166,7 @@ def _boost_loop(
     )
     i = init_iteration
     end = init_iteration + num_boost_round
+    iter_counter = obs_registry.REGISTRY.counter("train_iterations")
     while i < end:
         for cb in cbs_before:
             cb(
@@ -174,16 +180,22 @@ def _boost_loop(
                 )
             )
         if chunk > 1 and end - i >= chunk:
-            done, finished = booster.update_chunk(chunk, sync_stop=needs_eval)
+            with trace_mod.span("train.chunk", cat="train", iteration=i,
+                                chunk=chunk):
+                done, finished = booster.update_chunk(
+                    chunk, sync_stop=needs_eval
+                )
             if done == 0:
                 break
         else:
             # the tail shorter than a chunk runs per-iteration: a tail-sized
             # scan would trace + XLA-compile a whole second boosting program
             # to save at most chunk-1 host round-trips
-            finished = booster.update(fobj=fobj)
+            with trace_mod.span("train.iteration", cat="train", iteration=i):
+                finished = booster.update(fobj=fobj)
             done = 1
         i += done
+        iter_counter.inc(done)
 
         evaluation_result_list = []
         if needs_eval:

@@ -26,9 +26,10 @@ import numpy as np
 from ..config import Config
 from ..dataset import BinnedDataset
 from ..metric import Metric
+from ..obs import memwatch, retrace as retrace_mod
 from ..objective import ObjectiveFunction
 from ..ops import grow_native
-from ..ops.grow import grow_tree, grow_tree_scan
+from ..ops.grow import grow_tree, grow_tree_scan, spec_batch_slots
 from ..ops.predict import PredictTree, make_predict_tree, tree_predict_value
 from ..ops.split import CegbParams, SplitParams
 from ..utils import log
@@ -174,6 +175,9 @@ class GBDT:
         )
         self._setup_cegb(train_set)
         self._forced_splits = self._parse_forced_splits(train_set)
+        # named memwatch point: the binned matrix + training carries are now
+        # resident (gated on LIGHTGBM_TPU_MEMWATCH; obs/memwatch.py)
+        memwatch.auto_snapshot("post_bin")
 
     def _setup_cegb(self, train_set: BinnedDataset) -> None:
         """CEGB penalty vectors mapped onto used features (config.h:389-405)."""
@@ -682,6 +686,9 @@ class GBDT:
             nl_dev.copy_to_host_async()  # [n, K]
         except AttributeError:
             pass
+        # per-chunk peak accounting (allocator stats only — no buffer walk
+        # inside the training loop; gated on LIGHTGBM_TPU_MEMWATCH)
+        memwatch.auto_snapshot("chunk", light=True)
         base = len(self._device_trees)
         for idx, ta in enumerate(trees_out):  # iteration-major, class-minor
             self._device_trees.append((ta, idx % K))
@@ -742,6 +749,8 @@ class GBDT:
         )
 
         def chunk_fn(scores, bag_mask, it0, fmasks, rate):
+            retrace_mod.note_trace("gbdt.train_chunk")  # once per XLA trace
+
             def body(carry, xs):
                 scores, bag, stopped = carry
                 it, fmask_k = xs
@@ -911,13 +920,29 @@ class GBDT:
             if buf is None or buf.shape != (rows, F, self.num_bins, 3):
                 buf = jnp.zeros((rows, F, self.num_bins, 3), jnp.float32)
             self._hist_buf = None  # consumed by donation below
+            # spec mode carries a SECOND histogram-sized buffer (the right-
+            # child cache, ADVICE r5 #2): donate it the same way so it stops
+            # being re-zeroed every tree. spec_batch_slots is the same gate
+            # grow_tree traces with, so the buffer exists iff spec engages.
+            sbuf = None
+            if spec_batch_slots(
+                M, hist_mode=cfg.tpu_hist_mode,
+                has_lazy_cegb=self.cegb_params.has_lazy,
+                pooled=slots is not None and slots < M, cegb_on=cegb_on,
+            ):
+                sbuf = getattr(self, "_spec_buf", None)
+                if sbuf is None or sbuf.shape != (M, F, self.num_bins, 3):
+                    sbuf = jnp.zeros((M, F, self.num_bins, 3), jnp.float32)
+                self._spec_buf = None  # consumed by donation below
             out = grow_tree(
                 self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
                 self.feature_meta, forced_splits=self._forced_splits,
                 cegb=self.cegb_params, cegb_state=self._cegb_state,
                 hist_buf=buf, bins_nf=self.bins_dev_nf,
-                hist_pool_slots=slots, **common,
+                hist_pool_slots=slots, spec_buf=sbuf, **common,
             )
+            if sbuf is not None:
+                out, self._spec_buf = out[:-1], out[-1]
             out, self._hist_buf = out[:-1], out[-1]
             if cegb_on:
                 tree, leaf_id, self._cegb_state = out

@@ -1,0 +1,42 @@
+"""lightgbm_tpu.obs: the unified observability layer (docs/Observability.md).
+
+Four pieces, one spine:
+
+ * :mod:`~lightgbm_tpu.obs.trace`    — structured span tracer; Chrome-trace
+   JSON via ``LIGHTGBM_TPU_TRACE=<path>``, Perfetto-viewable, device-aligned
+   through ``jax.profiler.TraceAnnotation``.
+ * :mod:`~lightgbm_tpu.obs.retrace`  — jit-compile watchdog; counts real XLA
+   traces per entry point, ``LIGHTGBM_TPU_RETRACE=fail`` hard-fails on
+   retraces after warmup.
+ * :mod:`~lightgbm_tpu.obs.memwatch` — device-memory snapshots at named
+   points + shape-math attribution of the known large carries.
+ * :mod:`~lightgbm_tpu.obs.registry` — the one metrics registry (counters /
+   gauges / histograms / rates) behind the serve ``/metrics`` Prometheus
+   endpoint, the training callback, and the bench/bringup run reports.
+
+Importing this package never touches a jax backend.
+"""
+from __future__ import annotations
+
+from . import memwatch, registry, retrace, trace  # noqa: F401
+from .registry import REGISTRY, MetricsRegistry  # noqa: F401
+
+# cross-wiring: the default registry's watchdog/memory gauges pull live
+# values at read time, so any exposition (serve /metrics, run_report) is
+# current without a push site having to remember them
+REGISTRY.gauge(
+    "jit_traces_total"
+).set_fn(lambda: float(sum(retrace.WATCHDOG.counts().values())))
+REGISTRY.gauge(
+    "jit_retraces_after_warmup"
+).set_fn(lambda: float(retrace.WATCHDOG.total_retraces()))
+REGISTRY.gauge("device_peak_bytes").set_fn(memwatch.peak_device_bytes)
+
+__all__ = [
+    "REGISTRY",
+    "MetricsRegistry",
+    "memwatch",
+    "registry",
+    "retrace",
+    "trace",
+]

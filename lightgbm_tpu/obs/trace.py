@@ -1,0 +1,259 @@
+"""Structured span tracer: Chrome-trace-format JSON, Perfetto-viewable.
+
+Env-gated with ``LIGHTGBM_TPU_TRACE=<path>``: when set, every ``span()``
+context in the process records a Chrome "complete" event (``ph: "X"`` with
+pid/tid/ts/dur, microseconds) and the buffer is written to ``<path>`` at
+``stop()``/``flush()`` or process exit. Load the file in Perfetto
+(https://ui.perfetto.dev) or chrome://tracing; events on one thread nest by
+time containment, so a ``train.iteration`` span visually contains its
+``tree growth`` / ``renew+score update`` phase spans.
+
+Span sites (cat → where):
+  * ``train.phase``   — every PhaseTimers phase (utils/timer.py)
+  * ``train``         — per-iteration / per-chunk spans (engine._boost_loop)
+  * ``serve``         — request lifecycle: queue wait → batch gather →
+                        dispatch → reply (serve/server.py, serve/batcher.py)
+  * ``bringup``       — per-stage spans in helpers/tpu_bringup.py
+  * ``cli``           — task-level spans (cli.py)
+
+Device correlation: when jax is already imported and a tracer is active,
+``span()`` additionally enters ``jax.profiler.TraceAnnotation(name)`` so the
+host span shows up inside the XLA/TPU profile that ``LIGHTGBM_TPU_PROFILE``
+captures — the host and device timelines line up by annotation name.
+
+One trace file per PROCESS: a subprocess inheriting the env var would clobber
+the parent's file at exit, so drivers that fan out stages rewrite the path
+per child (helpers/tpu_bringup.py appends ``.stage_<name>``).
+
+Disabled cost: one dict lookup per ``span()`` call. Thread-safe throughout.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+ENV_TRACE = "LIGHTGBM_TPU_TRACE"
+
+_EPOCH = time.perf_counter()
+
+
+def now_us() -> float:
+    """Microseconds on the tracer's (monotonic) clock."""
+    return (time.perf_counter() - _EPOCH) * 1e6
+
+
+#: buffer cap: ~160 bytes/event dict puts 1M events around 160MB — enough
+#: for hours of phase spans or minutes of per-request serve spans, small
+#: enough that a traced long-lived server cannot OOM from the tracer
+MAX_EVENTS = 1_000_000
+
+
+class Tracer:
+    """In-memory Chrome-trace event buffer bound to one output path.
+
+    The buffer is CAPPED at ``max_events``: once full, further events are
+    counted (``dropped``) but not stored, and the flushed file carries a
+    ``dropped_events`` marker — tracing a long-lived serve process degrades
+    to a truncated-but-loadable trace instead of unbounded memory growth.
+    """
+
+    def __init__(self, path: str, max_events: int = MAX_EVENTS) -> None:
+        self.path = path
+        self.pid = os.getpid()
+        self.max_events = max_events
+        self.dropped = 0
+        self._events: List[Dict] = []
+        self._lock = threading.Lock()
+        self._tids: Dict[int, int] = {}  # thread ident -> small stable tid
+
+    def _append(self, ev: Dict) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def _tid(self) -> int:
+        ident = threading.get_ident()
+        with self._lock:
+            tid = self._tids.get(ident)
+            if tid is None:
+                tid = len(self._tids)
+                self._tids[ident] = tid
+                name = threading.current_thread().name
+                # metadata rides outside the cap: a handful of threads
+                self._events.insert(tid, {
+                    "ph": "M", "name": "thread_name", "pid": self.pid,
+                    "tid": tid, "args": {"name": name},
+                })
+            return tid
+
+    def complete(
+        self, name: str, cat: str, ts_us: float, dur_us: float,
+        args: Optional[Dict] = None, tid: Optional[int] = None,
+    ) -> None:
+        ev = {
+            "ph": "X", "name": name, "cat": cat or "lgbtpu",
+            "pid": self.pid, "tid": self._tid() if tid is None else tid,
+            "ts": round(ts_us, 3), "dur": round(max(dur_us, 0.0), 3),
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def instant(self, name: str, cat: str = "", args: Optional[Dict] = None) -> None:
+        ev = {
+            "ph": "i", "s": "t", "name": name, "cat": cat or "lgbtpu",
+            "pid": self.pid, "tid": self._tid(), "ts": round(now_us(), 3),
+        }
+        if args:
+            ev["args"] = args
+        self._append(ev)
+
+    def counter(self, name: str, value: float) -> None:
+        self._append({
+            "ph": "C", "name": name, "cat": "lgbtpu", "pid": self.pid,
+            "tid": 0, "ts": round(now_us(), 3),
+            "args": {"value": float(value)},
+        })
+
+    def flush(self) -> str:
+        """Write the full buffer (Chrome trace object form) to ``path``."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self.dropped
+        payload = {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "lightgbm_tpu.obs.trace"},
+        }
+        if dropped:
+            payload["otherData"]["dropped_events"] = dropped
+        with open(self.path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh)
+            fh.write("\n")
+        return self.path
+
+    def event_count(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+
+_TRACER: Optional[Tracer] = None
+_LOCK = threading.Lock()
+_ATEXIT_ARMED = False
+
+
+def start(path: Optional[str] = None) -> Tracer:
+    """Start (or return) the process tracer; ``path`` defaults to the
+    LIGHTGBM_TPU_TRACE env var. Idempotent while a tracer is live."""
+    global _TRACER, _ATEXIT_ARMED
+    with _LOCK:
+        if _TRACER is not None:
+            return _TRACER
+        target = path or os.environ.get(ENV_TRACE, "")
+        if not target:
+            raise ValueError(
+                "trace.start() needs a path (or set %s)" % ENV_TRACE
+            )
+        _TRACER = Tracer(target)
+        if not _ATEXIT_ARMED:
+            _ATEXIT_ARMED = True
+            atexit.register(_atexit_flush)
+        return _TRACER
+
+
+def stop() -> Optional[str]:
+    """Flush and detach the tracer; returns the written path (None when no
+    tracer was live). A later ``span()`` re-arms from the env var, so tests
+    can start/stop repeatedly."""
+    global _TRACER
+    with _LOCK:
+        tr, _TRACER = _TRACER, None
+    if tr is None:
+        return None
+    return tr.flush()
+
+
+def _atexit_flush() -> None:
+    with _LOCK:
+        tr = _TRACER
+    if tr is not None:
+        try:
+            tr.flush()
+        except OSError:
+            pass  # a dead target dir must not break interpreter shutdown
+
+
+def active() -> Optional[Tracer]:
+    """The live tracer, auto-starting from the env var on first use."""
+    tr = _TRACER
+    if tr is not None:
+        return tr
+    if os.environ.get(ENV_TRACE, ""):
+        try:
+            return start()
+        except (ValueError, OSError):
+            return None
+    return None
+
+
+def enabled() -> bool:
+    return active() is not None
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "", **args):
+    """Record a complete event around the body; no-op without a tracer.
+
+    Keyword args land in the event's ``args`` dict (JSON-able values only).
+    When jax is already imported, the span also enters
+    ``jax.profiler.TraceAnnotation`` so device profiles carry the same name.
+    """
+    tr = active()
+    if tr is None:
+        yield
+        return
+    ann = None
+    jx = sys.modules.get("jax")
+    if jx is not None:
+        try:
+            ann = jx.profiler.TraceAnnotation(name)
+            ann.__enter__()
+        except Exception:
+            ann = None  # profiler unavailable on this backend/version
+    t0 = now_us()
+    try:
+        yield
+    finally:
+        t1 = now_us()
+        if ann is not None:
+            try:
+                ann.__exit__(None, None, None)
+            except Exception as e:
+                # annotation teardown must never mask the body's result
+                from ..utils import log
+
+                log.debug("trace: TraceAnnotation teardown failed: %r", e)
+        tr.complete(name, cat, t0, t1 - t0, args or None)
+
+
+def complete_at(name: str, cat: str, t0_us: float, t1_us: float,
+                **args) -> None:
+    """Record a complete event with explicit start/end (``now_us`` clock) —
+    for spans measured across threads, e.g. a request's queue wait."""
+    tr = active()
+    if tr is not None:
+        tr.complete(name, cat, t0_us, t1_us - t0_us, args or None)
+
+
+def instant(name: str, cat: str = "", **args) -> None:
+    tr = active()
+    if tr is not None:
+        tr.instant(name, cat, args or None)

@@ -47,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import retrace as retrace_mod
 from ..utils.platform import env_choice, env_int
 from .histogram import _default_backend, leaf_histogram, leaf_values
 from .split import (
@@ -93,6 +94,37 @@ _ENV_SPEC_K = env_int("LIGHTGBM_TPU_SPEC_K", 8, lo=2, hi=64)
 # scatter/pallas impls the groupings differ, so lanes (which reuse the
 # impl verbatim per lane) keep exactness.
 _ENV_SPEC_HIST = env_choice("LIGHTGBM_TPU_SPEC_HIST", ("flat", "lanes"))
+
+def spec_batch_slots(
+    num_leaves: int,
+    hist_mode: str = "bucketed",
+    has_lazy_cegb: bool = False,
+    pooled: bool = False,
+    cegb_on: bool = False,
+    use_subtract: bool = True,
+    custom_split: bool = False,
+) -> int:
+    """Speculative-batch width grow_tree will trace with (0 = sequential).
+
+    The SINGLE source of truth for the spec-mode gate: grow_tree derives its
+    KB from this, and callers that allocate the donated ``spec_buf`` carry
+    (models/gbdt.py) or attribute its HBM footprint (obs/memwatch.py) call
+    it with the same arguments so they can never disagree with the trace.
+    """
+    bucketed = hist_mode == "bucketed" and not has_lazy_cegb and num_leaves > 1
+    spec_ok = (
+        bucketed and not pooled and not cegb_on and use_subtract
+        and not custom_split and _ENV_SPLIT_IMPL != "pallas"
+    )
+    if _ENV_GROW == "seq":
+        kb = 0
+    elif _ENV_GROW == "spec":
+        kb = _ENV_SPEC_K
+    else:
+        kb = _ENV_SPEC_K if _default_backend() == "tpu" else 0
+    kb = min(kb, num_leaves - 1) if spec_ok else 0
+    return kb if kb >= 2 else 0
+
 
 # which mode the most recent grow_tree TRACE resolved to ("spec"/"seq"),
 # and which batched-histogram form ("flat"/"lanes") — set at trace time, so
@@ -270,7 +302,7 @@ _NODE_I_COLS = np.array([0, 1, 2, 3, 2, 3], np.int32)
         "cegb_rescan", "hist_mode", "hist_dtype", "two_way", "feature_sharded",
         "hist_pool_slots", "use_subtract",
     ),
-    donate_argnames=("hist_buf",),
+    donate_argnames=("hist_buf", "spec_buf"),
 )
 def grow_tree(
     bins: jax.Array,  # [F, N] uint8/int32
@@ -300,6 +332,7 @@ def grow_tree(
     bins_nf: Optional[jax.Array] = None,
     hist_pool_slots: Optional[int] = None,
     use_subtract: bool = True,
+    spec_buf: Optional[jax.Array] = None,
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [N]).
 
@@ -337,7 +370,14 @@ def grow_tree(
     — the reference initializes these once per *training*, not per tree
     (serial_tree_learner.cpp:107-115), so acquisition penalties amortize. When
     ``cegb.enabled`` the return is (tree, leaf_id, new_cegb_state).
+    ``spec_buf``: optional donated [M, F, B, 3] scratch for the spec-mode
+    right-child cache (``spec_rhist``) — like ``hist_buf`` it skips the
+    full-buffer zeros write per tree (stale contents are safe: every read
+    is gated on the ``spec_flag`` carry, which starts all-False). Returned
+    aliased as the LAST output element so the caller can re-donate;
+    allocate it only when :func:`spec_batch_slots` says spec mode engages.
     """
+    retrace_mod.note_trace("ops.grow_tree")  # runs once per real XLA trace
     N = bins.shape[1]
     F = feature_meta["num_bin"].shape[0]
     M = num_leaves
@@ -400,19 +440,15 @@ def grow_tree(
     # histogram pools (slot state is per-split), custom split searches
     # (may contain collectives that don't vmap), masked mode, and the
     # use_subtract=False oracle.
-    spec_ok = (
-        bucketed and not pooled and not cegb_on and use_subtract
-        and split_fn is find_best_split and _ENV_SPLIT_IMPL != "pallas"
+    KB = spec_batch_slots(
+        M,
+        hist_mode=hist_mode,
+        has_lazy_cegb=cegb.has_lazy,
+        pooled=pooled,
+        cegb_on=cegb_on,
+        use_subtract=use_subtract,
+        custom_split=split_fn is not find_best_split,
     )
-    if _ENV_GROW == "seq":
-        KB = 0
-    elif _ENV_GROW == "spec":
-        KB = _ENV_SPEC_K
-    else:
-        KB = _ENV_SPEC_K if _default_backend() == "tpu" else 0
-    KB = min(KB, M - 1) if spec_ok else 0
-    if KB < 2:
-        KB = 0
     if _ENV_SPEC_HIST:
         use_flat = _ENV_SPEC_HIST == "flat"
     else:
@@ -1078,8 +1114,11 @@ def grow_tree(
         slot_age=slot_age0,
         spec_flag=jnp.zeros((M,) if KB else (1,), bool),
         spec_lphys=jnp.zeros((M,) if KB else (1,), jnp.int32),
+        # donated scratch (like hist_buf): stale contents are read only
+        # through spec_flag-gated selects and spec_flag starts all-False,
+        # so skipping the [M, F, B, 3] zeros write per tree is safe
         spec_rhist=(
-            jnp.zeros((M, F, B, 3), f32)
+            (spec_buf if spec_buf is not None else jnp.zeros((M, F, B, 3), f32))
             if KB
             else jnp.zeros((1, 1, 1, 1), f32)
         ),
@@ -1710,6 +1749,11 @@ def grow_tree(
         out = out + ((final.feature_used, final.used_in_data),)
     if hist_buf is not None:
         out = out + (final.hist,)  # aliases the donated buffer (zero-copy)
+    if spec_buf is not None:
+        # aliased like hist: the caller re-adopts it for the next tree. A
+        # seq-mode trace (KB == 0) hands the untouched donation back so the
+        # donated input still has an aliasable output.
+        out = out + (final.spec_rhist if KB else spec_buf,)
     return out
 
 

@@ -18,6 +18,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..obs import retrace as retrace_mod
 from .split import MISSING_NAN, MISSING_ZERO
 
 
@@ -264,6 +265,7 @@ def _packed_tree_leaf(codes, isnan, packed: PackedTrees, t) -> jax.Array:
 @jax.jit
 def packed_predict_leaves(codes, isnan, packed: PackedTrees) -> jax.Array:
     """[T, N] leaf indices for the whole ensemble — ONE device dispatch."""
+    retrace_mod.note_trace("ops.packed_predict_leaves")  # once per XLA trace
     T = packed.num_leaves.shape[0]
     return jax.vmap(
         lambda t: _packed_tree_leaf(codes, isnan, packed, t)
@@ -282,6 +284,7 @@ def packed_predict_values(
     contract belongs to the leaf indices + float64 host finalize
     (serve/packed.py PackedEnsemble.predict).
     """
+    retrace_mod.note_trace("ops.packed_predict_values")  # once per XLA trace
     leaves = packed_predict_leaves(codes, isnan, packed)  # [T, N]
     vals = jnp.take_along_axis(packed.leaf_value, leaves, axis=1)  # [T, N]
     T = vals.shape[0]
@@ -303,6 +306,7 @@ def packed_bin_rows(X, bounds, is_cat_feat) -> tuple:
     differently from the float64 host path — the exact path does this
     conversion on the host instead (serve/packed.py).
     """
+    retrace_mod.note_trace("ops.packed_bin_rows")  # once per XLA trace
     isnan = jnp.isnan(X)
     ranks = jax.vmap(
         lambda b, x: jnp.searchsorted(b, x, side="left"), in_axes=(0, 1),

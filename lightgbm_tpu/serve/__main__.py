@@ -15,7 +15,6 @@ import os
 import sys
 from typing import List, Optional
 
-from ..utils import log
 from .server import ServeApp, make_server
 
 
@@ -50,16 +49,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         max_batch_rows=args.max_batch_rows,
         max_delay_ms=args.max_delay_ms,
         min_bucket_rows=args.min_bucket_rows,
+        warmup_rows=args.warmup_rows,  # loads (and hot swaps) pre-warm
     )
     for spec in args.models:
         if "=" in spec:
             name, path = spec.split("=", 1)
         else:
             name, path = os.path.splitext(os.path.basename(spec))[0], spec
-        served = app.registry.load(name, path)
-        if args.warmup_rows > 0:
-            buckets = served.warmup(args.warmup_rows)
-            log.info("serve: warmed %r buckets %s" % (name, buckets))
+        app.registry.load(name, path)
+    if args.warmup_rows > 0:
+        # every bucket is compiled: from here on any jit trace is a retrace
+        # (warned once; LIGHTGBM_TPU_RETRACE=fail hard-fails — obs/retrace.py);
+        # hot swaps stay safe: ModelRegistry.load warms the incoming model
+        # and re-arms with its compile counts before it goes live
+        app.arm_retrace_watchdog()
     httpd = make_server(args.host, args.port, app)
     host, port = httpd.server_address[:2]
     print(

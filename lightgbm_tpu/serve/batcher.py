@@ -24,17 +24,23 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..obs import trace as trace_mod
 from .metrics import ServeMetrics
 
 
 class _Request:
-    __slots__ = ("key", "rows", "future", "t_enqueue")
+    __slots__ = ("key", "rows", "future", "t_enqueue", "t_trace_us")
 
     def __init__(self, key, rows: np.ndarray) -> None:
         self.key = key
         self.rows = rows
         self.future: Future = Future()
-        self.t_enqueue = time.time()
+        # perf_counter: enqueue stamps only ever feed DELTAS (delay-window
+        # deadlines), and wall-clock deltas break under NTP steps
+        self.t_enqueue = time.perf_counter()
+        # trace-clock enqueue stamp, so the worker can emit the request's
+        # queue-wait span with its true start (obs/trace.py complete_at)
+        self.t_trace_us = trace_mod.now_us() if trace_mod.enabled() else None
 
 
 _CLOSE = object()
@@ -90,11 +96,11 @@ class MicroBatcher:
         while True:
             batch: List[_Request] = [first]
             rows = first.rows.shape[0]
-            deadline = first.t_enqueue + self.max_delay_s
+            deadline = first.t_enqueue + self.max_delay_s  # perf_counter base
             closing = None
             carry = None
             while rows < self.max_batch_rows:
-                wait = deadline - time.time()
+                wait = deadline - time.perf_counter()
                 if wait <= 0:
                     break
                 try:
@@ -118,7 +124,16 @@ class MicroBatcher:
             first = carry
 
     def _dispatch(self, batch: List[_Request], rows: int) -> None:
-        t0 = time.time()
+        if trace_mod.enabled():
+            # queue-wait spans: enqueue -> the moment the batch dispatches
+            t_now = trace_mod.now_us()
+            for r in batch:
+                if r.t_trace_us is not None:
+                    trace_mod.complete_at(
+                        "serve.queue_wait", "serve", r.t_trace_us, t_now,
+                        rows=int(r.rows.shape[0]),
+                    )
+        t0 = time.perf_counter()
         try:
             # the concat is INSIDE the try: two same-key requests with
             # mismatched widths must fail their own futures, not kill the
@@ -128,13 +143,17 @@ class MicroBatcher:
                 if len(batch) == 1
                 else np.concatenate([r.rows for r in batch], axis=0)
             )
-            out = self.dispatch(batch[0].key, X)
+            with trace_mod.span(
+                "serve.batch_dispatch", cat="serve", rows=int(rows),
+                requests=len(batch),
+            ):
+                out = self.dispatch(batch[0].key, X)
         except BaseException as e:  # fan the failure out, keep the worker up
             for r in batch:
                 r.future.set_exception(e)
             self.metrics.incr("batch_errors")
             return
-        dt = time.time() - t0
+        dt = time.perf_counter() - t0
         m = self.metrics
         m.dispatch_latency.record(dt)
         m.batch_occupancy.record(min(rows / self.max_batch_rows, 1.0))

@@ -20,6 +20,8 @@ from typing import Callable, Dict, Optional, Sequence
 
 import numpy as np
 
+from ..obs import registry as obs_registry
+
 DEFAULT_MIN_ROWS = 16
 DEFAULT_MAX_ROWS = 1 << 16
 
@@ -68,10 +70,17 @@ class BucketedDispatcher:
     def _record(self, b: int) -> None:
         with self._lock:
             self.calls += 1
-            if b not in self.bucket_counts:
+            new_bucket = b not in self.bucket_counts
+            if new_bucket:
                 self.bucket_counts[b] = 0
                 self.retraces += 1
             self.bucket_counts[b] += 1
+        if new_bucket:
+            # the process-wide observability counter behind /metrics and the
+            # bench/bringup run reports (obs/registry.py) — the generalized
+            # form of the zero-retraces-after-warmup assertion this class
+            # used to keep private
+            obs_registry.REGISTRY.counter("bucket_retraces").inc()
 
     def __call__(self, *arrays: np.ndarray):
         n = arrays[0].shape[0]

@@ -1,105 +1,86 @@
-"""Serving metrics: latency percentiles, QPS, queue depth, batch occupancy.
+"""Serving metrics: a thin client of the obs metrics registry.
 
-Stdlib-only and lock-guarded; the HTTP handler threads, the batcher worker
-and the /metrics endpoint all touch these concurrently. Percentiles come
-from a bounded reservoir of the most recent observations (ring buffer, not a
-decaying histogram — at serving rates the last few thousand samples ARE the
-steady state, and the p99 of a ring is exact where a log-bucketed histogram
-is approximate).
+The ring-buffer/rate primitives that used to live here moved to
+``lightgbm_tpu.obs.registry`` (the one registry shared by train + serve);
+this module keeps the serving-flavored surface: ``LatencyWindow`` renders
+millisecond snapshots for the JSON endpoint, and ``ServeMetrics`` wires the
+server's instruments into a :class:`~lightgbm_tpu.obs.registry.MetricsRegistry`
+so ``/metrics`` can render Prometheus text exposition straight off it.
+
+Each ``ServeMetrics`` owns a FRESH registry by default (two ServeApps in one
+process must not mix latency rings); the /metrics endpoint concatenates the
+app registry with the process-wide default one, which carries the training
+phases, jit-retrace counts and device-memory gauges (serve/server.py).
+
+Percentiles come from a bounded reservoir of the most recent observations
+(ring buffer, not a decaying histogram — at serving rates the last few
+thousand samples ARE the steady state, and the p99 of a ring is exact where
+a log-bucketed histogram is approximate).
 """
 from __future__ import annotations
 
-import threading
-import time
-from collections import deque
 from typing import Dict, Optional
 
-import numpy as np
+from ..obs.registry import (  # noqa: F401  (RateMeter re-exported: public API)
+    Histogram,
+    MetricsRegistry,
+    RateMeter,
+)
 
 
-class LatencyWindow:
+class LatencyWindow(Histogram):
     """Ring buffer of recent latencies (seconds in, milliseconds out)."""
 
-    def __init__(self, size: int = 4096) -> None:
-        self._buf = np.zeros(size, np.float64)
-        self._n = 0  # total ever recorded
-        self._lock = threading.Lock()
-
-    def record(self, seconds: float) -> None:
-        with self._lock:
-            self._buf[self._n % len(self._buf)] = seconds
-            self._n += 1
-
-    def snapshot(self) -> Dict[str, float]:
-        with self._lock:
-            n = min(self._n, len(self._buf))
-            if n == 0:
-                return {"count": 0}
-            window = np.sort(self._buf[:n])
-            total = self._n
-        def pct(p):
-            return round(float(window[min(int(p * n), n - 1)]) * 1e3, 4)
+    def snapshot(self) -> Dict[str, float]:  # type: ignore[override]
+        base = super().snapshot()
+        if base.get("count", 0) == 0:
+            return {"count": 0}
         return {
-            "count": total,
-            "p50_ms": pct(0.50),
-            "p95_ms": pct(0.95),
-            "p99_ms": pct(0.99),
-            "max_ms": round(float(window[-1]) * 1e3, 4),
-            "mean_ms": round(float(window.mean()) * 1e3, 4),
+            "count": base["count"],
+            "p50_ms": round(base["p50"] * 1e3, 4),
+            "p95_ms": round(base["p95"] * 1e3, 4),
+            "p99_ms": round(base["p99"] * 1e3, 4),
+            "max_ms": round(base["max"] * 1e3, 4),
+            "mean_ms": round(base["mean"] * 1e3, 4),
         }
 
 
-class RateMeter:
-    """Sliding-window event rate (QPS / rows-per-second)."""
-
-    def __init__(self, window_s: float = 60.0) -> None:
-        self.window_s = window_s
-        self._events: deque = deque()  # (t, weight)
-        self._lock = threading.Lock()
-
-    def record(self, weight: float = 1.0, now: Optional[float] = None) -> None:
-        t = time.time() if now is None else now
-        with self._lock:
-            self._events.append((t, weight))
-            self._trim(t)
-
-    def _trim(self, now: float) -> None:
-        cutoff = now - self.window_s
-        while self._events and self._events[0][0] < cutoff:
-            self._events.popleft()
-
-    def rate(self, now: Optional[float] = None) -> float:
-        t = time.time() if now is None else now
-        with self._lock:
-            self._trim(t)
-            if not self._events:
-                return 0.0
-            span = max(t - self._events[0][0], 1e-9)
-            # a single burst shorter than the window divides by its true
-            # span, not the full window, so cold-start rates aren't diluted
-            return sum(w for _, w in self._events) / min(span, self.window_s)
-
-
 class ServeMetrics:
-    """The server's one metrics hub (serve/server.py wires everything here)."""
+    """The server's one metrics hub (serve/server.py wires everything here).
 
-    def __init__(self) -> None:
-        self.request_latency = LatencyWindow()  # full request wall time
-        self.dispatch_latency = LatencyWindow()  # device dispatch only
-        self.qps = RateMeter()
-        self.rows_per_sec = RateMeter()
-        self.batch_occupancy = LatencyWindow(1024)  # 0..1, reuses the ring
-        self._counters: Dict[str, int] = {}
-        self._lock = threading.Lock()
+    All instruments are registered on ``self.registry`` under stable names,
+    so ``prometheus_text()`` is the complete serving exposition:
+    request/dispatch latency summaries, qps / rows_per_second gauges, queue
+    depth, batch occupancy, and every ``incr`` counter (as ``*_total``).
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        reg = registry if registry is not None else MetricsRegistry()
+        self.registry = reg
+        self.request_latency = reg.attach(
+            "request_latency_seconds", LatencyWindow()
+        )  # full request wall time
+        self.dispatch_latency = reg.attach(
+            "dispatch_latency_seconds", LatencyWindow()
+        )  # device dispatch only
+        self.qps = reg.rate("qps")
+        self.rows_per_sec = reg.rate("rows_per_second")
+        self.batch_occupancy = reg.attach(
+            "batch_occupancy_ratio", Histogram(1024)
+        )  # 0..1 per dispatched batch
         self.queue_depth_fn = lambda: 0  # wired to the batcher's queue
+        reg.gauge("queue_depth").set_fn(
+            lambda: float(self.queue_depth_fn())
+        )
 
     def incr(self, name: str, by: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + by
+        self.registry.counter(name).inc(by)
 
     def counters(self) -> Dict[str, int]:
-        with self._lock:
-            return dict(self._counters)
+        return self.registry.counters()
+
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
 
     def snapshot(self, dispatcher_stats: Optional[Dict] = None) -> Dict[str, object]:
         occ = self.batch_occupancy.snapshot()
@@ -111,10 +92,9 @@ class ServeMetrics:
             "queue_depth": int(self.queue_depth_fn()),
             "counters": self.counters(),
             "batch_occupancy": {
-                # the ring stores occupancy fractions; rename the ms fields
                 "count": occ.get("count", 0),
-                "mean": round(occ.get("mean_ms", 0.0) / 1e3, 4),
-                "p50": round(occ.get("p50_ms", 0.0) / 1e3, 4),
+                "mean": round(occ.get("mean", 0.0), 4),
+                "p50": round(occ.get("p50", 0.0), 4),
             },
         }
         if dispatcher_stats:

@@ -8,8 +8,11 @@ backend; the request path is
     (pad to pow2 rows) -> packed device dispatch -> fan results back out
 
 Endpoints:
-  GET  /healthz   liveness + backend + model readiness
-  GET  /metrics   ServeMetrics snapshot + per-model bucket/retrace stats
+  GET  /healthz       liveness + backend + model readiness
+  GET  /metrics       Prometheus text exposition (serve instruments + the
+                      process-wide obs registry: train phases, jit retraces,
+                      device memory; docs/Observability.md)
+  GET  /metrics.json  the legacy JSON snapshot + per-model bucket stats
   GET  /models    registry listing (fingerprint, version, shape, objective)
   POST /models    {"name": ..., "path": ...} — load or atomically hot-swap
   POST /predict   {"rows": [[...]], "model"?, "raw_score"?, "pred_leaf"?,
@@ -33,6 +36,9 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..models.model_text import model_fingerprint, peek_model_header
+from ..obs import registry as obs_registry
+from ..obs import retrace as retrace_mod
+from ..obs import trace as trace_mod
 from ..utils import log
 from ..utils.log import LightGBMError
 from ..utils.vfile import vopen
@@ -53,9 +59,12 @@ def ensure_backend() -> str:
         jax.devices()
         return jax.default_backend()
     except RuntimeError as e:
-        log.warning(
+        # warn_once: restart loops / repeated probes would otherwise emit an
+        # identical line per attempt and bury the first (informative) one
+        log.warn_once(
+            "serve-backend-fallback",
             "serve: accelerator backend failed to initialize (%s); "
-            "falling back to CPU" % str(e)[:200]
+            "falling back to CPU" % str(e)[:200],
         )
         jax.config.update("jax_platforms", "cpu")
         return jax.default_backend()
@@ -146,36 +155,74 @@ class ServedModel:
 
 
 class ModelRegistry:
-    """name -> ServedModel with atomic hot swap."""
+    """name -> ServedModel with atomic hot swap.
 
-    def __init__(self, min_bucket_rows: int = 16) -> None:
+    ``warmup_rows > 0`` makes every load (startup AND hot swap) pre-compile
+    the new model's row buckets off-lock before it goes live, then — when
+    the retrace watchdog is armed — re-arm with the fresh counts. Without
+    this, a hot swap on a hardened server (LIGHTGBM_TPU_RETRACE=fail) would
+    fail its first requests on the new model's legitimate first compiles.
+    """
+
+    def __init__(self, min_bucket_rows: int = 16, warmup_rows: int = 0) -> None:
         self._models: Dict[str, ServedModel] = {}
         self._lock = threading.Lock()
+        # serializes whole load/hot-swap builds (rare operator actions):
+        # overlapping loads would race on the shared watchdog disarm/arm
+        # window below. Separate from _lock so concurrent PREDICTS are
+        # never blocked behind a build.
+        self._load_lock = threading.Lock()
         self.min_bucket_rows = min_bucket_rows
+        self.warmup_rows = warmup_rows
 
     def load(self, name: str, path: str) -> ServedModel:
         """Load (or atomically replace) ``name`` from a model-text file. The
-        whole build happens off-lock; a failed load leaves the old model
-        serving."""
+        whole build happens off the registry lock; a failed load leaves the
+        old model serving."""
         from ..basic import Booster
 
-        with vopen(path) as fh:
-            text = fh.read()
-        peek_model_header(text)  # cheap validation before the full parse
-        booster = Booster(model_str=text)
-        ensemble = booster.to_packed()
-        file_sha = model_fingerprint(text)
-        # the whole build — parse, pack, dispatchers — happens OFF the lock;
-        # only the version stamp + dict swap hold it, so concurrent predicts
-        # never block behind a hot swap
-        served = ServedModel(
-            name, path, ensemble, file_sha, 0, self.min_bucket_rows
-        )
-        with self._lock:
-            served.version = (
-                self._models[name].version + 1 if name in self._models else 1
+        with self._load_lock:
+            with vopen(path) as fh:
+                text = fh.read()
+            peek_model_header(text)  # cheap validation before the full parse
+            booster = Booster(model_str=text)
+            ensemble = booster.to_packed()
+            file_sha = model_fingerprint(text)
+            # the whole build — parse, pack, dispatchers — happens OFF the
+            # registry lock; only the version stamp + dict swap hold it, so
+            # concurrent predicts never block behind a hot swap
+            served = ServedModel(
+                name, path, ensemble, file_sha, 0, self.min_bucket_rows
             )
-            self._models[name] = served
+            # the incoming model's warmup compiles are legitimate — they
+            # must not trip an armed watchdog (LIGHTGBM_TPU_RETRACE=fail
+            # would fail the swap on its own warmup, and warn mode would
+            # burn the warn_once key a REAL later retrace needs). Suspend
+            # enforcement for the build and re-arm with the fresh counts in
+            # a finally — a failed warmup must not leave the server
+            # permanently unpoliced.
+            was_armed = retrace_mod.WATCHDOG.armed
+            if was_armed:
+                retrace_mod.disarm()
+            try:
+                if self.warmup_rows > 0:
+                    # compile the new model's buckets BEFORE it goes live:
+                    # in-flight traffic keeps hitting the old model's
+                    # warmed dispatchers while this one warms
+                    buckets = served.warmup(self.warmup_rows)
+                    log.info(
+                        "serve: model %r warmed buckets %s" % (name, buckets)
+                    )
+                with self._lock:
+                    served.version = (
+                        self._models[name].version + 1
+                        if name in self._models
+                        else 1
+                    )
+                    self._models[name] = served
+            finally:
+                if was_armed:
+                    retrace_mod.arm()
         log.info(
             "serve: model %r v%d loaded from %s (%d trees, %d features)"
             % (name, served.version, path, ensemble.num_trees, ensemble.num_features)
@@ -216,13 +263,14 @@ class ServeApp:
         max_batch_rows: int = 4096,
         max_delay_ms: float = 2.0,
         min_bucket_rows: int = 16,
+        warmup_rows: int = 0,
     ) -> None:
         if mode not in ("exact", "fused"):
             raise LightGBMError("serve mode must be 'exact' or 'fused'")
         self.mode = mode
         self.backend = ensure_backend()
         self.metrics = ServeMetrics()
-        self.registry = ModelRegistry(min_bucket_rows)
+        self.registry = ModelRegistry(min_bucket_rows, warmup_rows)
         self.batcher = (
             MicroBatcher(
                 self._dispatch,
@@ -258,10 +306,27 @@ class ServeApp:
         served = self.registry.get(model)
         kind = self._kind(raw_score, pred_leaf, fused)
         key = (served, kind)
-        if self.batcher is not None:
-            out = self.batcher.submit(key, X).result(timeout=PREDICT_TIMEOUT_S)
-        else:
-            out = self._dispatch(key, X)
+        t0 = time.perf_counter()  # interval clock: immune to NTP steps
+        # the request-lifecycle root span: queue wait + batch gather +
+        # dispatch + reply all nest inside (or alongside, for the worker
+        # thread's events) this one — obs/trace.py
+        with trace_mod.span(
+            "serve.request", cat="serve", model=served.name, kind=kind,
+            rows=int(X.shape[0]),
+        ):
+            if self.batcher is not None:
+                out = self.batcher.submit(key, X).result(
+                    timeout=PREDICT_TIMEOUT_S
+                )
+            else:
+                out = self._dispatch(key, X)
+        # request accounting lives HERE, not in the HTTP handler, so direct
+        # drivers (tests, obs smoke, embedding hosts) meter identically
+        m = self.metrics
+        m.qps.record()
+        m.incr("requests")
+        m.incr("rows", int(X.shape[0]))
+        m.request_latency.record(time.perf_counter() - t0)
         return out, served
 
     def dispatcher_stats(self) -> Dict[str, object]:
@@ -274,6 +339,33 @@ class ServeApp:
                 "fused": served.fused_disp.stats(),
             }
         return out
+
+    def arm_retrace_watchdog(self) -> None:
+        """Snapshot jit-trace counts as the warm baseline: any compile after
+        this is a retrace (warned once; LIGHTGBM_TPU_RETRACE=fail raises).
+        Called by ``python -m lightgbm_tpu.serve`` once startup warmup has
+        compiled every bucket (obs/retrace.py)."""
+        retrace_mod.arm()
+
+    def prometheus_metrics(self) -> str:
+        """Prometheus text: this app's serving instruments + the process-wide
+        obs registry (train phases, jit traces, device memory). Per-model
+        bucket stats ride as labeled gauges so steady-state retraces are
+        scrapeable per model."""
+        g_buckets = self.metrics.registry.gauge("model_buckets")
+        g_retrace = self.metrics.registry.gauge("model_bucket_retraces")
+        for name, stats in self.dispatcher_stats().items():
+            for kind in ("exact", "fused"):
+                g_buckets.set(
+                    len(stats[kind]["buckets"]), model=name, kind=kind
+                )
+                g_retrace.set(
+                    stats[kind]["retraces"], model=name, kind=kind
+                )
+        return (
+            self.metrics.prometheus_text()
+            + obs_registry.REGISTRY.prometheus_text()
+        )
 
     def close(self) -> None:
         if self.batcher is not None:
@@ -291,9 +383,12 @@ class _Handler(BaseHTTPRequestHandler):
         log.debug("serve: " + fmt % args)
 
     def _json(self, code: int, payload: Dict) -> None:
-        body = json.dumps(payload).encode("utf-8")
+        self._text(code, json.dumps(payload), "application/json")
+
+    def _text(self, code: int, text: str, ctype: str) -> None:
+        body = text.encode("utf-8")
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -327,6 +422,14 @@ class _Handler(BaseHTTPRequestHandler):
                 },
             )
         elif path == "/metrics":
+            # Prometheus text exposition (docs/Observability.md has a scrape
+            # config example); the pre-obs JSON snapshot moved to
+            # /metrics.json
+            self._text(
+                200, app.prometheus_metrics(),
+                "text/plain; version=0.0.4; charset=utf-8",
+            )
+        elif path == "/metrics.json":
             self._json(200, app.metrics.snapshot(app.dispatcher_stats()))
         elif path == "/models":
             self._json(200, {"models": app.registry.list()})
@@ -336,7 +439,6 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
         app = self.app
         path = self.path.split("?", 1)[0]
-        t0 = time.time()
         try:
             body = self._body()
             if path == "/predict":
@@ -354,10 +456,7 @@ class _Handler(BaseHTTPRequestHandler):
                     pred_leaf=bool(body.get("pred_leaf", False)),
                     fused=body.get("fused"),
                 )
-                app.metrics.qps.record()
-                app.metrics.incr("requests")
-                app.metrics.incr("rows", X.shape[0])
-                app.metrics.request_latency.record(time.time() - t0)
+                # request counters + latency are recorded by app.predict
                 self._json(
                     200,
                     {

@@ -3,16 +3,22 @@
 TPU-native counterpart of the reference's ``Log`` singleton
 (/root/reference/include/LightGBM/utils/log.h:38-108): levels Debug/Info/Warning/Fatal,
 Fatal raises, and a pluggable callback so embedding hosts (CLI, tests) can redirect
-output.
+output. Each emitted line carries an ISO-8601 timestamp; ``warn_once``
+rate-limits recurring warnings (backend probes, CPU fallbacks) to one line
+per key per process.
 """
 from __future__ import annotations
 
 import sys
+import threading
+import time
 from typing import Callable, Optional
 
 _LEVELS = {"debug": 10, "info": 20, "warning": 30, "fatal": 40}
 _level = "info"
 _callback: Optional[Callable[[str], None]] = None
+_warned_keys: set = set()
+_warn_lock = threading.Lock()
 
 
 class LightGBMError(Exception):
@@ -40,7 +46,8 @@ def register_callback(cb: Optional[Callable[[str], None]]) -> None:
 def _emit(level: str, msg: str) -> None:
     if _LEVELS[level] < _LEVELS[_level]:
         return
-    text = "[LightGBM-TPU] [%s] %s" % (level.capitalize(), msg)
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime())
+    text = "[LightGBM-TPU] [%s] [%s] %s" % (stamp, level.capitalize(), msg)
     if _callback is not None:
         _callback(text + "\n")
     else:
@@ -57,6 +64,26 @@ def info(msg: str, *args) -> None:
 
 def warning(msg: str, *args) -> None:
     _emit("warning", msg % args if args else msg)
+
+
+def warn_once(key: str, msg: str, *args) -> bool:
+    """Emit a warning once per ``key`` per process; later calls with the
+    same key are dropped. For warnings that recur structurally (backend
+    probe failures, CPU fallbacks, retraces) where the first line carries
+    all the signal and repetition only buries it. Returns whether the line
+    was emitted."""
+    with _warn_lock:
+        if key in _warned_keys:
+            return False
+        _warned_keys.add(key)
+    warning(msg, *args)
+    return True
+
+
+def reset_warn_once() -> None:
+    """Forget warn_once history (tests)."""
+    with _warn_lock:
+        _warned_keys.clear()
 
 
 def fatal(msg: str, *args) -> None:

@@ -23,7 +23,14 @@ Two numbers are recorded per phase:
 
 For kernel-level breakdowns use LIGHTGBM_TPU_PROFILE=<dir> instead, which
 wraps training in a ``jax.profiler`` trace readable in TensorBoard/Perfetto —
-the TPU-native counterpart of poking timers into the C++ learner.
+the TPU-native counterpart of poking timers into the C++ learner. For host-
+side span timelines use LIGHTGBM_TPU_TRACE=<path> (obs/trace.py): every
+phase below also records a Chrome-trace span whenever that tracer is active,
+independent of whether the TIMETAG accumulators are on.
+
+Clock: ``time.perf_counter`` throughout — monotonic. The pre-obs
+``time.time()`` was wall-clock, so an NTP step mid-run silently corrupted
+phase totals (and could even go negative).
 """
 from __future__ import annotations
 
@@ -32,6 +39,7 @@ import os
 import time
 from typing import Dict, Optional
 
+from ..obs import trace as trace_mod
 from . import log
 
 ENV_FLAG = "LIGHTGBM_TPU_TIMETAG"
@@ -62,7 +70,7 @@ class _PhaseHandle:
         self.dispatch: Optional[float] = None
 
     def mark(self, result=None) -> None:
-        self.dispatch = time.time() - self._t0
+        self.dispatch = time.perf_counter() - self._t0
         if self._sync and result is not None:
             import jax
 
@@ -95,22 +103,32 @@ class PhaseTimers:
 
     @contextlib.contextmanager
     def phase(self, name: str):
-        if not self.enabled:
+        # the obs tracer records a span for every phase even when the
+        # TIMETAG accumulators are off — routed through trace_mod.span so
+        # the phase ALSO enters jax.profiler.TraceAnnotation and lines up
+        # with LIGHTGBM_TPU_PROFILE device timelines; span cost is paid
+        # only while a tracer is live, disabled cost is one global read
+        if not self.enabled and trace_mod.active() is None:
             yield _NOOP
             return
-        t0 = time.time()
-        handle = _PhaseHandle(self.sync, t0)
-        try:
-            yield handle
-        finally:
-            dt = time.time() - t0
-            self.seconds[name] = self.seconds.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
-            # a phase that never mark()ed is all host work: dispatch == total
-            host = handle.dispatch if handle.dispatch is not None else dt
-            self.dispatch_seconds[name] = (
-                self.dispatch_seconds.get(name, 0.0) + host
-            )
+        with trace_mod.span(name, cat="train.phase"):
+            if not self.enabled:
+                yield _NOOP
+                return
+            t0 = time.perf_counter()
+            handle = _PhaseHandle(self.sync, t0)
+            try:
+                yield handle
+            finally:
+                dt = time.perf_counter() - t0
+                self.seconds[name] = self.seconds.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
+                # a phase that never mark()ed is all host work:
+                # dispatch == total
+                host = handle.dispatch if handle.dispatch is not None else dt
+                self.dispatch_seconds[name] = (
+                    self.dispatch_seconds.get(name, 0.0) + host
+                )
 
     def report(self) -> None:
         if not self.enabled or not self.seconds:
@@ -129,6 +147,26 @@ class PhaseTimers:
                 )
             )
         log.info("  %-18s %8.3fs" % ("total", total))
+
+    def publish(self, registry=None) -> None:
+        """Export the accumulated phase totals into the metrics registry
+        (labels carry the phase name): ``train_phase_seconds_total``,
+        ``train_phase_dispatch_seconds_total``, ``train_phase_calls_total``.
+        No-op when nothing was recorded; engine.train calls this once at
+        the end so /metrics, bench JSON and bringup reports all read the
+        same numbers (docs/Observability.md)."""
+        if not self.seconds:
+            return
+        from ..obs import registry as registry_mod
+
+        reg = registry if registry is not None else registry_mod.REGISTRY
+        g_total = reg.gauge("train_phase_seconds_total")
+        g_disp = reg.gauge("train_phase_dispatch_seconds_total")
+        g_calls = reg.gauge("train_phase_calls_total")
+        for name, secs in self.seconds.items():
+            g_total.set(secs, phase=name)
+            g_disp.set(self.dispatch_seconds.get(name, secs), phase=name)
+            g_calls.set(self.counts.get(name, 0), phase=name)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,10 @@
+"""JX009 true positives: raw wall-clock timing and bare print() in a file
+living under an observability-routed directory (ops/ or models/)."""
+import time
+
+
+def timed_pass(run):
+    t0 = time.time()  # JX009: wall-clock; NTP steps corrupt the interval
+    out = run()
+    print("pass took", time.time() - t0)  # JX009 x2: print + time.time
+    return out

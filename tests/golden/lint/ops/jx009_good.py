@@ -1,0 +1,16 @@
+"""JX009 true negatives: monotonic clocks and routed logging are fine even
+inside ops/ / models/ — and helpers outside those dirs may print freely."""
+import time
+
+from lightgbm_tpu.utils import log
+
+
+def timed_pass(run):
+    t0 = time.perf_counter()  # monotonic: the sanctioned interval clock
+    out = run()
+    log.debug("pass took %.3fs", time.perf_counter() - t0)
+    return out
+
+
+def recurring_warning():
+    log.warn_once("fallback", "falling back to the slow path")

@@ -28,7 +28,16 @@ from tools.graftlint.engine import compare_to_baseline  # noqa: E402
 
 LINT_DIR = os.path.join(REPO, "tests", "golden", "lint")
 ALL_RULES = ("JX001", "JX002", "JX003", "JX004",
-             "JX005", "JX006", "JX007", "JX008")
+             "JX005", "JX006", "JX007", "JX008", "JX009")
+
+
+def _fixture(rule_id, kind):
+    """Fixture path for a rule: directory-scoped rules (JX009) keep their
+    fixtures under golden/lint/ops/ so the scope gate sees an ops/ path
+    segment; everything else lives flat in golden/lint/."""
+    name = "%s_%s.py" % (rule_id.lower(), kind)
+    scoped = os.path.join(LINT_DIR, "ops", name)
+    return scoped if os.path.exists(scoped) else os.path.join(LINT_DIR, name)
 
 
 def _lint(path, rule_id):
@@ -40,7 +49,7 @@ def _lint(path, rule_id):
 # ---------------------------------------------------------------------------
 @pytest.mark.parametrize("rule_id", ALL_RULES)
 def test_rule_fires_on_bad_fixture(rule_id):
-    path = os.path.join(LINT_DIR, "%s_bad.py" % rule_id.lower())
+    path = _fixture(rule_id, "bad")
     findings = _lint(path, rule_id)
     assert findings, "%s produced no findings on its bad fixture" % rule_id
     assert all(f.rule == rule_id for f in findings)
@@ -53,7 +62,7 @@ def test_rule_fires_on_bad_fixture(rule_id):
 
 @pytest.mark.parametrize("rule_id", ALL_RULES)
 def test_rule_silent_on_good_fixture(rule_id):
-    path = os.path.join(LINT_DIR, "%s_good.py" % rule_id.lower())
+    path = _fixture(rule_id, "good")
     findings = _lint(path, rule_id)
     assert findings == [], (
         "%s false positives: %s" % (rule_id, [f.format() for f in findings])
@@ -87,6 +96,30 @@ def test_jx006_hot_path_factory(tmp_path):
     good = run_lint([str(ops_dir / "jx006_good.py")],
                     root=str(tmp_path), select=["JX006"])
     assert good == []
+
+
+def test_jx009_scoped_to_ops_and_models(tmp_path):
+    """JX009 polices only ops/ and models/ directories: the same file is
+    clean under helpers/ (bench scripts print their protocol lines) and
+    flagged under models/."""
+    src = open(_fixture("JX009", "bad")).read()
+    for dirname, expected in (("helpers", 0), ("models", 3)):
+        d = tmp_path / dirname
+        d.mkdir()
+        p = d / "timed.py"
+        p.write_text(src)
+        findings = run_lint([str(p)], root=str(tmp_path), select=["JX009"])
+        assert len(findings) == expected, (dirname, [
+            f.format() for f in findings
+        ])
+
+
+def test_jx009_counts():
+    findings = _lint(_fixture("JX009", "bad"), "JX009")
+    # two time.time() calls + one print()
+    assert len(findings) == 3
+    msgs = " ".join(f.message for f in findings)
+    assert "perf_counter" in msgs and "print()" in msgs
 
 
 def test_jx007_axis_index_first_positional(tmp_path):

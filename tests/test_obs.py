@@ -1,0 +1,547 @@
+"""Unified observability layer (lightgbm_tpu/obs, docs/Observability.md):
+
+  * structured tracing: a tiny traced train+serve run emits Chrome-trace
+    JSON with pid/tid/ph/ts on every event, >= 3 training-phase spans
+    nested inside an iteration span, and >= 1 serve request span;
+  * retrace watchdog: counts REAL jax.jit trace events, passes on the
+    warmed serve path, and trips (LIGHTGBM_TPU_RETRACE=fail) on a
+    deliberately shape-unstable call;
+  * metrics registry: Prometheus text exposition round-trips through a
+    parser and carries latency quantiles, QPS, retrace count and peak
+    device bytes;
+  * memwatch: shape-math attribution equals the actual donated buffer
+    sizes (hist carry + spec_rhist) on CPU;
+  * satellites: perf_counter-based phase timers, log.warn_once with ISO
+    timestamps, spec_rhist donation reuse.
+"""
+import json
+import os
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.ops.grow as grow_mod
+from lightgbm_tpu.obs import memwatch, registry as registry_mod, retrace, trace
+from lightgbm_tpu.obs.registry import MetricsRegistry
+from lightgbm_tpu.utils import log
+from lightgbm_tpu.utils.log import LightGBMError
+from lightgbm_tpu.utils.timer import PhaseTimers
+
+
+@pytest.fixture
+def clean_obs(monkeypatch):
+    """Isolate the global tracer/watchdog state per test."""
+    trace.stop()
+    retrace.disarm()
+    monkeypatch.delenv("LIGHTGBM_TPU_TRACE", raising=False)
+    monkeypatch.delenv("LIGHTGBM_TPU_RETRACE", raising=False)
+    yield
+    trace.stop()
+    retrace.disarm()
+    log.reset_warn_once()
+
+
+def _train_small(rounds=3, n=500, leaves=7, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": leaves, "verbosity": -1},
+        lgb.Dataset(X, label=y), num_boost_round=rounds,
+    )
+    return bst, X
+
+
+# ---------------------------------------------------------------------------
+# structured tracing
+# ---------------------------------------------------------------------------
+
+PHASES = {"boosting(grad)", "bagging", "tree growth", "renew+score update"}
+
+
+def test_trace_golden_train_and_serve(clean_obs, monkeypatch, tmp_path):
+    """The acceptance-criteria trace: train + one serve request under
+    LIGHTGBM_TPU_TRACE, then validate the Chrome-trace JSON structurally."""
+    path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("LIGHTGBM_TPU_TRACE", path)
+    bst, X = _train_small()
+    model = str(tmp_path / "m.txt")
+    bst.save_model(model)
+
+    from lightgbm_tpu.serve.server import ServeApp
+
+    app = ServeApp(max_delay_ms=1.0, min_bucket_rows=8)
+    try:
+        app.registry.load("m", model)
+        out, _ = app.predict(X[:5])
+        assert out.shape[0] == 5
+    finally:
+        app.close()
+    written = trace.stop()
+    assert written == path
+
+    doc = json.load(open(path))
+    events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert events
+    for e in events:  # structural contract: chrome-trace complete events
+        for field in ("pid", "tid", "ph", "ts", "dur", "name", "cat"):
+            assert field in e, (field, e)
+        assert e["dur"] >= 0.0
+    names = {e["name"] for e in events}
+    assert len(names & PHASES) >= 3, sorted(names)
+    assert "train.iteration" in names
+    # serve request lifecycle: root span + worker-side batch events
+    assert "serve.request" in names
+    assert "serve.batch_dispatch" in names
+    assert "serve.queue_wait" in names
+
+
+def test_trace_spans_nest_inside_iteration(clean_obs, monkeypatch, tmp_path):
+    path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("LIGHTGBM_TPU_TRACE", path)
+    _train_small(rounds=2)
+    trace.stop()
+    events = [
+        e for e in json.load(open(path))["traceEvents"] if e.get("ph") == "X"
+    ]
+    iters = [e for e in events if e["name"] == "train.iteration"]
+    phases = [e for e in events if e["name"] in PHASES]
+    assert len(iters) == 2
+    # every phase span lies inside SOME iteration span on the same thread
+    for ph in phases:
+        assert any(
+            it["tid"] == ph["tid"]
+            and it["ts"] <= ph["ts"]
+            and ph["ts"] + ph["dur"] <= it["ts"] + it["dur"] + 1.0
+            for it in iters
+        ), ph
+
+
+def test_trace_disabled_is_silent(clean_obs, tmp_path):
+    assert trace.active() is None
+    with trace.span("nothing"):
+        pass
+    assert trace.stop() is None
+
+
+def test_phase_spans_without_timetag(clean_obs, monkeypatch, tmp_path):
+    """Tracing is independent of the TIMETAG accumulators: phases emit
+    spans even with timers disabled (and the timers stay off)."""
+    path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("LIGHTGBM_TPU_TRACE", path)
+    monkeypatch.delenv("LIGHTGBM_TPU_TIMETAG", raising=False)
+    bst, _ = _train_small(rounds=1)
+    assert not bst._gbdt.timers.enabled
+    assert not bst._gbdt.timers.seconds
+    trace.stop()
+    names = {
+        e["name"]
+        for e in json.load(open(path))["traceEvents"]
+        if e.get("ph") == "X"
+    }
+    assert len(names & PHASES) >= 3
+
+
+# ---------------------------------------------------------------------------
+# retrace watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_counts_real_jit_traces(clean_obs, monkeypatch):
+    wd = retrace.RetraceWatchdog()
+
+    @jax.jit
+    def f(x):
+        wd.note_trace("f")
+        return x * 2
+
+    f(jnp.ones(4))
+    f(jnp.ones(4))  # cache hit: no new trace
+    assert wd.counts() == {"f": 1}
+    f(jnp.ones(8))  # new shape: one real compile
+    assert wd.counts() == {"f": 2}
+
+    wd.arm()
+    f(jnp.ones(8))  # warmed shape
+    assert wd.retraces_after_warmup() == {}
+    monkeypatch.setenv("LIGHTGBM_TPU_RETRACE", "fail")
+    with pytest.raises(LightGBMError, match="retrace after warmup"):
+        f(jnp.ones(16))  # shape-unstable: trips the armed watchdog
+    assert wd.retraces_after_warmup() == {"f": 1}
+
+
+def test_watchdog_warn_mode_warns_once(clean_obs, monkeypatch):
+    wd = retrace.RetraceWatchdog()
+    lines = []
+    log.set_verbosity(1)  # earlier verbosity=-1 training left level=fatal
+    log.register_callback(lines.append)
+    try:
+
+        @jax.jit
+        def g(x):
+            wd.note_trace("g")
+            return x + 1
+
+        g(jnp.ones(4))
+        wd.arm()
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRACE", "warn")
+        g(jnp.ones(8))
+        g(jnp.ones(16))
+        retraced = [ln for ln in lines if "retrace after warmup" in ln]
+        assert len(retraced) == 1  # warn_once: one line for the pattern
+        assert wd.total_retraces() == 2
+    finally:
+        log.register_callback(None)
+        log.reset_warn_once()
+
+
+def test_retrace_fail_passes_on_warmed_serve_path(
+    clean_obs, monkeypatch, tmp_path
+):
+    """The acceptance criterion: with every bucket warmed and the watchdog
+    armed, LIGHTGBM_TPU_RETRACE=fail serves mixed-size traffic without a
+    single compile — and a deliberately shape-unstable call trips it."""
+    bst, X = _train_small()
+    model = str(tmp_path / "m.txt")
+    bst.save_model(model)
+
+    from lightgbm_tpu.serve.server import ServeApp
+
+    app = ServeApp(max_delay_ms=1.0, min_bucket_rows=8)
+    try:
+        served = app.registry.load("m", model)
+        served.warmup(max_rows=64)  # compiles every bucket 8..64, both paths
+        app.arm_retrace_watchdog()
+        monkeypatch.setenv("LIGHTGBM_TPU_RETRACE", "fail")
+        for n in (3, 9, 17, 33, 64):  # all land in warmed buckets
+            out, _ = app.predict(X[:n])
+            assert out.shape[0] == n
+        assert retrace.retraces_after_warmup() == {}
+        # now bypass the bucket cache with a raw 100-row dispatch: a fresh
+        # shape, a fresh XLA trace, a hard failure
+        with pytest.raises(LightGBMError, match="retrace after warmup"):
+            served.ensemble.predict_leaves(X[:100])
+    finally:
+        monkeypatch.delenv("LIGHTGBM_TPU_RETRACE", raising=False)
+        retrace.reset()
+        app.close()
+
+
+def test_hot_swap_warms_and_rearms_armed_watchdog(clean_obs, tmp_path):
+    """A hot swap on a hardened server must not fail its first requests:
+    ModelRegistry.load suspends the armed watchdog around the incoming
+    model's warmup (those compiles are legitimate), then re-arms with the
+    fresh counts, so LIGHTGBM_TPU_RETRACE=fail survives the swap.
+
+    Runs in a SUBPROCESS: the in-process jit cache may already hold the
+    second model's shapes from earlier tests, which would make the swap
+    compile nothing and the assertion vacuous — a fresh process guarantees
+    the swap really traces."""
+    import subprocess
+    import sys
+
+    src = """
+import os
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serve.server import ServeApp
+from lightgbm_tpu.obs import retrace
+
+rng = np.random.RandomState(0)
+X = rng.randn(400, 4); y = (X[:, 0] > 0).astype(float)
+a = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+              lgb.Dataset(X, label=y), 2)
+b = lgb.train({"objective": "binary", "num_leaves": 15, "verbose": -1},
+              lgb.Dataset(X, label=y), 4)  # different packed shapes
+td = os.environ["SWAP_DIR"]
+pa, pb = os.path.join(td, "a.txt"), os.path.join(td, "b.txt")
+a.save_model(pa); b.save_model(pb)
+
+app = ServeApp(max_delay_ms=1.0, min_bucket_rows=8, warmup_rows=16)
+app.registry.load("m", pa)
+app.arm_retrace_watchdog()
+os.environ["LIGHTGBM_TPU_RETRACE"] = "fail"
+before = sum(retrace.counts().values())
+app.registry.load("m", pb)  # must warm + re-arm, not trip on its compiles
+assert sum(retrace.counts().values()) > before, "swap compiled nothing: vacuous"
+out, served = app.predict(X[:5])
+assert served.version == 2 and out.shape[0] == 5
+assert retrace.retraces_after_warmup() == {}
+app.close()
+print("SWAP_OK")
+"""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", SWAP_DIR=str(tmp_path),
+    )
+    env.pop("LIGHTGBM_TPU_RETRACE", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", src], env=env, capture_output=True,
+        text=True, timeout=300, cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "SWAP_OK" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# metrics registry + Prometheus exposition
+# ---------------------------------------------------------------------------
+
+_PROM_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})? (?P<value>[0-9eE\+\-\.]+)$"
+)
+
+
+def _parse_prom(text):
+    """Prometheus text exposition -> {(name, labels): float}; raises on any
+    malformed line (the round-trip contract)."""
+    out = {}
+    types = {}
+    for line in text.strip().splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _PROM_SAMPLE.match(line)
+        assert m, "malformed exposition line: %r" % line
+        out[(m.group("name"), m.group("labels") or "")] = float(
+            m.group("value")
+        )
+    return out, types
+
+
+def test_registry_prometheus_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("requests").inc(5)
+    reg.counter("by_model").inc(2, model="prod")
+    reg.counter("by_model").inc(3, model="canary")
+    reg.gauge("queue_depth").set(7)
+    reg.gauge("phase_s").set(1.5, phase="tree growth")
+    h = reg.histogram("latency_seconds")
+    for v in (0.001, 0.002, 0.003, 0.004):
+        h.record(v)
+    reg.rate("qps").record(10)
+
+    samples, types = _parse_prom(reg.prometheus_text())
+    assert types["lgbtpu_requests_total"] == "counter"
+    assert types["lgbtpu_latency_seconds"] == "summary"
+    assert types["lgbtpu_qps"] == "gauge"
+    assert samples[("lgbtpu_requests_total", "")] == 5
+    assert samples[("lgbtpu_by_model_total", 'model="canary"')] == 3
+    assert samples[("lgbtpu_queue_depth", "")] == 7
+    assert samples[("lgbtpu_phase_s", 'phase="tree growth"')] == 1.5
+    assert samples[("lgbtpu_latency_seconds", 'quantile="0.5"')] == 0.003
+    assert samples[("lgbtpu_latency_seconds_count", "")] == 4
+    assert samples[("lgbtpu_latency_seconds_sum", "")] == pytest.approx(0.01)
+
+    report = reg.run_report()
+    assert report["counters"]["requests"] == 5
+    assert report["summaries"]["latency_seconds"]["count"] == 4
+
+
+def test_serve_metrics_exposition_has_required_families(clean_obs, tmp_path):
+    """/metrics acceptance: latency quantiles, QPS, retrace count and peak
+    device bytes all present and parseable."""
+    bst, X = _train_small()
+    model = str(tmp_path / "m.txt")
+    bst.save_model(model)
+
+    from lightgbm_tpu.serve.server import ServeApp
+
+    app = ServeApp(max_delay_ms=1.0, min_bucket_rows=8)
+    try:
+        app.registry.load("m", model)
+        app.predict(X[:5])
+        samples, types = _parse_prom(app.prometheus_metrics())
+    finally:
+        app.close()
+    assert types["lgbtpu_request_latency_seconds"] == "summary"
+    assert ("lgbtpu_request_latency_seconds", 'quantile="0.5"') in samples
+    assert ("lgbtpu_qps", "") in samples
+    assert samples[("lgbtpu_requests_total", "")] >= 1
+    assert ("lgbtpu_jit_retraces_after_warmup", "") in samples
+    assert ("lgbtpu_jit_traces_total", "") in samples
+    assert samples[("lgbtpu_device_peak_bytes", "")] > 0
+    assert ("lgbtpu_bucket_retraces_total", "") in samples
+
+
+def test_training_publishes_phase_gauges(clean_obs, monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_TIMETAG", "1")
+    before = registry_mod.REGISTRY.counters().get("train_iterations", 0)
+    _train_small(rounds=2)
+    report = registry_mod.REGISTRY.run_report()
+    assert report["counters"]["train_iterations"] == before + 2
+    assert any(
+        k.startswith("train_phase_seconds_total") and "tree growth" in k
+        for k in report["gauges"]
+    )
+
+
+def test_record_metrics_callback():
+    from lightgbm_tpu.callback import record_metrics
+
+    reg = MetricsRegistry()
+    rng = np.random.RandomState(0)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y)
+    lgb.train(
+        {"objective": "binary", "num_leaves": 4, "verbosity": -1},
+        ds, num_boost_round=3, valid_sets=[ds], valid_names=["train"],
+        callbacks=[record_metrics(reg)], verbose_eval=False,
+    )
+    report = reg.run_report()
+    assert report["gauges"]["train_last_iteration"] == 3
+    assert report["counters"]["train_eval_boundaries"] == 3
+    assert any(k.startswith("eval_metric") for k in report["gauges"])
+
+
+# ---------------------------------------------------------------------------
+# memwatch
+# ---------------------------------------------------------------------------
+
+
+def test_memwatch_shape_math_matches_hist_buffer(clean_obs):
+    bst, _ = _train_small(leaves=15)
+    g = bst._gbdt
+    attr = memwatch.attribute_training(g)
+    assert g._hist_buf is not None
+    assert attr["hist_carry"]["bytes"] == g._hist_buf.nbytes
+    assert attr["hist_carry"]["donated"]
+    assert attr["scores"]["bytes"] == g.scores.nbytes
+    assert attr["bins"]["bytes"] == g.bins_dev.nbytes
+    assert attr["total_bytes"] >= attr["hist_carry"]["bytes"]
+
+
+def test_memwatch_packed_attribution(clean_obs):
+    bst, _ = _train_small()
+    pk = bst.to_packed()
+    attr = memwatch.attribute_packed(pk)
+    actual = sum(int(a.nbytes) for a in pk.packed)
+    assert attr["total_bytes"] == actual
+    assert attr["fields_bytes"]["leaf_value"] == int(pk.packed.leaf_value.nbytes)
+
+
+def test_memwatch_snapshot_cpu(clean_obs):
+    reg = MetricsRegistry()
+    rec = memwatch.snapshot("test_point", registry=reg)
+    assert rec["tag"] == "test_point"
+    # CPU backend reports no allocator stats; the live census stands in
+    assert rec["live_buffer_bytes"] >= 0
+    gauges = reg.run_report()["gauges"]
+    assert "device_peak_bytes" in gauges
+    assert memwatch.snapshots()[-1]["tag"] == "test_point"
+
+
+# ---------------------------------------------------------------------------
+# satellites: timers, warn_once, spec donation reuse
+# ---------------------------------------------------------------------------
+
+
+def test_phase_timers_use_monotonic_clock(clean_obs, monkeypatch):
+    """A wall-clock step (NTP) must not corrupt phase totals: freeze
+    time.time and confirm the timers still measure real elapsed time."""
+    import lightgbm_tpu.utils.timer as timer_mod
+
+    monkeypatch.setattr(timer_mod.time, "time", lambda: 0.0)
+    t = PhaseTimers(enabled=True, sync=False)
+    with t.phase("p") as ph:
+        time.perf_counter()  # any work
+        ph.mark()
+        # busy-wait ~2ms of real monotonic time
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.002:
+            pass
+    assert t.seconds["p"] >= 0.002  # wall-clock says 0; perf_counter doesn't
+    assert 0.0 <= t.dispatch_seconds["p"] <= t.seconds["p"] + 1e-9
+
+
+def test_warn_once_rate_limits_and_stamps(clean_obs):
+    lines = []
+    log.set_verbosity(1)  # earlier verbosity=-1 training left level=fatal
+    log.register_callback(lines.append)
+    try:
+        assert log.warn_once("k1", "thing happened: %d", 7)
+        assert not log.warn_once("k1", "thing happened: %d", 8)
+        assert log.warn_once("k2", "other thing")
+        assert len(lines) == 2
+        # ISO-8601 timestamp on every emitted line
+        for ln in lines:
+            assert re.search(r"\[\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\]", ln)
+        assert "thing happened: 7" in lines[0]
+    finally:
+        log.register_callback(None)
+        log.reset_warn_once()
+
+
+def test_spec_batch_slots_gate():
+    """The helper gbdt/memwatch rely on must agree with grow_tree's spec
+    gate (single source of truth): every decline condition zeroes it."""
+    import lightgbm_tpu.ops.grow as g
+
+    orig = g._ENV_GROW
+    g._ENV_GROW = "spec"
+    try:
+        assert g.spec_batch_slots(31) > 0
+        assert g.spec_batch_slots(31, pooled=True) == 0
+        assert g.spec_batch_slots(31, cegb_on=True) == 0
+        assert g.spec_batch_slots(31, hist_mode="masked") == 0
+        assert g.spec_batch_slots(31, custom_split=True) == 0
+        assert g.spec_batch_slots(2) == 0  # kb < 2 degenerates to seq
+        g._ENV_GROW = "seq"
+        assert g.spec_batch_slots(31) == 0
+    finally:
+        g._ENV_GROW = orig
+
+
+# NOTE: this test (and only it in this module) clears the jit caches, so it
+# runs LAST — earlier tests reuse one another's compiled programs.
+def test_spec_buf_donation_is_bitwise_invariant(clean_obs, monkeypatch):
+    """The spec_rhist carry survives across trees as a donated scratch (no
+    per-tree re-zeroing) and changes NOTHING semantically: spec training
+    with the donated buffer is bit-identical to spec training without it.
+    (Spec-vs-SEQ exactness is test_spec_grow's contract and has its own
+    documented flat-path near-tie caveat, ADVICE r5 #1 — this test pins the
+    delta this PR introduced: the donation itself.)"""
+    import lightgbm_tpu.models.gbdt as gbdt_mod
+    import lightgbm_tpu.ops.histogram as hist_mod
+
+    monkeypatch.setattr(hist_mod, "_ENV_IMPL", "xla")
+    monkeypatch.setattr(grow_mod, "_ENV_SPEC_HIST", "flat")
+    monkeypatch.setattr(grow_mod, "_ENV_GROW", "spec")
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(900, 6)
+    y = (X[:, 0] + 0.4 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+
+    jax.clear_caches()
+    try:
+        with_don = lgb.train(params, lgb.Dataset(X, label=y), 4)
+        assert grow_mod._LAST_GROW_MODE == "spec"
+        g = with_don._gbdt
+        assert g._spec_buf is not None
+        assert g._spec_buf.shape == (15, 6, g.num_bins, 3)
+        # memwatch shape math equals the real donated buffer (ADVICE r5 #2)
+        attr = memwatch.attribute_training(g)
+        assert attr["spec_rhist"]["bytes"] == g._spec_buf.nbytes
+        assert attr["spec_rhist"]["donated"]
+        # gbdt-side gate forced to 0 -> grow_tree gets spec_buf=None and
+        # allocates + zeros its own spec_rhist every tree (the pre-PR path)
+        monkeypatch.setattr(gbdt_mod, "spec_batch_slots", lambda *a, **k: 0)
+        jax.clear_caches()
+        no_don = lgb.train(params, lgb.Dataset(X, label=y), 4)
+        assert getattr(no_don._gbdt, "_spec_buf", None) is None
+        assert with_don.model_to_string() == no_don.model_to_string()
+    finally:
+        monkeypatch.setattr(grow_mod, "_ENV_GROW", "")
+        monkeypatch.setattr(grow_mod, "_ENV_SPEC_HIST", "")
+        jax.clear_caches()

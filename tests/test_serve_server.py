@@ -127,7 +127,21 @@ def test_predict_fused_close(served):
 
 def test_metrics_and_models_endpoints(served):
     _, port, _, _, _ = served
-    status, m = _call(port, "GET", "/metrics")
+    # /metrics is Prometheus text exposition since the obs PR; the JSON
+    # snapshot moved to /metrics.json (docs/Serving.md)
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+    try:
+        conn.request("GET", "/metrics")
+        r = conn.getresponse()
+        assert r.status == 200
+        assert r.getheader("Content-Type", "").startswith("text/plain")
+        text = r.read().decode("utf-8")
+    finally:
+        conn.close()
+    assert "# TYPE lgbtpu_requests_total counter" in text
+    assert 'lgbtpu_request_latency_seconds{quantile="0.5"}' in text
+    assert "lgbtpu_qps" in text
+    status, m = _call(port, "GET", "/metrics.json")
     assert status == 200
     assert m["counters"].get("requests", 0) >= 1
     assert "request_latency" in m and "buckets" in m
