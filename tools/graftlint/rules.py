@@ -427,6 +427,43 @@ def _is_broad(handler_type: Optional[ast.AST]) -> bool:
     return False
 
 
+_OBS_ROUTED_DIRS = ("ops", "models")
+
+
+@rule("JX009", "raw wall-clock / print in observability-routed packages")
+def jx009_raw_host_io(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """In ``lightgbm_tpu/ops/`` and ``lightgbm_tpu/models/`` every timing
+    and log line must route through the observability layer: ``time.time()``
+    is wall-clock (an NTP step corrupts phase totals — use
+    ``time.perf_counter`` via utils/timer.py or obs/trace.py spans), and a
+    bare ``print()`` bypasses the log levels, the ISO timestamps and the
+    pluggable callback (use utils/log.py, or ``log.warn_once`` for
+    recurring warnings). Scoped to those directories: helpers and bench
+    scripts legitimately print their own protocol lines.
+    """
+    if not any(seg in _OBS_ROUTED_DIRS for seg in ctx.rel_path.split("/")[:-1]):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        if fname == "time.time":
+            yield ctx.finding(
+                "JX009", node,
+                "time.time() is wall-clock (NTP steps corrupt intervals); "
+                "use time.perf_counter via utils/timer.py or an obs/trace "
+                "span",
+            )
+        elif isinstance(node.func, ast.Name) and node.func.id == "print":
+            yield ctx.finding(
+                "JX009", node,
+                "bare print() bypasses log levels/timestamps/callback; "
+                "route through utils/log.py (warn_once for recurring "
+                "warnings)",
+            )
+
+
+# --------------------------------------------------------------------------
 @rule("JX008", "broad exception handler silently swallows")
 def jx008_silent_swallow(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
     """``except Exception: pass`` (or a bare ``except:``) with nothing in
