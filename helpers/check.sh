@@ -14,6 +14,10 @@
 #   helpers/check.sh --obs      # lint gate, then the observability smoke:
 #                               # traced mini-train + serve, validate the
 #                               # Chrome-trace JSON + Prometheus /metrics
+#   helpers/check.sh --resil    # lint gate, then the resilience smoke:
+#                               # SIGKILL a checkpointing training run at an
+#                               # injected fault site, resume bit-identically;
+#                               # SIGTERM-drain the real server mid-flight
 #
 # ruff/mypy are optional: the container may not ship them (no network
 # installs); when absent they are skipped with a notice — graftlint and
@@ -23,9 +27,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs) ;;
+    full|--quick|--lint|--serve|--obs|--resil) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve or --obs)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs or --resil)" >&2
         exit 2
         ;;
 esac
@@ -69,6 +73,11 @@ fi
 if [ "$MODE" = "--obs" ]; then
     echo "== obs smoke (traced mini-train + serve, validate trace + /metrics) =="
     exec env JAX_PLATFORMS=cpu python helpers/obs_smoke.py
+fi
+
+if [ "$MODE" = "--resil" ]; then
+    echo "== resil smoke (SIGKILL/resume bit-identity + SIGTERM serve drain) =="
+    exec env JAX_PLATFORMS=cpu python helpers/resil_smoke.py
 fi
 
 if [ "$MODE" = "--quick" ]; then

@@ -40,6 +40,30 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, ".tpu_bringup.log")
+
+
+def _load_backoff():
+    """lightgbm_tpu.resil.backoff by FILE path: importing it through the
+    package would execute lightgbm_tpu/__init__ and pull jax into this
+    driver process, which stays jax-free on the no-trace path by design."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lgbtpu_resil_backoff",
+        os.path.join(REPO, "lightgbm_tpu", "resil", "backoff.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# transient tunnel/TPU-client wedges (the relay dying and coming back, a
+# stuck client that the process-group kill cleared) deserve another shot
+# before a stage is recorded failed: retries beyond the first attempt, and
+# the exponential backoff before each one (resil/backoff.py — the same
+# schedule helper the serve dispatch retry uses)
+STAGE_RETRIES = int(os.environ.get("LIGHTGBM_TPU_BRINGUP_RETRIES", "1"))
+STAGE_BACKOFF_S = float(os.environ.get("LIGHTGBM_TPU_BRINGUP_BACKOFF_S", "20"))
 _REHEARSAL = os.environ.get("LIGHTGBM_TPU_BRINGUP_CPU") == "1"
 # a CPU rehearsal must never write the production summary: bench.py's
 # bake-off adoption reads TPU_BRINGUP.json, and CPU-measured smoke rates
@@ -521,6 +545,44 @@ def run_stage(stage: str, src: str) -> dict:
     return _run_child(stage, [sys.executable, "-c", src])
 
 
+def _is_transient(result: dict) -> bool:
+    """Only the wedge shape is worth retrying: a timeout-KILLED child (hung
+    tunnel / wedged TPU client, the failure this retry exists for). A child
+    that ran to completion and failed (nonzero rc, in-child assertion) is
+    deterministic — re-running it just doubles time-to-red on real TPU time
+    without new information."""
+    return str(result.get("error", "")).startswith("timeout")
+
+
+def run_with_retry(stage: str, fn) -> dict:
+    """Run a stage up to 1 + STAGE_RETRIES times, sleeping the exponential
+    backoff schedule between attempts; only transient failures (timeout
+    kills) retry. Every attempt is logged; the returned result carries
+    ``attempts`` so the summary records how many shots a flaky tunnel
+    needed."""
+    attempts = 1 + max(STAGE_RETRIES, 0)
+    schedule = _load_backoff().delays(
+        attempts, base_s=STAGE_BACKOFF_S, factor=2.0, max_s=600.0
+    )
+    result = {"ok": False, "error": "stage never ran"}
+    for attempt in range(1, attempts + 1):
+        result = fn()
+        result["attempts"] = attempt
+        if result.get("ok") or not _is_transient(result):
+            return result
+        if attempt < attempts:
+            delay = next(schedule)
+            log_line(stage, {"retry_after_attempt": attempt,
+                             "backoff_s": delay})
+            print(
+                "bringup: stage %s failed (attempt %d/%d); retrying in %.0fs"
+                % (stage, attempt, attempts, delay),
+                flush=True,
+            )
+            time.sleep(delay)
+    return result
+
+
 def run_bench(stage: str = "bench") -> dict:
     env = dict(os.environ)
     env.pop("BENCH_FORCE_PLATFORMS", None)
@@ -579,7 +641,12 @@ def main() -> int:
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         with _stage_span(stage):
-            result = run_bench(stage) if src is None else run_stage(stage, src)
+            result = run_with_retry(
+                stage,
+                (lambda s=stage: run_bench(s))
+                if src is None
+                else (lambda s=stage, c=src: run_stage(s, c)),
+            )
         summary["stages"][stage] = result
         if stage == "smoke_seq":
             _check_spec_seq_match(summary)
@@ -596,7 +663,7 @@ def main() -> int:
                 return 1
     print("bringup: stage bench ...", flush=True)
     with _stage_span("bench"):
-        summary["stages"]["bench"] = run_bench()
+        summary["stages"]["bench"] = run_with_retry("bench", run_bench)
     ok = summary["stages"]["bench"].get("ok", False)
     summary["verdict"] = "ok" if ok else "bench failed"
     _dump(summary)

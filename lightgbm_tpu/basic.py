@@ -21,6 +21,7 @@ from .metric import Metric, create_metric, default_metric_for_objective
 from .models import gbdt as gbdt_mod
 from .models.model_text import dump_model_to_json, load_model_from_string, save_model_to_string
 from .objective import create_objective, objective_from_model_string
+from .resil.atomic import atomic_write_text
 from .utils import log
 from .utils.vfile import vopen
 from .utils.log import LightGBMError
@@ -989,8 +990,11 @@ class Booster:
     # -- model IO --------------------------------------------------------
 
     def save_model(self, filename: str, num_iteration: int = -1, start_iteration: int = 0) -> "Booster":
-        with vopen(filename, "w") as fh:
-            fh.write(self.model_to_string(num_iteration, start_iteration))
+        # atomic publish (resil/atomic.py): a crash mid-save leaves either
+        # the previous complete model file or the new one, never a prefix
+        atomic_write_text(
+            filename, self.model_to_string(num_iteration, start_iteration)
+        )
         return self
 
     def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0) -> str:

@@ -101,6 +101,9 @@ def record_evaluation(eval_result: Dict) -> Callable:
             series.append(value)
 
     _callback.order = 20  # type: ignore[attr-defined]
+    # resil/checkpoint.py repopulates the pre-crash entries through this on
+    # resume, so evals_result is not silently truncated at the crash point
+    _callback.eval_result = eval_result  # type: ignore[attr-defined]
     return _callback
 
 
@@ -180,6 +183,7 @@ class _EarlyStopper:
         self.best_iter: List[int] = []
         self.best_entries: List = []
         self.improves: List[Callable] = []
+        self.higher_better: List[bool] = []
 
     def _setup(self, env: CallbackEnv) -> None:
         self.initialized = True
@@ -198,6 +202,7 @@ class _EarlyStopper:
             print("Training until validation scores don't improve for %d rounds." % self.stopping_rounds)
         for entry in env.evaluation_result_list:
             higher_better = entry[3]
+            self.higher_better.append(bool(higher_better))
             self.best_value.append(float("-inf") if higher_better else float("inf"))
             self.best_iter.append(0)
             self.best_entries.append(None)
@@ -209,6 +214,42 @@ class _EarlyStopper:
         if self.verbose:
             print("%s\n[%d]\t%s" % (message, self.best_iter[i] + 1, _fmt_line(self.best_entries[i])))
         raise EarlyStopException(self.best_iter[i], self.best_entries[i])
+
+    # -- checkpoint support (resil/checkpoint.py) ----------------------------
+
+    def state_dict(self) -> Dict:
+        """JSON-able snapshot of the per-metric best trackers, so a resumed
+        run (engine.train(resume_from=...)) continues the SAME stopping
+        window instead of restarting it."""
+        return {
+            "initialized": self.initialized,
+            "active": self.active,
+            "best_value": [float(v) for v in self.best_value],
+            "best_iter": [int(i) for i in self.best_iter],
+            "best_entries": [
+                None if e is None else [list(entry) for entry in e]
+                for e in self.best_entries
+            ],
+            # stored at _setup, never probed out of the closures: a probe
+            # like imp(1.0, 0.0) would silently invert the moment improves
+            # gains a tolerance (min_delta-style)
+            "higher_better": [bool(hb) for hb in self.higher_better],
+        }
+
+    def load_state_dict(self, state: Dict) -> None:
+        self.initialized = bool(state["initialized"])
+        self.active = bool(state["active"])
+        self.best_value = [float(v) for v in state["best_value"]]
+        self.best_iter = [int(i) for i in state["best_iter"]]
+        self.best_entries = [
+            None if e is None else [tuple(entry) for entry in e]
+            for e in state["best_entries"]
+        ]
+        self.higher_better = [bool(hb) for hb in state["higher_better"]]
+        self.improves = [
+            (lambda new, old: new > old) if hb else (lambda new, old: new < old)
+            for hb in self.higher_better
+        ]
 
     def __call__(self, env: CallbackEnv) -> None:
         if not self.initialized:
@@ -240,4 +281,6 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False, verbos
     # engine.train clamps the device chunk to this window so a chunked run
     # can never overshoot the stop detection by more than the window itself
     _callback.stopping_rounds = stopping_rounds  # type: ignore[attr-defined]
+    # resil/checkpoint.py captures + restores the best trackers through this
+    _callback.stopper = stopper  # type: ignore[attr-defined]
     return _callback

@@ -22,6 +22,7 @@ from .obs import trace as trace_mod
 from .config import Config, load_config_file
 from .engine import train as train_api
 from .io import load_sidecar, load_text_file
+from .resil.atomic import atomic_write_text
 from .utils import log
 from .utils.vfile import vopen
 from .utils.log import LightGBMError
@@ -103,6 +104,10 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
 
         _snapshot.order = 100
         callbacks.append(_snapshot)
+    # crash-safe full-state checkpoints (beyond the model-only snapshots
+    # above): checkpoint_path=... [checkpoint_rounds=N] resume_from=...
+    # restart a SIGKILLed run bit-identically (docs/FaultTolerance.md);
+    # engine.train pops these from params so the model footer stays clean
     booster = train_api(
         params,
         train_set,
@@ -113,6 +118,9 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         early_stopping_rounds=config.early_stopping_round or None,
         verbose_eval=config.metric_freq if config.verbosity >= 1 else False,
         callbacks=callbacks or None,
+        checkpoint_path=config.checkpoint_path or None,
+        checkpoint_rounds=max(config.checkpoint_rounds, 0),
+        resume_from=config.resume_from or None,
     )
     booster.save_model(config.output_model)
     log.info("Finished training; model saved to %s" % config.output_model)
@@ -165,8 +173,7 @@ def run_convert_model(config: Config, params: Dict[str, str]) -> None:
 
     booster = Booster(model_file=config.input_model)
     code = save_model_to_ifelse(booster._gbdt, num_iteration=-1)
-    with vopen(config.convert_model, "w") as fh:
-        fh.write(code)
+    atomic_write_text(config.convert_model, code)
     log.info("Finished converting model; source saved to %s" % config.convert_model)
 
 

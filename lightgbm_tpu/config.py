@@ -283,6 +283,15 @@ class Config:
     data_random_seed: int = 1
     output_model: str = "LightGBM_model.txt"
     snapshot_freq: int = -1
+    # Crash-safe training checkpoints (resil/checkpoint.py,
+    # docs/FaultTolerance.md): full state (model text + score carries + RNG
+    # position + early-stopping bests) saved atomically every
+    # checkpoint_rounds iterations; resume_from restarts BIT-identically.
+    # checkpoint_rounds <= 0 falls back to snapshot_freq (reference parity),
+    # then to ~10 checkpoints per run (num_iterations // 10, min 1).
+    checkpoint_path: str = ""
+    checkpoint_rounds: int = -1
+    resume_from: str = ""
     input_model: str = ""
     output_result: str = "LightGBM_predict_result.txt"
     initscore_filename: str = ""

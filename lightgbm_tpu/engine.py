@@ -16,6 +16,7 @@ from . import callback as callback_mod
 from .basic import Booster, Dataset
 from .obs import registry as obs_registry
 from .obs import trace as trace_mod
+from .resil import faults
 from .utils import timer as timer_mod
 from .config import Config
 from .utils import log
@@ -39,6 +40,9 @@ def train(
     learning_rates=None,
     keep_training_booster: bool = False,
     callbacks: Optional[List[Callable]] = None,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_rounds: int = 0,
+    resume_from: Optional[str] = None,
 ) -> Booster:
     params = dict(params) if params else {}
     params = Config.canonicalize(params)
@@ -46,6 +50,37 @@ def train(
         num_boost_round = int(params.pop("num_iterations"))
     if "early_stopping_round" in params and early_stopping_rounds is None:
         early_stopping_rounds = int(params.pop("early_stopping_round"))
+    # resilience params (docs/FaultTolerance.md) may ride in via params;
+    # explicit kwargs win. They are POPPED so the Booster's Config (and the
+    # model's parameters footer) stays independent of where a run was
+    # checkpointed/resumed — the footer byte-identity the crash tests assert.
+    if "checkpoint_path" in params:
+        v = str(params.pop("checkpoint_path"))
+        checkpoint_path = checkpoint_path or v
+    if "checkpoint_rounds" in params:
+        v = int(params.pop("checkpoint_rounds"))
+        checkpoint_rounds = checkpoint_rounds if checkpoint_rounds > 0 else v
+    if "resume_from" in params:
+        v = str(params.pop("resume_from"))
+        resume_from = resume_from or v
+    if resume_from and not checkpoint_path:
+        # a resumed run keeps checkpointing to the file it resumed from: the
+        # crash that made the checkpoint necessary can strike again, and a
+        # second preemption must not throw away all post-resume progress
+        checkpoint_path = resume_from
+    if checkpoint_path and checkpoint_rounds <= 0:
+        # snapshot_freq parity: the reference's snapshot cadence doubles as
+        # the checkpoint cadence when no explicit rounds are given; absent
+        # both, default to ~10 checkpoints per run — a checkpoint serializes
+        # the full model text + score carries (+fsync), so a cadence of 1
+        # would turn a long run I/O-bound
+        snap = int(params.get("snapshot_freq", -1) or -1)
+        checkpoint_rounds = snap if snap > 0 else max(1, num_boost_round // 10)
+    if resume_from and init_model is not None:
+        raise LightGBMError(
+            "resume_from and init_model are mutually exclusive: a checkpoint "
+            "already carries its full model"
+        )
     if fobj is not None:
         params["objective"] = "none"
     # continued training
@@ -104,6 +139,49 @@ def train(
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
+    # crash-safe checkpoint/resume (resil/checkpoint.py). Restore happens
+    # AFTER valid sets attach (their score carries come from the checkpoint,
+    # not a tree replay) and after callbacks exist (the early-stopping bests
+    # restore into the live stoppers).
+    start_iteration = init_iteration
+    ckpt_writer = None
+    if resume_from or checkpoint_path:
+        from .resil import checkpoint as ckpt_mod
+
+        if resume_from:
+            ckpt = ckpt_mod.restore(booster, resume_from, cbs_after)
+            init_iteration = ckpt.begin_iteration
+            start_iteration = ckpt.iteration
+            # num_boost_round is a train() ARGUMENT, so restore()'s
+            # config-digest warning cannot catch a mismatched end bound —
+            # check it against the manifest's end_iteration here
+            ckpt_end = int(ckpt.manifest["end_iteration"])
+            live_end = init_iteration + num_boost_round
+            if live_end < start_iteration:
+                raise LightGBMError(
+                    "resume_from: num_boost_round=%d ends the run at "
+                    "iteration %d, BEFORE the checkpoint's position %d — "
+                    "nothing would train and the returned model would carry "
+                    "more iterations than requested; pass the original run's "
+                    "num_boost_round (%d)"
+                    % (num_boost_round, live_end, start_iteration,
+                       ckpt_end - init_iteration)
+                )
+            if live_end != ckpt_end:
+                log.warning(
+                    "resume: num_boost_round=%d ends the run at iteration %d "
+                    "but the checkpointed run ended at %d; the resumed run "
+                    "will NOT be bit-identical to the original"
+                    % (num_boost_round, live_end, ckpt_end)
+                )
+        if checkpoint_path:
+            # refuse unsupported configs (dart) NOW, not at the first cadence
+            # boundary checkpoint_rounds iterations in
+            ckpt_mod.check_checkpointable(booster._gbdt)
+            ckpt_writer = ckpt_mod.CheckpointWriter(
+                checkpoint_path, checkpoint_rounds, cbs_after
+            )
+
     # Device-resident chunked boosting (GBDT.train_chunk): up to
     # device_chunk_size iterations fuse into one jitted dispatch; callbacks,
     # eval and early stopping then observe chunk BOUNDARIES only
@@ -130,6 +208,7 @@ def train(
             booster, params, fobj, feval, valid_sets, is_valid_contain_train,
             train_data_name, init_iteration, num_boost_round,
             cbs_before, cbs_after, chunk,
+            start_iteration=start_iteration, ckpt_writer=ckpt_writer,
         )
     # resolve the deferred no-split check before handing the booster back:
     # a stop inside the FINAL chunk (or final iteration) would otherwise
@@ -152,22 +231,36 @@ def train(
 def _boost_loop(
     booster, params, fobj, feval, valid_sets, is_valid_contain_train,
     train_data_name, init_iteration, num_boost_round, cbs_before, cbs_after,
-    chunk: int = 1,
+    chunk: int = 1, start_iteration: Optional[int] = None, ckpt_writer=None,
 ):
     """The boosting iteration loop; returns the last evaluation result list.
 
     ``chunk > 1`` steps by device-resident chunks (Booster.update_chunk):
     eval and after-iteration callbacks run once per chunk boundary with
     ``iteration`` = the last completed iteration; ``chunk=1`` is the classic
-    per-iteration loop, byte-identical to the pre-chunking behavior."""
+    per-iteration loop, byte-identical to the pre-chunking behavior.
+
+    ``start_iteration`` positions a RESUMED loop past the checkpointed
+    iterations while ``init_iteration`` keeps the original run's begin (so
+    callback windows and the end bound replay identically); ``ckpt_writer``
+    (resil/checkpoint.py) saves the full training state at its cadence
+    boundaries."""
     evaluation_result_list: List = []
     needs_eval = valid_sets is not None or bool(
         params.get("is_provide_training_metric")
     )
-    i = init_iteration
+    i = init_iteration if start_iteration is None else start_iteration
     end = init_iteration + num_boost_round
+    if booster._gbdt._stopped:
+        # a checkpoint taken AT a no-split stop boundary restores
+        # stopped=True: nothing is left to train, and one more loop pass
+        # would re-run eval + callbacks the uninterrupted run never had
+        return evaluation_result_list
     iter_counter = obs_registry.REGISTRY.counter("train_iterations")
     while i < end:
+        # named fault site: the crash tests SIGKILL here mid-run and prove
+        # resume_from replays to a byte-identical model (resil/faults.py)
+        faults.maybe_fire("train.iteration")
         for cb in cbs_before:
             cb(
                 callback_mod.CallbackEnv(
@@ -224,6 +317,23 @@ def _boost_loop(
             booster.best_iteration = es.best_iteration + 1
             evaluation_result_list = es.best_score
             break
+        if ckpt_writer is not None and ckpt_writer.due(i, done):
+            # after the boundary's eval + callbacks, so the early-stopping
+            # bests captured are exactly the ones a resumed run needs next
+            try:
+                ckpt_writer.write(booster, init_iteration, end)
+            except LightGBMError:
+                raise  # structural refusal (e.g. dart): a config error, loud
+            except Exception as e:
+                # a failed write (ENOSPC, NFS blip) must not kill the run it
+                # exists to protect: the last good checkpoint is intact on
+                # disk (atomic publish), so warn and keep training
+                obs_registry.REGISTRY.counter("resil_checkpoint_errors").inc()
+                log.warning(
+                    "checkpoint: write failed (%s: %s); continuing — the "
+                    "last good checkpoint is intact"
+                    % (type(e).__name__, str(e)[:200])
+                )
         if finished:
             break
     return evaluation_result_list

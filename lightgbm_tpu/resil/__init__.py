@@ -1,0 +1,35 @@
+"""Fault-tolerance layer: crash-safe checkpoints, fault injection, backoff.
+
+The ROADMAP north-star is a production system; production systems get
+preempted, SIGKILLed, and wedged. This package is the layer that lets the
+rest of lightgbm_tpu *survive* the failures the obs layer reports:
+
+ * ``resil.atomic``     — temp-file + fsync + rename publication for every
+                          model/checkpoint artifact (the same pattern
+                          native/__init__.py uses for its built .so), so a
+                          crash mid-write can never truncate a published file.
+ * ``resil.checkpoint`` — periodic training checkpoints capturing model text
+                          + device score carries + host RNG position +
+                          deferred-stop and early-stopping state;
+                          ``engine.train(checkpoint_path=...,
+                          resume_from=...)`` resumes BIT-identically
+                          (docs/FaultTolerance.md).
+ * ``resil.faults``     — deterministic, env-gated fault injection
+                          (``LIGHTGBM_TPU_FAULTS=site:occurrence[:action]``)
+                          with named sites in the boost loop, checkpoint
+                          writer, serve dispatch and batcher worker, so every
+                          recovery path is exercised by REAL induced failures
+                          in tests rather than mocks.
+ * ``resil.backoff``    — the one exponential-backoff helper shared by the
+                          serve dispatch retry and the bringup stage retry.
+
+Import discipline: this ``__init__`` pulls in only the jax-free modules
+(``backoff``, ``faults``) so host-side drivers (helpers/tpu_bringup.py) can
+use them without paying a jax import; ``checkpoint`` is imported lazily by
+its callers (engine.py).
+"""
+from __future__ import annotations
+
+from . import backoff, faults  # noqa: F401  (jax-free; see docstring)
+from .atomic import atomic_write_text  # noqa: F401
+from .faults import InjectedFault, maybe_fire  # noqa: F401

@@ -1,0 +1,106 @@
+"""Atomic artifact publication: temp file + fsync + rename.
+
+The same publish pattern native/__init__.py uses for its compiled .so —
+write to a uniquely named temp file next to the target, fsync, then
+``os.replace`` — generalized for every text artifact that must never be
+observed truncated: model files (Booster.save_model), CLI ``output_model``
+writes, and training checkpoints (resil/checkpoint.py). A SIGKILL at ANY
+point leaves either the previous complete file or the new complete file,
+never a prefix; leaked ``.tmp`` files are pid/thread/sequence-tagged (so
+concurrent writers never share one) and ignored by readers.
+
+Remote (fsspec) URIs cannot be renamed atomically through the generic
+interface, so they stream through vopen as before — atomicity is a local-
+filesystem guarantee (object stores get it from their own all-or-nothing
+PUT semantics).
+
+graftlint rule JX010 enforces that model/checkpoint artifact writes inside
+``lightgbm_tpu/`` route through here (docs/StaticAnalysis.md).
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Optional
+
+from ..utils import vfile
+from . import faults
+
+# temp names carry pid + thread id + a process-global sequence number: two
+# threads (or one thread re-entering) publishing the SAME target path must
+# never share a temp file — a shared name would let one writer truncate the
+# other's in-progress bytes and rename interleaved content into place, the
+# exact corruption this module exists to prevent
+_seq = itertools.count()
+
+
+def atomic_write_text(
+    path: str,
+    text: str,
+    fsync: bool = True,
+    fault_site: Optional[str] = None,
+) -> str:
+    """Publish ``text`` at ``path`` atomically; returns ``path``.
+
+    ``fault_site`` names a resil/faults.py site fired BETWEEN the durable
+    temp write and the rename — the exact window where a naive writer would
+    leave a truncated artifact; the crash tests kill there to prove this one
+    cannot.
+    """
+    return atomic_write_bytes(
+        path, text.encode("utf-8"), fsync=fsync, fault_site=fault_site
+    )
+
+
+def atomic_write_bytes(
+    path: str,
+    data: bytes,
+    fsync: bool = True,
+    fault_site: Optional[str] = None,
+) -> str:
+    """Binary twin of :func:`atomic_write_text` (checkpoint archives)."""
+    if vfile.is_remote(path):
+        with vfile.vopen(path, "wb") as fh:
+            fh.write(data)
+        return path
+    tmp = "%s.%d.%x.%d.tmp" % (
+        path, os.getpid(), threading.get_ident(), next(_seq)
+    )
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            fh.flush()
+            if fsync:
+                os.fsync(fh.fileno())
+        if fault_site is not None:
+            faults.maybe_fire(fault_site)
+        os.replace(tmp, path)
+    except BaseException:
+        # a FAILED publish must not leak its temp file; a SIGKILL mid-write
+        # leaks one, which the pid suffix keeps from ever shadowing the real
+        # artifact
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    if fsync:
+        _fsync_dir(os.path.dirname(os.path.abspath(path)))
+    return path
+
+
+def _fsync_dir(dirname: str) -> None:
+    """Durable rename: fsync the directory so the new entry survives a power
+    cut, not just a process kill. Best-effort — not every filesystem allows
+    directory fds."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

@@ -1,0 +1,27 @@
+"""Exponential backoff: the one retry-delay schedule for the whole package.
+
+Both consumers of retries — the serve dispatch retry (serve/server.py) and
+the bringup stage retry (helpers/tpu_bringup.py) — draw their sleeps from
+``delays`` so "how long do we wait after a transient failure" is decided in
+exactly one place; the retry LOOPS themselves stay with their callers (serve
+needs its asymmetric CPU-fallback arm, bringup signals failure through a
+result dict rather than exceptions). Stdlib only (the bringup driver must
+not pay a jax/numpy import for it).
+"""
+from __future__ import annotations
+
+from typing import Iterator
+
+
+def delays(
+    attempts: int,
+    base_s: float = 1.0,
+    factor: float = 2.0,
+    max_s: float = 60.0,
+) -> Iterator[float]:
+    """The sleep (seconds) before each RETRY of an ``attempts``-attempt loop:
+    ``attempts - 1`` values, ``base_s * factor**i`` capped at ``max_s``.
+    Deterministic by design — a jittered delay would make the fault-injection
+    tests (resil/faults.py) timing-dependent."""
+    for i in range(max(attempts - 1, 0)):
+        yield min(base_s * (factor ** i), max_s)

@@ -1,0 +1,392 @@
+"""Crash-safe training checkpoints: periodic atomic save + bit-identical resume.
+
+The reference LightGBM persists periodic model snapshots (``snapshot_freq``,
+gbdt.cpp:254-258) but a snapshot alone cannot CONTINUE a run identically —
+the score carries, the host RNG position and the early-stopping bests are
+gone, so a restart re-trains from the snapshot as a *different* run. This
+module checkpoints the full training state, so that
+
+    engine.train(..., checkpoint_path=p, checkpoint_rounds=N)      # crashes
+    engine.train(..., resume_from=p)                               # resumes
+
+produces a final model string BYTE-identical to the uninterrupted run —
+extending the bitwise discipline tests/test_device_chunk.py established for
+device chunks to process death (tests/test_resil.py kills with SIGKILL at
+injected fault sites and proves it).
+
+One checkpoint file (npz, ``allow_pickle=False``) holds:
+
+  * the model text at the boundary (the same LightGBM-format string
+    ``save_model`` writes — itself a valid model file input);
+  * the device score carries (train ``[K, N]`` f32 + every valid set's);
+  * the host feature-fraction RNG position (``_feat_rng``; the bagging
+    stream is stateless ``fold_in(seed, iteration)`` and needs no capture);
+  * the resolved deferred no-split stop state (``_pending_chunk`` /
+    ``_pending_stop`` are CONSUMED before saving — bit-neutral, the check
+    reads the same device scalars it would have read next iteration);
+  * early-stopping best values/iterations/entries per armed stopper, and the
+    eval history.
+
+Writes go through resil/atomic.py (temp + fsync + rename, fault site
+``checkpoint.write``), so a crash mid-save can never truncate a published
+checkpoint. DART is refused: it re-drops and rescales PAST trees per
+iteration through device arrays a text round-trip cannot reconstruct.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import io
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs import registry as obs_registry
+from ..obs import trace as trace_mod
+from ..utils import log, vfile
+from ..utils.log import LightGBMError
+from .atomic import atomic_write_bytes
+
+CHECKPOINT_VERSION = 1
+FAULT_SITE_WRITE = "checkpoint.write"
+
+
+def _json_scalar(obj):
+    """Manifest values may carry numpy scalars (custom metrics, eval
+    history); coerce them instead of failing the save mid-train."""
+    if isinstance(obj, np.bool_):
+        return bool(obj)
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    raise TypeError(
+        "checkpoint manifest value %r (%s) is not JSON-serializable"
+        % (obj, type(obj).__name__)
+    )
+
+
+def _config_digest(config) -> str:
+    return hashlib.sha1(
+        repr(sorted(config.to_dict().items())).encode("utf-8")
+    ).hexdigest()
+
+
+def _stopper_key(stopper) -> List:
+    return [int(stopper.stopping_rounds), bool(stopper.first_metric_only)]
+
+
+def _valid_idents(gbdt) -> List[List]:
+    """Per-valid-set identity (row count + label digest): the carry arrays
+    are stored positionally, and two same-sized valid sets attached in a
+    different order on resume would silently swap their score carries —
+    every eval and early-stopping decision would then read the other set's
+    scores."""
+    out: List[List] = []
+    for vs in getattr(gbdt, "valid_sets", []):
+        label = getattr(vs.metadata, "label", None)
+        digest = (
+            hashlib.sha1(np.ascontiguousarray(label).tobytes()).hexdigest()[:16]
+            if label is not None else ""
+        )
+        out.append([int(vs.num_data), digest])
+    return out
+
+
+def _stopper_states(cbs_after) -> List[Dict]:
+    """State of every early_stopping() callback, tagged with its config
+    identity: engine.train orders same-``order`` callbacks by set-iteration
+    tiebreak, which is NOT stable across processes, so restore() matches
+    states to live stoppers by identity rather than position."""
+    return [
+        dict(cb.stopper.state_dict(), stopper_key=_stopper_key(cb.stopper))
+        for cb in cbs_after if hasattr(cb, "stopper")
+    ]
+
+
+class CheckpointWriter:
+    """Cadence + serialization for engine._boost_loop's boundary hook."""
+
+    def __init__(self, path: str, rounds: int, cbs_after=None) -> None:
+        if rounds < 1:
+            raise LightGBMError(
+                "checkpoint_rounds must be >= 1, got %d" % rounds
+            )
+        self.path = path
+        self.rounds = rounds
+        self._cbs_after = list(cbs_after or [])
+        self.written = 0
+
+    def due(self, iteration: int, done: int) -> bool:
+        """True when the just-completed window crossed a cadence boundary
+        (chunked boosting advances ``done`` iterations at once)."""
+        step = max(done, 1)
+        return iteration // self.rounds > (iteration - step) // self.rounds
+
+    def write(self, booster, begin_iteration: int, end_iteration: int) -> str:
+        with trace_mod.span("resil.checkpoint", cat="resil",
+                            iteration=booster.current_iteration):
+            out = save_checkpoint(
+                self.path, booster, begin_iteration, end_iteration,
+                self._cbs_after,
+            )
+        self.written += 1
+        return out
+
+
+def check_checkpointable(gbdt) -> None:
+    """Refuse configurations a checkpoint cannot faithfully capture.
+
+    engine.train calls this BEFORE the boost loop starts, so an unsupported
+    run fails at startup instead of training ``checkpoint_rounds`` iterations
+    and dying at the first cadence boundary."""
+    if type(gbdt).__name__ == "DART":
+        raise LightGBMError(
+            "checkpointing is not supported for dart boosting: DART re-drops "
+            "and rescales past trees per iteration (state a model-text round "
+            "trip cannot reconstruct)"
+        )
+
+
+def save_checkpoint(
+    path: str, booster, begin_iteration: int, end_iteration: int,
+    cbs_after=None,
+) -> str:
+    """Capture the full training state at the current boundary; atomic."""
+    gbdt = booster._gbdt
+    check_checkpointable(gbdt)
+    # resolve the deferred no-split check BEFORE capturing: it reads the same
+    # device scalars it would have read at the next iteration, so consuming
+    # here is bit-neutral — and a checkpoint must never hold placeholder
+    # trees a resumed run would have rolled back
+    gbdt._consume_pending_stop()
+    manifest: Dict[str, object] = {
+        "version": CHECKPOINT_VERSION,
+        "iteration": int(booster.current_iteration),
+        "begin_iteration": int(begin_iteration),
+        "end_iteration": int(end_iteration),
+        "stopped": bool(gbdt._stopped),
+        "boosting": type(gbdt).__name__,
+        "num_class": int(gbdt.num_class),
+        "num_tree_per_iteration": int(gbdt.num_tree_per_iteration),
+        "num_data": int(gbdt.num_data),
+        "num_features": int(gbdt.train_set.num_features or 0),
+        # the TRAINED-iteration counter, NOT len(models)//K: continued
+        # training (init_model) prepends the predictor's trees without
+        # advancing iter_, and the bagging stream keys off fold_in(bag_key,
+        # iter_) — recomputing from tree count would shift every remaining
+        # bag draw on resume
+        "iter": int(gbdt.iter_),
+        "num_init_iteration": int(getattr(gbdt, "num_init_iteration", 0)),
+        "config_digest": _config_digest(gbdt.config),
+        "model_text": booster.model_to_string(),
+        "best_iteration": int(booster.best_iteration),
+        "eval_history": gbdt._eval_history,
+        "early_stopping": _stopper_states(cbs_after or []),
+        "n_valid": len(getattr(gbdt, "valid_scores", [])),
+        "valid_ident": _valid_idents(gbdt),
+    }
+    arrays: Dict[str, np.ndarray] = {"scores": np.asarray(gbdt.scores)}
+    for i, vs in enumerate(getattr(gbdt, "valid_scores", [])):
+        arrays["valid_scores_%d" % i] = np.asarray(vs)
+    state = gbdt._feat_rng.get_state()
+    manifest["feat_rng"] = {
+        "algo": str(state[0]), "pos": int(state[2]),
+        "has_gauss": int(state[3]), "cached_gaussian": float(state[4]),
+    }
+    arrays["feat_rng_keys"] = np.asarray(state[1], np.uint32)
+    arrays["manifest"] = np.frombuffer(
+        json.dumps(manifest, default=_json_scalar).encode("utf-8"), np.uint8
+    )
+    bio = io.BytesIO()
+    np.savez(bio, **arrays)
+    atomic_write_bytes(path, bio.getvalue(), fault_site=FAULT_SITE_WRITE)
+    obs_registry.REGISTRY.counter("resil_checkpoints").inc()
+    log.info(
+        "checkpoint: saved iteration %d to %s"
+        % (manifest["iteration"], path)
+    )
+    return path
+
+
+def _load_stopper_states(states: List[Dict], stoppers: List) -> None:
+    """Restore early-stopping bests into the live callbacks, matched by
+    config identity (stopping_rounds, first_metric_only): positional
+    matching would cross-wire the bests whenever two stoppers tie on
+    callback ``order`` (the tiebreak is set-iteration order, different per
+    process). Same-identity stoppers are interchangeable — the same config
+    over the same evals yields the same state."""
+    if not states:
+        return
+    if len(states) != len(stoppers):
+        raise LightGBMError(
+            "checkpoint carried %d early-stopping state(s), the resumed "
+            "setup has %d early_stopping callback(s)"
+            % (len(states), len(stoppers))
+        )
+    remaining = list(states)
+    for stopper in stoppers:
+        key = _stopper_key(stopper)
+        idx = next(
+            (j for j, s in enumerate(remaining)
+             if s.get("stopper_key", key) == key), None,
+        )
+        if idx is None:
+            raise LightGBMError(
+                "checkpoint's early-stopping states do not match the "
+                "resumed setup's early_stopping callbacks "
+                "(stopping_rounds / first_metric_only differ)"
+            )
+        stopper.load_state_dict(remaining.pop(idx))
+
+
+class Checkpoint:
+    """A loaded checkpoint: manifest dict + named arrays."""
+
+    def __init__(self, manifest: Dict, arrays: Dict[str, np.ndarray]) -> None:
+        self.manifest = manifest
+        self.arrays = arrays
+
+    @property
+    def iteration(self) -> int:
+        return int(self.manifest["iteration"])
+
+    @property
+    def begin_iteration(self) -> int:
+        return int(self.manifest["begin_iteration"])
+
+
+def load_checkpoint(path: str) -> Checkpoint:
+    # the writer accepts remote URIs (atomic_write_bytes -> vopen); the
+    # loader must read them back the same way — np.load on the literal URI
+    # string would FileNotFoundError exactly where the write path invited
+    # the user to put the checkpoint
+    if vfile.is_remote(path):
+        with vfile.vopen(path, "rb") as fh:
+            src = io.BytesIO(fh.read())
+    else:
+        src = path
+    with np.load(src, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    raw = arrays.pop("manifest", None)
+    if raw is None:
+        raise LightGBMError("%s is not a lightgbm_tpu checkpoint" % path)
+    manifest = json.loads(bytes(raw.tobytes()).decode("utf-8"))
+    if int(manifest.get("version", -1)) != CHECKPOINT_VERSION:
+        raise LightGBMError(
+            "checkpoint %s has version %s (this build reads %d)"
+            % (path, manifest.get("version"), CHECKPOINT_VERSION)
+        )
+    return Checkpoint(manifest, arrays)
+
+
+def restore(booster, path: str, cbs_after=None) -> Checkpoint:
+    """Graft a checkpoint into a freshly built training booster.
+
+    Call AFTER valid sets are attached and callbacks are built (the stopper
+    states restore into the live early_stopping callbacks) and BEFORE the
+    boost loop starts. Returns the checkpoint so the caller can position the
+    loop (``iteration`` / ``begin_iteration``).
+    """
+    import jax.numpy as jnp
+
+    from ..basic import Booster
+
+    with trace_mod.span("resil.resume", cat="resil"):
+        ckpt = load_checkpoint(path)
+        m = ckpt.manifest
+        gbdt = booster._gbdt
+        if type(gbdt).__name__ != m["boosting"]:
+            raise LightGBMError(
+                "checkpoint was taken with boosting %r, resuming with %r"
+                % (m["boosting"], type(gbdt).__name__)
+            )
+        for key, live in (
+            ("num_class", gbdt.num_class),
+            ("num_tree_per_iteration", gbdt.num_tree_per_iteration),
+            ("num_data", gbdt.num_data),
+            # same row count but a different feature space would graft trees
+            # whose split_feature indices point into the wrong columns —
+            # silent garbage, so it must be as loud as a num_data mismatch
+            ("num_features", gbdt.train_set.num_features or 0),
+        ):
+            if int(m[key]) != int(live):
+                raise LightGBMError(
+                    "checkpoint %s=%s does not match the training setup's %s"
+                    % (key, m[key], live)
+                )
+        if m["config_digest"] != _config_digest(gbdt.config):
+            log.warning(
+                "resume: training parameters differ from the checkpoint's; "
+                "the resumed run will NOT be bit-identical to the original"
+            )
+        n_valid = len(getattr(gbdt, "valid_scores", []))
+        if int(m["n_valid"]) != n_valid:
+            raise LightGBMError(
+                "checkpoint carried %s validation score carries, the resumed "
+                "setup has %d — attach the same valid sets to resume"
+                % (m["n_valid"], n_valid)
+            )
+        idents = m.get("valid_ident")
+        if idents is not None and list(idents) != _valid_idents(gbdt):
+            raise LightGBMError(
+                "the resumed run's valid sets do not match the checkpoint's "
+                "(count, order, rows and labels must all agree) — the score "
+                "carries are positional, so a reordered attach would graft "
+                "each set's scores onto the wrong data"
+            )
+        # trees: round-trip through the standard model-text loader (the
+        # loaded host trees re-serialize byte-identically; models/tree.py
+        # formats with round-trippable precision). The live run's verbosity
+        # rides along so the helper Booster's default Config cannot reset
+        # the global log level mid-train.
+        loaded = Booster(
+            model_str=str(m["model_text"]),
+            params={"verbosity": gbdt.config.verbosity},
+        )
+        K = max(gbdt.num_tree_per_iteration, 1)
+        gbdt.models = loaded._gbdt.models
+        gbdt._device_trees = [(None, i % K) for i in range(len(gbdt.models))]
+        # restore the trained-iteration counter exactly (manifest "iter"):
+        # for an init_model run it is SMALLER than len(models)//K, and the
+        # bagging stream fold_in(bag_key, iter_) must replay from the same
+        # position the original run was at
+        gbdt.iter_ = int(m["iter"])
+        gbdt.num_init_iteration = int(m.get("num_init_iteration", 0))
+        # device carries: exact f32 bits back onto the device
+        gbdt.scores = jnp.asarray(ckpt.arrays["scores"])
+        for i in range(n_valid):
+            gbdt.valid_scores[i] = jnp.asarray(ckpt.arrays["valid_scores_%d" % i])
+        # host RNG stream position (feature_fraction draws)
+        fr = m["feat_rng"]
+        gbdt._feat_rng.set_state((
+            fr["algo"], np.asarray(ckpt.arrays["feat_rng_keys"], np.uint32),
+            int(fr["pos"]), int(fr["has_gauss"]), float(fr["cached_gaussian"]),
+        ))
+        gbdt._stopped = bool(m["stopped"])
+        gbdt._pending_stop = None
+        gbdt._pending_chunk = None
+        gbdt._eval_history = m.get("eval_history") or {}
+        # re-seed record_evaluation() dicts with the pre-crash entries, or
+        # a resumed run's evals_result would silently start at the crash
+        # point while the uninterrupted run's holds the full history
+        for cb in (cbs_after or []):
+            er = getattr(cb, "eval_result", None)
+            if isinstance(er, dict):
+                er.clear()
+                for dname, metrics in gbdt._eval_history.items():
+                    dst = er.setdefault(dname, collections.OrderedDict())
+                    for mname, series in metrics.items():
+                        dst[mname] = list(series)
+        booster.best_iteration = int(m.get("best_iteration", -1))
+        stoppers = [
+            cb.stopper for cb in (cbs_after or []) if hasattr(cb, "stopper")
+        ]
+        _load_stopper_states(list(m.get("early_stopping") or []), stoppers)
+    obs_registry.REGISTRY.counter("resil_resumes").inc()
+    log.info(
+        "resume: restored iteration %d from %s (end %d)"
+        % (ckpt.iteration, path, int(m["end_iteration"]))
+    )
+    return ckpt

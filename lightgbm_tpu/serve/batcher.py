@@ -19,12 +19,15 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Callable, List, Optional
 
 import numpy as np
 
 from ..obs import trace as trace_mod
+from ..resil import faults
+from ..utils import log
+from ..utils.log import LightGBMError
 from .metrics import ServeMetrics
 
 
@@ -46,6 +49,29 @@ class _Request:
 _CLOSE = object()
 
 
+def _try_resolve(fut: Future, value=None, exc: Optional[BaseException] = None) -> bool:
+    """Resolve ``fut`` unless it already is; returns whether this call won.
+    A wedged worker's gathered requests can be force-failed by close() and
+    THEN resolved by the worker if it un-wedges — the loser of that race
+    must be a no-op, not an InvalidStateError that kills the worker loop."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(value)
+        return True
+    except InvalidStateError:
+        return False
+
+
+class BatcherClosed(LightGBMError):
+    """Shutdown-side abandonment: raised to submitters when the batcher is
+    closed (or a wedged worker's pending requests are force-failed). A
+    retryable SERVER condition, not a client fault — the HTTP layer maps it
+    to 503 + Retry-After so clients fail over to another replica instead of
+    dropping the request as a 400."""
+
+
 class MicroBatcher:
     """Queue + worker thread. ``dispatch(key, X)`` does the actual predict."""
 
@@ -62,6 +88,16 @@ class MicroBatcher:
         self.metrics = metrics or ServeMetrics()
         self._q: "queue.Queue" = queue.Queue()
         self.metrics.queue_depth_fn = self._q.qsize
+        self._closed = False
+        # the batch the worker has gathered but not yet fanned out — held on
+        # self so close()'s force-fail can reach requests a wedged dispatch
+        # is sitting on, not just the ones still in the queue (GIL-atomic
+        # list rebind; only the worker writes it)
+        self._inflight_batch: List[_Request] = []
+        # orders submits against close(): without it a submitter could pass
+        # the _closed check, be descheduled, and enqueue AFTER close() put
+        # the sentinel and drained — leaving a future nothing ever resolves
+        self._submit_lock = threading.Lock()
         self._worker = threading.Thread(
             target=self._loop, name="lgbtpu-serve-batcher", daemon=True
         )
@@ -69,16 +105,71 @@ class MicroBatcher:
 
     # -- producer side ----------------------------------------------------
 
+    def queue_depth(self) -> int:
+        """Requests currently waiting (the admission-control signal)."""
+        return self._q.qsize()
+
     def submit(self, key, rows: np.ndarray) -> Future:
         """Enqueue one request; resolve the returned Future with its slice of
         the batched result (row-leading), or the dispatch exception."""
-        req = _Request(key, rows)
-        self._q.put(req)
+        with self._submit_lock:
+            if self._closed:
+                raise BatcherClosed("batcher is closed (server shutting down)")
+            req = _Request(key, rows)
+            self._q.put(req)
         return req.future
 
     def close(self, timeout: float = 5.0) -> None:
-        self._q.put(_CLOSE)
+        """Flush-and-stop: everything queued BEFORE close drains in FIFO
+        order, then the worker exits. The submit lock guarantees the _CLOSE
+        sentinel is the queue's LAST entry, so a clean exit leaves nothing
+        unresolved. If the worker is wedged (hung device call) and misses
+        the join window, pending requests are force-FAILED so their
+        submitters' ``future.result()`` calls return instead of hanging
+        until their full deadlines — a wedged worker must never silently
+        leak in-flight futures."""
+        with self._submit_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(_CLOSE)
         self._worker.join(timeout=timeout)
+        if self._worker.is_alive():
+            failed = self._fail_pending(
+                "batcher worker wedged at shutdown; request abandoned"
+            )
+            log.warning(
+                "serve: batcher worker did not exit within %.1fs; "
+                "force-failed %d pending request(s)" % (timeout, failed)
+            )
+            self.metrics.incr("batcher_wedged")
+
+    def _fail_pending(self, reason: str) -> int:
+        failed = 0
+        saw_close = False
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if req is _CLOSE:
+                saw_close = True
+                continue
+            req.future.set_exception(BatcherClosed(reason))
+            failed += 1
+        # the wedged worker's GATHERED batch too: those requests left the
+        # queue but never fanned out, and their submitters would otherwise
+        # block in future.result() until their full deadlines
+        for req in self._inflight_batch:
+            if _try_resolve(req.future, exc=BatcherClosed(reason)):
+                failed += 1
+        if saw_close:
+            # re-queue the exit sentinel (AFTER the drain, or get_nowait
+            # would pull it right back): a worker that un-wedges later must
+            # still find it and exit, or every wedge permanently leaks the
+            # thread plus whatever its frames capture
+            self._q.put(_CLOSE)
+        return failed
 
     # -- worker side ------------------------------------------------------
 
@@ -118,7 +209,16 @@ class MicroBatcher:
                     break
                 batch.append(nxt)
                 rows += nxt.rows.shape[0]
-            self._dispatch(batch, rows)
+            # the carried next-batch opener rides along in _inflight_batch:
+            # it lives only in this frame's locals, so a dispatch that wedges
+            # here must let close() force-fail it WITH the gathered batch —
+            # and it stays covered through the next gather until it lands in
+            # a batch of its own
+            self._inflight_batch = batch if carry is None else batch + [carry]
+            try:
+                self._dispatch(batch, rows)
+            finally:
+                self._inflight_batch = [] if carry is None else [carry]
             if carry is None:
                 return closing
             first = carry
@@ -135,6 +235,11 @@ class MicroBatcher:
                     )
         t0 = time.perf_counter()
         try:
+            # named fault site (resil/faults.py): a `hang` here simulates the
+            # wedged device call close()'s force-fail path exists for; a
+            # `raise` exercises the fan-out-and-survive path below. INSIDE
+            # the try for the same reason the concat is.
+            faults.maybe_fire("serve.batcher")
             # the concat is INSIDE the try: two same-key requests with
             # mismatched widths must fail their own futures, not kill the
             # (only) worker thread and hang every request after them
@@ -150,7 +255,7 @@ class MicroBatcher:
                 out = self.dispatch(batch[0].key, X)
         except BaseException as e:  # fan the failure out, keep the worker up
             for r in batch:
-                r.future.set_exception(e)
+                _try_resolve(r.future, exc=e)
             self.metrics.incr("batch_errors")
             return
         dt = time.perf_counter() - t0
@@ -163,5 +268,5 @@ class MicroBatcher:
         off = 0
         for r in batch:
             n = r.rows.shape[0]
-            r.future.set_result(out[off : off + n])
+            _try_resolve(r.future, out[off : off + n])
             off += n

@@ -8,7 +8,7 @@ backend; the request path is
     (pad to pow2 rows) -> packed device dispatch -> fan results back out
 
 Endpoints:
-  GET  /healthz       liveness + backend + model readiness
+  GET  /healthz       liveness + backend + model readiness (+ draining)
   GET  /metrics       Prometheus text exposition (serve instruments + the
                       process-wide obs registry: train phases, jit retraces,
                       device memory; docs/Observability.md)
@@ -16,7 +16,14 @@ Endpoints:
   GET  /models    registry listing (fingerprint, version, shape, objective)
   POST /models    {"name": ..., "path": ...} — load or atomically hot-swap
   POST /predict   {"rows": [[...]], "model"?, "raw_score"?, "pred_leaf"?,
-                   "fused"?} -> {"predictions": ...}
+                   "fused"?, "deadline_ms"?} -> {"predictions": ...};
+                   503 + Retry-After when shed, 504 past the deadline
+
+Resilience (docs/FaultTolerance.md): per-request deadlines (default
+``default_deadline_s``, overridable per request), queue-depth admission
+control that sheds with 503 BEFORE enqueueing work, dispatch
+retry-once-then-CPU-fallback on device failure, and a graceful drain
+(``ServeApp.drain``) the SIGTERM handler in serve/__main__.py drives.
 
 Hot swap is atomic by construction: a swap builds the complete ServedModel
 (parse, pack, dispatchers) OFF the registry lock, then replaces the dict
@@ -27,9 +34,13 @@ JAX to CPU and keeps serving — same code path, slower dispatch.
 """
 from __future__ import annotations
 
+import contextlib
 import json
+import math
 import threading
 import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
@@ -39,15 +50,57 @@ from ..models.model_text import model_fingerprint, peek_model_header
 from ..obs import registry as obs_registry
 from ..obs import retrace as retrace_mod
 from ..obs import trace as trace_mod
+from ..resil import backoff, faults
 from ..utils import log
 from ..utils.log import LightGBMError
 from ..utils.vfile import vopen
-from .batcher import MicroBatcher
+from .batcher import BatcherClosed, MicroBatcher
 from .cache import BucketedDispatcher
 from .metrics import ServeMetrics
 from .packed import PackedEnsemble
 
-PREDICT_TIMEOUT_S = 120.0
+#: default per-request deadline; every request may override it with a
+#: ``deadline_ms`` body field (the old single global PREDICT_TIMEOUT_S)
+DEFAULT_DEADLINE_S = 120.0
+#: default queued-request cap for admission control (0 disables shedding)
+DEFAULT_MAX_QUEUE_DEPTH = 1024
+#: Retry-After seconds a shed response advertises
+SHED_RETRY_AFTER_S = 1
+
+
+def _check_deadline(deadline: float) -> float:
+    """A usable deadline is finite, positive, and within what
+    ``Future.result(timeout=...)`` accepts — anything past
+    ``threading.TIMEOUT_MAX`` (~292 years) raises OverflowError inside
+    threading, turning a malformed deadline into a 500."""
+    if not (math.isfinite(deadline)
+            and 0 < deadline <= threading.TIMEOUT_MAX):
+        raise LightGBMError(
+            "deadline must be a positive number of seconds <= %g, got %r"
+            % (threading.TIMEOUT_MAX, deadline)
+        )
+    return deadline
+
+
+class ServeOverloaded(Exception):
+    """Request rejected BEFORE any work was enqueued (queue saturated, or
+    the server is draining); the HTTP layer maps it to 503 + Retry-After.
+    ``reason`` is a stable token ("queue_full" / "draining") clients and
+    metric labels key off; ``detail`` is the human sentence."""
+
+    def __init__(self, reason: str, detail: str = "",
+                 retry_after_s: int = SHED_RETRY_AFTER_S):
+        super().__init__(
+            "server overloaded: %s" % (detail or reason)
+        )
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline elapsed before its result arrived; mapped to
+    HTTP 504. The batched work itself is abandoned, not cancelled — a
+    same-key neighbor in the batch still gets its answer."""
 
 
 def ensure_backend() -> str:
@@ -264,6 +317,8 @@ class ServeApp:
         max_delay_ms: float = 2.0,
         min_bucket_rows: int = 16,
         warmup_rows: int = 0,
+        default_deadline_s: float = DEFAULT_DEADLINE_S,
+        max_queue_depth: int = DEFAULT_MAX_QUEUE_DEPTH,
     ) -> None:
         if mode not in ("exact", "fused"):
             raise LightGBMError("serve mode must be 'exact' or 'fused'")
@@ -271,6 +326,10 @@ class ServeApp:
         self.backend = ensure_backend()
         self.metrics = ServeMetrics()
         self.registry = ModelRegistry(min_bucket_rows, warmup_rows)
+        # fail at startup, not per-request: a bad --deadline-s would
+        # otherwise surface as a 400 on every single /predict
+        self.default_deadline_s = _check_deadline(float(default_deadline_s))
+        self.max_queue_depth = int(max_queue_depth)
         self.batcher = (
             MicroBatcher(
                 self._dispatch,
@@ -282,6 +341,20 @@ class ServeApp:
             else None
         )
         self.started_at = time.time()
+        # dead-device fallback: models re-packed on CPU, keyed by content
+        # hash so a hot-swapped successor never serves a stale rebuild
+        self._cpu_models: Dict[str, ServedModel] = {}
+        self._cpu_rebuild_lock = threading.Lock()
+        # drain/shed state: _state_lock orders the draining flag against the
+        # in-flight count so drain() can never observe a transient zero while
+        # a request is between admission and registration
+        self._state_lock = threading.Lock()
+        # marks handler threads whose whole request track_request already
+        # counts, so predict()'s own accounting doesn't count them twice
+        self._tracked_thread = threading.local()
+        self._idle = threading.Condition(self._state_lock)
+        self._inflight = 0
+        self.draining = False
 
     def _kind(self, raw_score: bool, pred_leaf: bool, fused: Optional[bool]) -> str:
         if pred_leaf:
@@ -291,9 +364,139 @@ class ServeApp:
             return "fused_raw" if raw_score else "fused"
         return "raw" if raw_score else "value"
 
-    def _dispatch(self, key: Tuple[ServedModel, str], X: np.ndarray) -> np.ndarray:
-        model, kind = key
+    def _run_model(self, model: ServedModel, kind: str, X: np.ndarray) -> np.ndarray:
+        faults.maybe_fire("serve.dispatch")  # named site (resil/faults.py)
         return model.run(kind, X)
+
+    def _run_model_cpu(self, model: ServedModel, kind: str, X: np.ndarray) -> np.ndarray:
+        """Best-effort CPU re-dispatch after repeated device failure: the
+        same packed-model code path pinned to a CPU device (slower, still
+        exact). On a CPU-backed server this is simply a third attempt."""
+        import jax
+
+        cpu = jax.devices("cpu")[0]
+        try:
+            with jax.default_device(cpu):
+                return model.run(kind, X)
+        except Exception:
+            # a HARD device failure strands the packed tensors on the dead
+            # accelerator — default_device only moves the computation, so
+            # model.run would first have to copy them off the device that
+            # just died. Rebuild the model on CPU from its source text
+            # (cached per content hash) and serve from that.
+            rebuilt = self._cpu_rebuild(model)
+            with jax.default_device(cpu):
+                return rebuilt.run(kind, X)
+
+    def _cpu_rebuild(self, model: ServedModel) -> ServedModel:
+        """Re-pack ``model`` with every tensor born on a CPU device."""
+        import jax
+
+        from ..basic import Booster
+
+        with self._cpu_rebuild_lock:
+            cached = self._cpu_models.get(model.file_sha)
+            if cached is not None:
+                return cached
+            # evict rebuilds whose content hash no longer backs any served
+            # model (hot swaps would otherwise grow this by one packed
+            # ensemble per swap, forever) — BEFORE inserting, so the entry
+            # being built survives for its own in-flight request even if
+            # the model was swapped out mid-request
+            live = {str(i["file_sha"]) for i in self.registry.list()}
+            for sha in [s for s in self._cpu_models if s not in live]:
+                del self._cpu_models[sha]
+            log.warn_once(
+                "serve-cpu-rebuild-" + model.file_sha[:12],
+                "serve: rebuilding model %r on CPU (packed tensors "
+                "unreachable on the failed device)" % model.name,
+            )
+            with jax.default_device(jax.devices("cpu")[0]):
+                with vopen(model.path) as fh:
+                    text = fh.read()
+                # the file may have been rewritten since this ServedModel
+                # loaded it (e.g. ahead of a hot swap): serving those bytes
+                # under the OLD fingerprint/version — and caching that
+                # pairing — would misreport what produced every prediction
+                if model_fingerprint(text) != model.file_sha:
+                    # RuntimeError (-> 500), not LightGBMError (-> 400):
+                    # the requester cannot fix an operator-side stale file
+                    raise RuntimeError(
+                        "cpu fallback: %r changed on disk since model %r "
+                        "version %d was loaded; re-POST /models to serve "
+                        "the new contents"
+                        % (model.path, model.name, model.version)
+                    )
+                served = ServedModel(
+                    model.name, model.path, Booster(model_str=text).to_packed(),
+                    model.file_sha, model.version,
+                    self.registry.min_bucket_rows,
+                )
+            self._cpu_models[model.file_sha] = served
+            return served
+
+    def _dispatch(self, key: Tuple[ServedModel, str], X: np.ndarray) -> np.ndarray:
+        """Device dispatch with retry-once-then-CPU-fallback. Client faults
+        (LightGBMError/ValueError/TypeError: bad width, malformed rows)
+        propagate untouched — retrying a 400 would only burn device time."""
+        model, kind = key
+        try:
+            return self._run_model(model, kind, X)
+        except (LightGBMError, ValueError, TypeError):
+            raise
+        except Exception as e:
+            self.metrics.incr("serve_dispatch_retries")
+            log.warning(
+                "serve: dispatch failed (%s: %s); retrying once"
+                % (type(e).__name__, str(e)[:200])
+            )
+            time.sleep(next(backoff.delays(2, base_s=0.05)))
+            try:
+                return self._run_model(model, kind, X)
+            except (LightGBMError, ValueError, TypeError):
+                raise
+            except Exception as e2:
+                self.metrics.incr("serve_cpu_fallback")
+                log.warn_once(
+                    "serve-dispatch-cpu-fallback",
+                    "serve: dispatch failed twice (%s: %s); falling back to "
+                    "CPU re-dispatch" % (type(e2).__name__, str(e2)[:200]),
+                )
+                with trace_mod.span("serve.cpu_fallback", cat="serve",
+                                    rows=int(X.shape[0])):
+                    return self._run_model_cpu(model, kind, X)
+
+    def _admit(self) -> bool:
+        """Admission control, called BEFORE any work is enqueued: a draining
+        server and a saturated queue both shed with 503 + Retry-After, so
+        overload pushes back at the door instead of growing the queue past
+        any deadline's reach. Returns whether THIS call took an in-flight
+        slot: inside track_request (the HTTP path) the handler already holds
+        one for the whole request, and counting again would double the
+        drain report's stranded-request number."""
+        with self._state_lock:
+            if self.draining:
+                self.metrics.registry.counter("serve_shed").inc(
+                    reason="draining"
+                )
+                raise ServeOverloaded("draining")
+            if (
+                self.batcher is not None
+                and self.max_queue_depth > 0
+                and self.batcher.queue_depth() >= self.max_queue_depth
+            ):
+                self.metrics.registry.counter("serve_shed").inc(
+                    reason="queue_full"
+                )
+                raise ServeOverloaded(
+                    "queue_full",
+                    "queue depth %d at limit %d"
+                    % (self.batcher.queue_depth(), self.max_queue_depth),
+                )
+            if getattr(self._tracked_thread, "active", False):
+                return False
+            self._inflight += 1
+            return True
 
     def predict(
         self,
@@ -302,24 +505,61 @@ class ServeApp:
         raw_score: bool = False,
         pred_leaf: bool = False,
         fused: Optional[bool] = None,
+        deadline_s: Optional[float] = None,
     ) -> Tuple[np.ndarray, ServedModel]:
         served = self.registry.get(model)
         kind = self._kind(raw_score, pred_leaf, fused)
         key = (served, kind)
+        deadline = self.default_deadline_s if deadline_s is None else float(deadline_s)
+        if deadline_s is not None:
+            # JSON happily carries 1e309 (parsed as inf), negatives, or huge
+            # finite values past threading.TIMEOUT_MAX; fut.result() raises
+            # OverflowError deep in threading on any of them — reject bad
+            # deadlines as the client fault they are (HTTP 400)
+            _check_deadline(deadline)
+        counted = self._admit()
         t0 = time.perf_counter()  # interval clock: immune to NTP steps
-        # the request-lifecycle root span: queue wait + batch gather +
-        # dispatch + reply all nest inside (or alongside, for the worker
-        # thread's events) this one — obs/trace.py
-        with trace_mod.span(
-            "serve.request", cat="serve", model=served.name, kind=kind,
-            rows=int(X.shape[0]),
-        ):
-            if self.batcher is not None:
-                out = self.batcher.submit(key, X).result(
-                    timeout=PREDICT_TIMEOUT_S
-                )
-            else:
-                out = self._dispatch(key, X)
+        try:
+            # the request-lifecycle root span: queue wait + batch gather +
+            # dispatch + reply all nest inside (or alongside, for the worker
+            # thread's events) this one — obs/trace.py
+            with trace_mod.span(
+                "serve.request", cat="serve", model=served.name, kind=kind,
+                rows=int(X.shape[0]),
+            ):
+                if self.batcher is not None:
+                    fut = self.batcher.submit(key, X)
+                else:
+                    # no-batch mode still honors the deadline: run the direct
+                    # dispatch on its own thread so result(timeout=) can 504
+                    # a hung device call instead of blocking forever (the
+                    # dispatch is abandoned, not cancelled — same contract
+                    # as the batcher path)
+                    fut = Future()
+
+                    def _direct(f=fut, k=key, rows=X):
+                        try:
+                            f.set_result(self._dispatch(k, rows))
+                        except BaseException as e:
+                            f.set_exception(e)
+
+                    threading.Thread(
+                        target=_direct, name="lgbtpu-serve-direct",
+                        daemon=True,
+                    ).start()
+                try:
+                    out = fut.result(timeout=deadline)
+                except FuturesTimeout:
+                    self.metrics.incr("serve_deadline_exceeded")
+                    raise DeadlineExceeded(
+                        "request exceeded its %.3fs deadline" % deadline
+                    )
+        finally:
+            if counted:
+                with self._state_lock:
+                    self._inflight -= 1
+                    if self._inflight == 0:
+                        self._idle.notify_all()
         # request accounting lives HERE, not in the HTTP handler, so direct
         # drivers (tests, obs smoke, embedding hosts) meter identically
         m = self.metrics
@@ -367,6 +607,54 @@ class ServeApp:
             + obs_registry.REGISTRY.prometheus_text()
         )
 
+    @contextlib.contextmanager
+    def track_request(self):
+        """Hold the in-flight count across an ENTIRE request, response write
+        included. The HTTP handler wraps do_POST in this: predict()'s own
+        accounting releases when the result is computed, but the drain must
+        also wait out the handler thread's JSON serialization + socket write
+        (daemon threads die at process exit — an un-tracked write window
+        would let exit cut off the last responses)."""
+        self._tracked_thread.active = True
+        with self._state_lock:
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            self._tracked_thread.active = False
+            with self._state_lock:
+                self._inflight -= 1
+                if self._inflight == 0:
+                    self._idle.notify_all()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful shutdown: stop admitting, wait for in-flight requests,
+        flush the batcher. Returns True when every in-flight request
+        completed within ``timeout_s`` (the SIGTERM handler in
+        serve/__main__.py exits 0 either way — a drain timeout is logged and
+        pending futures are force-failed by the batcher close).
+        """
+        with trace_mod.span("serve.drain", cat="serve"):
+            deadline = time.perf_counter() + timeout_s
+            with self._idle:
+                self.draining = True
+                while self._inflight > 0:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        break
+                    self._idle.wait(remaining)
+                stranded = self._inflight  # read under the lock: the count
+                clean = stranded == 0      # the warning reports must be the
+                                           # one the timeout decision saw
+            if not clean:
+                log.warning(
+                    "serve: drain timed out after %.1fs with %d request(s) "
+                    "in flight" % (timeout_s, stranded)
+                )
+            self.close()
+        self.metrics.registry.counter("serve_drains").inc()
+        return clean
+
     def close(self) -> None:
         if self.batcher is not None:
             self.batcher.close()
@@ -393,6 +681,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _retryable_503(self, error: str, reason: str, retry_after_s: int) -> None:
+        raw = json.dumps({"error": error, "reason": reason}).encode("utf-8")
+        self.send_response(503)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", str(retry_after_s))
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def _body(self) -> Dict:
         length = int(self.headers.get("Content-Length") or 0)
         if length <= 0:
@@ -412,11 +709,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(
                 200,
                 {
-                    "status": "ok",
+                    "status": "draining" if app.draining else "ok",
                     "backend": app.backend,
                     "mode": app.mode,
                     "batching": app.batcher is not None,
-                    "ready": len(app.registry) > 0,
+                    "ready": len(app.registry) > 0 and not app.draining,
                     "models": [str(i["name"]) for i in app.registry.list()],
                     "uptime_s": round(time.time() - app.started_at, 1),
                 },
@@ -437,6 +734,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": "unknown path %s" % path})
 
     def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        # the whole request — response write included — counts as in-flight,
+        # so a SIGTERM drain waits for the bytes to reach the socket
+        with self.app.track_request():
+            self._do_POST()
+
+    def _do_POST(self) -> None:
         app = self.app
         path = self.path.split("?", 1)[0]
         try:
@@ -449,12 +752,18 @@ class _Handler(BaseHTTPRequestHandler):
                 X = np.asarray(rows, np.float64)
                 if X.ndim == 1:
                     X = X[None, :]
+                deadline_ms = body.get("deadline_ms")
                 out, served = app.predict(
                     X,
                     model=body.get("model"),
                     raw_score=bool(body.get("raw_score", False)),
                     pred_leaf=bool(body.get("pred_leaf", False)),
                     fused=body.get("fused"),
+                    deadline_s=(
+                        float(deadline_ms) / 1e3
+                        if deadline_ms is not None
+                        else None
+                    ),
                 )
                 # request counters + latency are recorded by app.predict
                 self._json(
@@ -478,6 +787,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(200, {"loaded": served.info()})
             else:
                 self._json(404, {"error": "unknown path %s" % path})
+        except ServeOverloaded as e:
+            # shed BEFORE enqueueing work: 503 + Retry-After is the
+            # backpressure contract clients key their retry loops off
+            # (counted as serve_shed_total in app.predict's admission)
+            self._retryable_503(str(e), e.reason, e.retry_after_s)
+        except BatcherClosed as e:
+            # server-side shutdown abandonment (wedged-worker force-fail or
+            # a submit racing the close): retryable, so 503 — a 400 would
+            # tell fail-over-capable clients to drop the request for good
+            app.metrics.incr("errors")
+            self._retryable_503(str(e), "shutting_down", SHED_RETRY_AFTER_S)
+        except DeadlineExceeded as e:
+            app.metrics.incr("errors")
+            self._json(504, {"error": str(e)})
         except (LightGBMError, ValueError, TypeError, OSError) as e:
             # TypeError covers np.asarray on malformed rows (e.g. JSON null
             # in a row) — a client fault, not a server one

@@ -1,0 +1,33 @@
+"""JX010 true positives: direct write-mode opens of model/checkpoint
+artifacts that should route through resil/atomic.py."""
+
+
+def save_model(model_path, text):
+    # the path expression names a model artifact
+    with open(model_path, "w") as fh:
+        fh.write(text)
+
+
+def persist_state(path, payload):
+    # enclosing name is neutral, but the path string names a checkpoint
+    with open(path + ".checkpoint", "wb") as fh:
+        fh.write(payload)
+
+
+def write_snapshot(path, text):
+    # the enclosing function names the artifact; vopen counts like open
+    fh = vopen(path, mode="w")
+    fh.write(text)
+    fh.close()
+
+
+def create_model(model_path, text):
+    # exclusive create publishes at the final name just like "w"
+    with open(model_path, "x") as fh:
+        fh.write(text)
+
+
+def emit_model(model_path, text):
+    # keyword-only call shape: the path rides in file=, the mode in mode=
+    with open(file=model_path, mode="w") as fh:
+        fh.write(text)

@@ -1,0 +1,25 @@
+"""JX010 true negatives: atomic-publisher writes, read-mode opens, and
+non-artifact outputs."""
+from lightgbm_tpu.resil.atomic import atomic_write_text
+
+
+def save_model(model_path, text):
+    # artifact write through the atomic publisher: the whole point
+    atomic_write_text(model_path, text)
+
+
+def write_predictions(output_result, rows):
+    # prediction output: rewritable from source, not a trusted artifact
+    with open(output_result, "w") as fh:
+        fh.write(rows)
+
+
+def load_model(model_path):
+    # read mode never truncates
+    with open(model_path) as fh:
+        return fh.read()
+
+
+def read_checkpoint(path):
+    with open(path + ".checkpoint", "rb") as fh:
+        return fh.read()

@@ -28,16 +28,19 @@ from tools.graftlint.engine import compare_to_baseline  # noqa: E402
 
 LINT_DIR = os.path.join(REPO, "tests", "golden", "lint")
 ALL_RULES = ("JX001", "JX002", "JX003", "JX004",
-             "JX005", "JX006", "JX007", "JX008", "JX009")
+             "JX005", "JX006", "JX007", "JX008", "JX009", "JX010")
 
 
 def _fixture(rule_id, kind):
-    """Fixture path for a rule: directory-scoped rules (JX009) keep their
-    fixtures under golden/lint/ops/ so the scope gate sees an ops/ path
-    segment; everything else lives flat in golden/lint/."""
+    """Fixture path for a rule: directory-scoped rules (JX009, JX010) keep
+    their fixtures under golden/lint/<scope-dir>/ so the scope gate sees the
+    required path segment; everything else lives flat in golden/lint/."""
     name = "%s_%s.py" % (rule_id.lower(), kind)
-    scoped = os.path.join(LINT_DIR, "ops", name)
-    return scoped if os.path.exists(scoped) else os.path.join(LINT_DIR, name)
+    for scope in ("ops", "lightgbm_tpu"):
+        scoped = os.path.join(LINT_DIR, scope, name)
+        if os.path.exists(scoped):
+            return scoped
+    return os.path.join(LINT_DIR, name)
 
 
 def _lint(path, rule_id):
@@ -120,6 +123,35 @@ def test_jx009_counts():
     assert len(findings) == 3
     msgs = " ".join(f.message for f in findings)
     assert "perf_counter" in msgs and "print()" in msgs
+
+
+def test_jx010_counts_and_scope(tmp_path):
+    """Five artifact-write findings in the bad fixture (plain "w"/"wb",
+    vopen, exclusive-create "x", keyword-only file=/mode=); the same file is
+    CLEAN outside a lightgbm_tpu/ directory (helpers and tests legitimately
+    write model files directly, e.g. golden-fixture generators)."""
+    findings = _lint(_fixture("JX010", "bad"), "JX010")
+    assert len(findings) == 5
+    assert all("atomic" in f.message for f in findings)
+    src = open(_fixture("JX010", "bad")).read()
+    outside = tmp_path / "helpers"
+    outside.mkdir()
+    (outside / "gen.py").write_text(src)
+    assert run_lint([str(outside / "gen.py")], root=str(tmp_path),
+                    select=["JX010"]) == []
+
+
+def test_jx010_atomic_writer_module_exempt(tmp_path):
+    """The publisher's own temp-file open must not flag itself."""
+    pkg = tmp_path / "lightgbm_tpu" / "resil"
+    pkg.mkdir(parents=True)
+    (pkg / "atomic.py").write_text(
+        "def atomic_write_text(path, text):\n"
+        "    with open(path + '.tmp', 'w') as fh:  # model_path upstream\n"
+        "        fh.write(text)\n"
+    )
+    assert run_lint([str(pkg / "atomic.py")], root=str(tmp_path),
+                    select=["JX010"]) == []
 
 
 def test_jx007_axis_index_first_positional(tmp_path):

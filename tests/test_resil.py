@@ -1,0 +1,541 @@
+"""Fault-tolerance layer tests (lightgbm_tpu/resil/): atomic publication,
+deterministic fault injection, backoff, and crash-safe checkpoint/resume —
+including subprocess SIGKILL-at-fault-site crashes whose resumed runs must
+produce model strings BYTE-identical to the uninterrupted run
+(docs/FaultTolerance.md).
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine
+from lightgbm_tpu.resil import atomic, backoff, faults
+from lightgbm_tpu.resil.faults import ENV_FAULTS, FaultPlanError, InjectedFault
+from lightgbm_tpu.utils.log import LightGBMError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(ENV_FAULTS, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# backoff
+# ---------------------------------------------------------------------------
+def test_backoff_delays_schedule():
+    assert list(backoff.delays(4, base_s=1.0, factor=2.0, max_s=3.0)) == [
+        1.0, 2.0, 3.0,
+    ]
+    assert list(backoff.delays(1)) == []
+
+
+# ---------------------------------------------------------------------------
+# fault injection
+# ---------------------------------------------------------------------------
+def test_faults_fire_at_exact_occurrence(monkeypatch):
+    monkeypatch.setenv(ENV_FAULTS, "mysite:3")
+    faults.reset()
+    faults.maybe_fire("mysite")
+    faults.maybe_fire("mysite")
+    faults.maybe_fire("othersite")  # independent counter
+    with pytest.raises(InjectedFault):
+        faults.maybe_fire("mysite")
+    faults.maybe_fire("mysite")  # occurrence 4: plan exhausted, no fire
+    assert faults.fire_count("mysite") == 4
+
+
+def test_faults_multiple_specs_one_site(monkeypatch):
+    monkeypatch.setenv(ENV_FAULTS, "s:1,s:2")
+    faults.reset()
+    with pytest.raises(InjectedFault):
+        faults.maybe_fire("s")
+    with pytest.raises(InjectedFault):
+        faults.maybe_fire("s")
+    faults.maybe_fire("s")
+
+
+def test_faults_malformed_spec_is_loud(monkeypatch):
+    monkeypatch.setenv(ENV_FAULTS, "siteonly")
+    faults.reset()
+    with pytest.raises(FaultPlanError):
+        faults.maybe_fire("siteonly")
+    monkeypatch.setenv(ENV_FAULTS, "s:1:explode")
+    faults.reset()
+    with pytest.raises(FaultPlanError):
+        faults.maybe_fire("s")
+
+
+def test_faults_disabled_is_silent():
+    for _ in range(3):
+        faults.maybe_fire("anything")
+    # counters aren't even kept on the disabled path
+    assert faults.fire_count("anything") == 0
+
+
+def test_faults_rearming_identical_plan_fires_again(monkeypatch):
+    # disarm/re-arm the SAME spec string: the first disabled maybe_fire must
+    # forget the stale occurrence counters, or the exact-match occ == n
+    # comparison would silently never fire the re-armed plan
+    monkeypatch.setenv(ENV_FAULTS, "rearm:1")
+    faults.reset()
+    with pytest.raises(InjectedFault):
+        faults.maybe_fire("rearm")
+    monkeypatch.delenv(ENV_FAULTS)
+    faults.maybe_fire("rearm")  # disabled: silent, clears cached state
+    monkeypatch.setenv(ENV_FAULTS, "rearm:1")
+    with pytest.raises(InjectedFault):
+        faults.maybe_fire("rearm")
+
+
+# ---------------------------------------------------------------------------
+# atomic publication
+# ---------------------------------------------------------------------------
+def test_atomic_write_publishes_and_cleans_tmp(tmp_path):
+    p = str(tmp_path / "artifact.txt")
+    atomic.atomic_write_text(p, "v1")
+    assert open(p).read() == "v1"
+    atomic.atomic_write_text(p, "v2")
+    assert open(p).read() == "v2"
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+def test_atomic_write_crash_window_keeps_old_file(tmp_path, monkeypatch):
+    """A failure between temp write and rename (the window a naive writer
+    truncates in) leaves the previously published content untouched."""
+    p = str(tmp_path / "model.txt")
+    atomic.atomic_write_text(p, "old complete content")
+    monkeypatch.setenv(ENV_FAULTS, "checkpoint.write:1")
+    faults.reset()
+    with pytest.raises(InjectedFault):
+        atomic.atomic_write_text(p, "new content", fault_site="checkpoint.write")
+    assert open(p).read() == "old complete content"
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+def test_atomic_write_concurrent_same_path(tmp_path):
+    """Concurrent publishers of the SAME target never share a temp file:
+    the published file is always ONE writer's complete content, never an
+    interleaving, and no writer dies on a vanished temp."""
+    import threading
+
+    p = str(tmp_path / "model.txt")
+    contents = ["writer-%d|" % i + "x" * 4096 for i in range(8)]
+    errors = []
+
+    def _publish(text):
+        try:
+            atomic.atomic_write_text(p, text)
+        except BaseException as e:  # noqa: BLE001 - recorded and asserted
+            errors.append(e)
+
+    threads = [threading.Thread(target=_publish, args=(c,)) for c in contents]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    assert open(p).read() in contents
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+def test_save_model_routes_through_atomic(tmp_path, rng):
+    X = rng.randn(120, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), 2,
+    )
+    p = str(tmp_path / "m.txt")
+    bst.save_model(p)
+    assert open(p).read() == bst.model_to_string()
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume — in-process bit-identity
+# ---------------------------------------------------------------------------
+def _binary_data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(400, 6)
+    y = (X[:, 0] + 0.3 * rng.randn(400) > 0).astype(float)
+    Xv = rng.randn(150, 6)
+    yv = (Xv[:, 0] > 0).astype(float)
+    return X, y, Xv, yv
+
+
+BIN_PARAMS = {
+    "objective": "binary", "num_leaves": 15, "verbosity": -1,
+    "feature_fraction": 0.7, "bagging_fraction": 0.8, "bagging_freq": 1,
+}
+
+
+def _train_binary(rounds=10, **train_kw):
+    X, y, Xv, yv = _binary_data()
+    ds = lgb.Dataset(X, label=y)
+    vs = lgb.Dataset(Xv, label=yv, reference=ds)
+    return engine.train(
+        dict(BIN_PARAMS), ds, rounds, valid_sets=[vs], verbose_eval=False,
+        early_stopping_rounds=6, **train_kw,
+    )
+
+
+def test_checkpoint_resume_bit_identical_binary(tmp_path):
+    ck = str(tmp_path / "run.ckpt")
+    ref = _train_binary().model_to_string()
+    with_ckpt = _train_binary(checkpoint_path=ck, checkpoint_rounds=4)
+    # checkpointing itself must not perturb the run
+    assert with_ckpt.model_to_string() == ref
+    resumed = _train_binary(resume_from=ck)
+    assert resumed.model_to_string() == ref
+    # the counters the obs layer exposes (acceptance: visible in /metrics)
+    from lightgbm_tpu.obs.registry import REGISTRY
+
+    text = REGISTRY.prometheus_text()
+    assert "lgbtpu_resil_checkpoints_total" in text
+    assert "lgbtpu_resil_resumes_total" in text
+
+
+def test_resume_repopulates_evals_result(tmp_path):
+    # record_evaluation dicts must carry the pre-crash history after a
+    # resume, not silently start at the crash point
+    ck = str(tmp_path / "er.ckpt")
+    full = {}
+    _train_binary(evals_result=full)
+    _train_binary(checkpoint_path=ck, checkpoint_rounds=4)
+    resumed = {}
+    _train_binary(resume_from=ck, evals_result=resumed)
+    assert resumed == full
+
+
+def test_checkpoint_resume_bit_identical_with_init_model(tmp_path):
+    # continued training prepends the init model's trees WITHOUT advancing
+    # iter_ — the bagging stream keys off fold_in(bag_key, iter_), so a
+    # resume that recomputed iter_ from tree count would silently shift
+    # every remaining bag draw
+    X, y, _, _ = _binary_data()
+
+    def ds():
+        return lgb.Dataset(X, label=y)
+
+    base = engine.train(dict(BIN_PARAMS), ds(), 3, verbose_eval=False)
+    ck = str(tmp_path / "cont.ckpt")
+
+    def cont(**kw):
+        return engine.train(
+            dict(BIN_PARAMS), ds(), 8, init_model=base, verbose_eval=False,
+            **kw,
+        )
+
+    ref = cont().model_to_string()
+    assert cont(checkpoint_path=ck, checkpoint_rounds=3).model_to_string() == ref
+    resumed = engine.train(
+        dict(BIN_PARAMS), ds(), 8, resume_from=ck, verbose_eval=False
+    )
+    assert resumed.model_to_string() == ref
+
+
+def test_checkpoint_write_failure_does_not_kill_training(tmp_path, monkeypatch):
+    # ENOSPC/NFS blips at a cadence boundary must warn and continue — the
+    # run a checkpoint protects must never die because the checkpoint did
+    monkeypatch.setenv(ENV_FAULTS, "checkpoint.write:1")
+    faults.reset()
+    ck = str(tmp_path / "w.ckpt")
+    ref = _train_binary().model_to_string()
+    got = _train_binary(checkpoint_path=ck, checkpoint_rounds=4)
+    assert got.model_to_string() == ref  # run completed despite the failure
+    from lightgbm_tpu.obs.registry import REGISTRY
+
+    assert REGISTRY.counter("resil_checkpoint_errors").value() >= 1
+    # the NEXT cadence boundary still published a good checkpoint
+    resumed = _train_binary(resume_from=ck)
+    assert resumed.model_to_string() == ref
+
+
+def test_resume_keeps_checkpointing_to_same_path(tmp_path, monkeypatch):
+    # resume_from without an explicit checkpoint_path keeps writing to the
+    # file it resumed from — a second preemption must not throw away all
+    # post-resume progress
+    from lightgbm_tpu.resil import checkpoint as ckpt_mod
+
+    ck = str(tmp_path / "keep.ckpt")
+    monkeypatch.setenv(ENV_FAULTS, "train.iteration:6")
+    faults.reset()
+    with pytest.raises(Exception):
+        _train_binary(checkpoint_path=ck, checkpoint_rounds=2)
+    monkeypatch.delenv(ENV_FAULTS)
+    faults.reset()
+    before = ckpt_mod.load_checkpoint(ck).iteration
+    ref = _train_binary().model_to_string()
+    resumed = _train_binary(resume_from=ck)  # no checkpoint_path given
+    assert resumed.model_to_string() == ref
+    after = ckpt_mod.load_checkpoint(ck).iteration
+    assert after > before  # the resumed run kept checkpointing
+
+
+def test_checkpoint_refuses_dart(tmp_path, rng):
+    X = rng.randn(200, 4)
+    y = (X[:, 0] > 0).astype(float)
+    from lightgbm_tpu.obs.registry import REGISTRY
+
+    trained_before = REGISTRY.counter("train_iterations").value()
+    with pytest.raises(LightGBMError, match="dart"):
+        engine.train(
+            {"objective": "binary", "boosting": "dart", "num_leaves": 7,
+             "verbosity": -1},
+            lgb.Dataset(X, label=y), 4,
+            checkpoint_path=str(tmp_path / "d.ckpt"), checkpoint_rounds=2,
+        )
+    # refused at startup, not at the first cadence boundary: zero iterations
+    # trained before the error
+    assert REGISTRY.counter("train_iterations").value() == trained_before
+
+
+def test_resume_rejects_mismatched_setup(tmp_path, rng):
+    X = rng.randn(200, 4)
+    y = (X[:, 0] > 0).astype(float)
+    ck = str(tmp_path / "b.ckpt")
+    engine.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), 4,
+        checkpoint_path=ck, checkpoint_rounds=2,
+    )
+    # different dataset size -> loud failure, not silent divergence
+    with pytest.raises(LightGBMError, match="num_data"):
+        engine.train(
+            {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+            lgb.Dataset(X[:100], label=y[:100]), 4, resume_from=ck,
+        )
+    # same row count but a different feature space would graft trees whose
+    # split indices point into the wrong columns -> equally loud
+    with pytest.raises(LightGBMError, match="num_features"):
+        engine.train(
+            {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+            lgb.Dataset(X[:, :3], label=y), 4, resume_from=ck,
+        )
+
+
+def test_resume_rejects_reordered_valid_sets(tmp_path, rng):
+    # the valid score carries are stored positionally: two same-sized valid
+    # sets attached in swapped order would silently graft each set's carry
+    # onto the other's data, corrupting every eval and stopping decision
+    X = rng.randn(200, 4)
+    y = (X[:, 0] > 0).astype(float)
+    Xa, ya = rng.randn(80, 4), (rng.randn(80) > 0).astype(float)
+    Xb, yb = rng.randn(80, 4), (rng.randn(80) > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "metric": "auc"}
+    ck = str(tmp_path / "vs.ckpt")
+
+    def run(order, **kw):
+        ds = lgb.Dataset(X, label=y)
+        va = lgb.Dataset(Xa, label=ya, reference=ds)
+        vb = lgb.Dataset(Xb, label=yb, reference=ds)
+        sets = [va, vb] if order == "ab" else [vb, va]
+        return engine.train(dict(params), ds, 4, valid_sets=sets,
+                            verbose_eval=False, **kw)
+
+    run("ab", checkpoint_path=ck, checkpoint_rounds=2)
+    with pytest.raises(LightGBMError, match="valid sets"):
+        run("ba", resume_from=ck)
+    # the matching order still resumes fine
+    run("ab", resume_from=ck)
+
+
+def test_stopper_states_matched_by_identity():
+    # cbs_after order for same-`order` callbacks is a set-iteration tiebreak
+    # (not stable across processes): restore must match saved stopper states
+    # by (stopping_rounds, first_metric_only), not position
+    from lightgbm_tpu import callback as cb_mod
+    from lightgbm_tpu.resil import checkpoint as ckpt_mod
+
+    def stopper_pair():
+        return [cb_mod.early_stopping(3, verbose=False).stopper,
+                cb_mod.early_stopping(7, verbose=False).stopper]
+
+    a, b = stopper_pair()
+    a.best_value, a.best_iter = [0.9], [4]
+    b.best_value, b.best_iter = [0.8], [2]
+    for s in (a, b):
+        s.initialized, s.best_entries, s.improves = True, [None], [lambda n, o: n > o]
+    states = ckpt_mod._stopper_states(
+        [type("C", (), {"stopper": s})() for s in (a, b)]
+    )
+    # restore into the REVERSED order: bests must land on the same windows
+    a2, b2 = stopper_pair()  # fresh 3- and 7-round stoppers
+    ckpt_mod._load_stopper_states(states, [b2, a2])
+    assert (a2.best_value, a2.best_iter) == ([0.9], [4])
+    assert (b2.best_value, b2.best_iter) == ([0.8], [2])
+    # a stopper config the checkpoint never saw is loud, not cross-wired
+    with pytest.raises(LightGBMError, match="early_stopping"):
+        ckpt_mod._load_stopper_states(
+            states, [stopper_pair()[0],
+                     cb_mod.early_stopping(9, verbose=False).stopper]
+        )
+
+
+def test_resume_end_bound_validated(tmp_path):
+    ck = str(tmp_path / "eb.ckpt")
+    _train_binary(rounds=8, checkpoint_path=ck, checkpoint_rounds=4)
+    # an end bound BEFORE the checkpoint's position can never be right: the
+    # run would train nothing and return more iterations than requested
+    with pytest.raises(LightGBMError, match="BEFORE the checkpoint"):
+        _train_binary(rounds=2, resume_from=ck)
+    # a LARGER bound is allowed (warns: not bit-identical to the original)
+    # and actually trains the extra iterations
+    extended = _train_binary(rounds=12, resume_from=ck)
+    assert extended.current_iteration >= 4  # past the checkpoint position
+
+
+def test_resume_from_stopped_checkpoint_is_noop(tmp_path, rng):
+    # a huge min_gain forces the no-split stop on the first tree; the
+    # checkpoint then carries stopped=True and a resume must exit
+    # immediately — no phantom loop pass re-running eval/callbacks
+    X = rng.randn(120, 3)
+    y = rng.randn(120)
+    params = {
+        "objective": "regression", "num_leaves": 7,
+        "min_gain_to_split": 1e9, "verbosity": -1,
+    }
+    ck = str(tmp_path / "stop.ckpt")
+    ref = engine.train(dict(params), lgb.Dataset(X, label=y), 5).model_to_string()
+    with_ck = engine.train(
+        dict(params), lgb.Dataset(X, label=y), 5,
+        checkpoint_path=ck, checkpoint_rounds=1,
+    )
+    assert with_ck.model_to_string() == ref
+    from lightgbm_tpu.obs.registry import REGISTRY
+
+    before = REGISTRY.counter("train_iterations").value()
+    resumed = engine.train(dict(params), lgb.Dataset(X, label=y), 5, resume_from=ck)
+    assert resumed.model_to_string() == ref
+    assert REGISTRY.counter("train_iterations").value() == before
+
+
+def test_checkpoint_remote_uri_roundtrip(tmp_path):
+    # the loader must accept the same remote URIs the writer does
+    # (save routes through vopen; np.load cannot open a URI string)
+    ck = "memory://resil_test/run.ckpt"
+    ref = _train_binary().model_to_string()
+    _train_binary(checkpoint_path=ck, checkpoint_rounds=4)
+    resumed = _train_binary(resume_from=ck)
+    assert resumed.model_to_string() == ref
+
+
+def test_resume_with_init_model_is_rejected(tmp_path):
+    with pytest.raises(LightGBMError, match="mutually exclusive"):
+        rng = np.random.RandomState(0)
+        X = rng.randn(100, 3)
+        engine.train(
+            {"objective": "regression", "verbosity": -1},
+            lgb.Dataset(X, label=X[:, 0]), 2,
+            resume_from=str("nope.ckpt"), init_model="also.txt",
+        )
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / resume — subprocess SIGKILL crashes
+# ---------------------------------------------------------------------------
+_CHILD = textwrap.dedent(
+    """
+    import os, sys
+    sys.path.insert(0, %r)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import engine
+
+    mode = sys.argv[1]
+    ckpt = sys.argv[2]
+    out = sys.argv[3] if len(sys.argv) > 3 else ""
+    resume = len(sys.argv) > 4 and sys.argv[4] == "resume"
+
+    rng = np.random.RandomState(11)
+    if mode == "binary":
+        X = rng.randn(300, 5)
+        y = (X[:, 0] + 0.3 * rng.randn(300) > 0).astype(float)
+        Xv = rng.randn(100, 5); yv = (Xv[:, 0] > 0).astype(float)
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "feature_fraction": 0.7, "bagging_fraction": 0.8,
+                  "bagging_freq": 1}
+        rounds, es, ck_rounds = 10, 6, 3
+    else:  # multiclass, device-chunked, early stopping armed
+        X = rng.randn(180, 5)
+        y = rng.randint(0, 3, 180).astype(float)
+        Xv = rng.randn(60, 5); yv = rng.randint(0, 3, 60).astype(float)
+        params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+                  "verbosity": -1, "feature_fraction": 0.8,
+                  "device_chunk_size": 4}
+        # the ES window exceeds the rounds so the armed stopper only fires
+        # its end-of-training path: checkpoint #2 (the kill target) lands at
+        # a non-final chunk boundary
+        rounds, es, ck_rounds = 13, 20, 4
+
+    ds = lgb.Dataset(X, label=y)
+    vs = lgb.Dataset(Xv, label=yv, reference=ds)
+    bst = engine.train(
+        params, ds, rounds, valid_sets=[vs], verbose_eval=False,
+        early_stopping_rounds=es,
+        checkpoint_path=ckpt or None,
+        checkpoint_rounds=ck_rounds,
+        resume_from=(ckpt if resume else None),
+    )
+    if out:
+        with open(out, "w") as fh:
+            fh.write(bst.model_to_string())
+    print("CHILD-DONE")
+    """
+    % REPO
+)
+
+
+def _run_child(args, extra_env=None, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(ENV_FAULTS, None)
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD] + list(args),
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.mark.parametrize(
+    "mode,fault",
+    [
+        # mid-run kill between checkpoint boundaries (sequential loop)
+        ("binary", "train.iteration:6:kill"),
+        # kill DURING the second checkpoint write: the atomic publisher must
+        # leave checkpoint #1 intact for the resume (chunked + multiclass +
+        # early stopping armed)
+        ("multiclass", "checkpoint.write:2:kill"),
+    ],
+)
+def test_sigkill_then_resume_is_byte_identical(tmp_path, mode, fault):
+    ck = str(tmp_path / "crash.ckpt")
+    ref_out = str(tmp_path / "ref.txt")
+    res_out = str(tmp_path / "resumed.txt")
+
+    # uninterrupted reference (no checkpointing at all)
+    r = _run_child([mode, "", ref_out])
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    # crashing run: SIGKILLed at the injected fault site
+    r = _run_child([mode, ck], extra_env={ENV_FAULTS: fault})
+    assert r.returncode == -9, (r.returncode, r.stdout, r.stderr[-2000:])
+    assert "CHILD-DONE" not in r.stdout
+    assert os.path.exists(ck), "no checkpoint survived the crash"
+
+    # resumed run completes and matches the uninterrupted model byte for byte
+    r = _run_child([mode, ck, res_out, "resume"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert open(res_out).read() == open(ref_out).read()

@@ -1,0 +1,502 @@
+"""Serve hardening tests: per-request deadlines, queue-depth load shedding,
+dispatch retry + CPU fallback, and SIGTERM graceful drain of the REAL server
+process — all driven by induced failures from resil/faults.py, not mocks.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.resil import faults
+from lightgbm_tpu.resil.faults import ENV_FAULTS
+from lightgbm_tpu.serve.server import (
+    DeadlineExceeded,
+    ServeApp,
+    ServeOverloaded,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    monkeypatch.delenv(ENV_FAULTS, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture(scope="module")
+def model_path(tmp_path_factory):
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        lgb.Dataset(X, label=y), 3,
+    )
+    p = str(tmp_path_factory.mktemp("serve_resil") / "m.txt")
+    bst.save_model(p)
+    return p, bst
+
+
+def _app(model_path, **kw):
+    app = ServeApp(max_delay_ms=1.0, min_bucket_rows=8, **kw)
+    app.registry.load("m", model_path[0])
+    return app
+
+
+def _rows(n=5):
+    return np.random.RandomState(0).randn(n, 4)
+
+
+# ---------------------------------------------------------------------------
+# dispatch retry + CPU fallback (fault site: serve.dispatch)
+# ---------------------------------------------------------------------------
+def test_dispatch_retry_once_recovers(model_path, monkeypatch):
+    monkeypatch.setenv(ENV_FAULTS, "serve.dispatch:1")
+    faults.reset()
+    app = _app(model_path, batch=False)
+    try:
+        out, _ = app.predict(_rows())
+        assert np.array_equal(out, model_path[1].predict(_rows()))
+        reg = app.metrics.registry
+        assert reg.counter("serve_dispatch_retries").value() == 1
+        assert reg.counter("serve_cpu_fallback").value() == 0
+    finally:
+        app.close()
+
+
+def test_dispatch_cpu_fallback_after_two_failures(model_path, monkeypatch):
+    monkeypatch.setenv(ENV_FAULTS, "serve.dispatch:1,serve.dispatch:2")
+    faults.reset()
+    app = _app(model_path, batch=False)
+    try:
+        out, _ = app.predict(_rows())
+        assert np.array_equal(out, model_path[1].predict(_rows()))
+        reg = app.metrics.registry
+        assert reg.counter("serve_dispatch_retries").value() == 1
+        assert reg.counter("serve_cpu_fallback").value() == 1
+        text = app.prometheus_metrics()
+        assert "lgbtpu_serve_dispatch_retries_total" in text
+        assert "lgbtpu_serve_cpu_fallback_total" in text
+    finally:
+        app.close()
+
+
+def test_cpu_fallback_rebuilds_when_device_tensors_unreachable(
+    model_path, monkeypatch
+):
+    # a HARD device death strands the packed tensors on the dead device:
+    # the fallback must rebuild the model on CPU from its source text, not
+    # try to copy tensors off the accelerator that just failed
+    app = _app(model_path, batch=False)
+    try:
+        served = app.registry.get("m")
+
+        def dead_device(kind, X):
+            raise RuntimeError("device halted")
+
+        monkeypatch.setattr(served, "run", dead_device)
+        out, _ = app.predict(_rows())
+        assert np.array_equal(out, model_path[1].predict(_rows()))
+        assert app.metrics.registry.counter("serve_cpu_fallback").value() == 1
+        # the rebuild is cached: a second request must not re-pack
+        assert app._cpu_models  # populated
+        rebuilt = app._cpu_models[served.file_sha]
+        out2, _ = app.predict(_rows())
+        assert app._cpu_models[served.file_sha] is rebuilt
+        assert np.array_equal(out2, out)
+    finally:
+        app.close()
+
+
+def test_cpu_fallback_refuses_stale_file(model_path, monkeypatch, tmp_path):
+    # the rebuild re-reads the model file from disk: if it was rewritten
+    # since this ServedModel loaded it (e.g. ahead of a hot swap), serving
+    # the new bytes under the OLD fingerprint/version — and caching that
+    # pairing — would misreport what produced every prediction
+    import shutil
+
+    path = str(tmp_path / "m.txt")
+    shutil.copy(model_path[0], path)
+    app = _app((path, model_path[1]), batch=False)
+    try:
+        served = app.registry.get("m")
+
+        def dead_device(kind, X):
+            raise RuntimeError("device halted")
+
+        monkeypatch.setattr(served, "run", dead_device)
+        with open(model_path[0]) as fh:
+            text = fh.read()
+        with open(path, "w") as fh:  # rewritten on disk behind the registry
+            fh.write(text + "\n# rewritten\n")
+        with pytest.raises(RuntimeError, match="changed on disk"):
+            app.predict(_rows())
+        assert served.file_sha not in app._cpu_models  # nothing cached
+    finally:
+        app.close()
+
+
+def test_client_faults_are_not_retried(model_path):
+    app = _app(model_path, batch=False)
+    try:
+        with pytest.raises(Exception):
+            app.predict(np.zeros((2, 9)))  # wrong width -> client fault
+        assert app.metrics.registry.counter("serve_dispatch_retries").value() == 0
+    finally:
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# per-request deadline (replaces the old global PREDICT_TIMEOUT_S)
+# ---------------------------------------------------------------------------
+def test_deadline_exceeded_maps_to_counter(model_path, monkeypatch):
+    monkeypatch.setenv(ENV_FAULTS, "serve.batcher:1:hang:1.0")
+    faults.reset()
+    app = _app(model_path, batch=True)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            app.predict(_rows(), deadline_s=0.1)
+        assert app.metrics.registry.counter("serve_deadline_exceeded").value() == 1
+        assert "lgbtpu_serve_deadline_exceeded_total" in app.prometheus_metrics()
+    finally:
+        app.close()
+
+
+def test_invalid_deadline_is_client_fault(model_path):
+    # JSON carries 1e309 (parsed as inf); fut.result(timeout=inf) would
+    # raise OverflowError deep in threading — must map to a 400 instead
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    app = _app(model_path, batch=False)
+    try:
+        # 1e19 is finite but past threading.TIMEOUT_MAX — fut.result()
+        # would raise OverflowError, a 500, for what is a client mistake
+        for bad in (float("inf"), 0.0, -1.0, float("nan"), 1e19):
+            with pytest.raises(LightGBMError, match="deadline"):
+                app.predict(_rows(), deadline_s=bad)
+    finally:
+        app.close()
+
+
+def test_bad_default_deadline_rejected_at_startup(model_path):
+    # a misconfigured --deadline-s must fail the server boot, not turn
+    # every subsequent /predict into a 400
+    from lightgbm_tpu.serve.server import ServeApp
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    for bad in (0.0, -5.0, float("inf"), 1e19):
+        with pytest.raises(LightGBMError, match="deadline"):
+            ServeApp(batch=False, default_deadline_s=bad)
+
+
+def test_no_batch_deadline_enforced(model_path, monkeypatch):
+    # --no-batch mode must honor deadlines too: the direct dispatch runs on
+    # its own thread so a hung device call 504s instead of blocking forever
+    monkeypatch.setenv(ENV_FAULTS, "serve.dispatch:1:hang:1.0")
+    faults.reset()
+    app = _app(model_path, batch=False)
+    try:
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded):
+            app.predict(_rows(), deadline_s=0.1)
+        assert time.perf_counter() - t0 < 0.9  # did not wait out the hang
+        assert app.metrics.registry.counter("serve_deadline_exceeded").value() == 1
+    finally:
+        app.close()
+
+
+def test_wedged_worker_exits_after_unwedge():
+    # close() on a wedged worker force-fails BOTH the still-queued requests
+    # and the batch the worker gathered before wedging (their submitters
+    # would otherwise block in future.result() for their full deadlines),
+    # and must leave the _CLOSE sentinel queued: a worker that later
+    # un-wedges has to find it and exit, not block forever in queue.get() —
+    # and its late fan-out must be a silent no-op on the failed futures
+    from lightgbm_tpu.serve.batcher import BatcherClosed, MicroBatcher
+
+    release = threading.Event()
+
+    def slow_dispatch(key, X):
+        release.wait(5.0)
+        return X
+
+    b = MicroBatcher(slow_dispatch, max_delay_ms=1.0)
+    f1 = b.submit("k", np.zeros((2, 3)))
+    time.sleep(0.1)  # worker dequeues f1 and wedges inside dispatch
+    f2 = b.submit("k", np.zeros((2, 3)))  # stays queued behind the wedge
+    b.close(timeout=0.2)
+    with pytest.raises(BatcherClosed):
+        f2.result(timeout=1.0)  # force-failed at close: was still queued
+    with pytest.raises(BatcherClosed):
+        f1.result(timeout=1.0)  # force-failed at close: gathered, un-fanned
+    release.set()  # the wedge clears; its set_result loses the race quietly
+    b._worker.join(timeout=2.0)
+    assert not b._worker.is_alive()  # found the re-queued sentinel and exited
+
+
+def test_wedged_worker_force_fail_reaches_carried_request():
+    # a request popped as the next batch's opener (incompatible key) lives
+    # in the worker's locals while the current batch dispatches — close()
+    # on a wedge there must force-fail it too, not leak its future
+    from lightgbm_tpu.serve.batcher import BatcherClosed, MicroBatcher
+
+    release = threading.Event()
+
+    def slow_dispatch(key, X):
+        release.wait(5.0)
+        return X
+
+    b = MicroBatcher(slow_dispatch, max_delay_ms=300.0)
+    fa = b.submit("a", np.zeros((2, 3)))
+    time.sleep(0.05)  # worker opens batch [fa], waits out the delay window
+    fb = b.submit("b", np.zeros((2, 3)))  # popped as carry -> [fa] dispatches
+    time.sleep(0.1)  # dispatch([fa]) wedges with fb carried in a local
+    b.close(timeout=0.2)
+    with pytest.raises(BatcherClosed):
+        fa.result(timeout=1.0)
+    with pytest.raises(BatcherClosed):
+        fb.result(timeout=1.0)  # the carried request: force-failed too
+    release.set()
+    b._worker.join(timeout=2.0)
+    assert not b._worker.is_alive()
+
+
+def test_tracked_request_counts_once(model_path):
+    # the HTTP handler holds the in-flight slot for the whole request via
+    # track_request; predict()'s own accounting must not count it AGAIN, or
+    # the drain report doubles the stranded-request number
+    app = _app(model_path, batch=False)
+    try:
+        seen = {}
+        orig = app._dispatch
+
+        def spy(key, X):
+            seen["inflight"] = app._inflight
+            return orig(key, X)
+
+        app._dispatch = spy
+        with app.track_request():
+            app.predict(_rows())
+        assert seen["inflight"] == 1  # one slot, not two
+        assert app._inflight == 0
+        app.predict(_rows())  # direct drivers still count themselves
+        assert seen["inflight"] == 1
+        assert app._inflight == 0
+    finally:
+        app.close()
+
+
+# ---------------------------------------------------------------------------
+# queue-depth admission control + draining rejects
+# ---------------------------------------------------------------------------
+def test_queue_saturation_sheds_before_enqueue(model_path, monkeypatch):
+    monkeypatch.setenv(ENV_FAULTS, "serve.batcher:1:hang:1.5")
+    faults.reset()
+    app = _app(model_path, batch=True, max_queue_depth=1)
+    results = []
+
+    def bg():
+        results.append(app.predict(_rows())[0])
+
+    try:
+        t1 = threading.Thread(target=bg)
+        t1.start()
+        time.sleep(0.3)  # worker dequeues the first request and hangs in it
+        t2 = threading.Thread(target=bg)
+        t2.start()
+        time.sleep(0.3)  # second request now WAITING in the queue (depth 1)
+        with pytest.raises(ServeOverloaded):
+            app.predict(_rows())
+        shed = app.metrics.registry.counter("serve_shed")
+        assert shed.value(reason="queue_full") == 1
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        # shedding protected, not dropped: both admitted requests completed
+        assert len(results) == 2
+        assert "lgbtpu_serve_shed_total" in app.prometheus_metrics()
+    finally:
+        app.close()
+
+
+def test_draining_rejects_new_requests(model_path):
+    app = _app(model_path, batch=True)
+    assert app.drain(timeout_s=5.0) is True  # idle server drains clean
+    with pytest.raises(ServeOverloaded):
+        app.predict(_rows())
+    assert app.metrics.registry.counter("serve_shed").value(reason="draining") == 1
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM graceful drain of the real server process
+# ---------------------------------------------------------------------------
+def _read_line(proc, timeout_s=180.0):
+    box = {}
+
+    def read():
+        box["line"] = proc.stdout.readline()
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return box.get("line")
+
+
+def test_sigterm_drains_in_flight_requests(model_path, tmp_path):
+    """Boot ``python -m lightgbm_tpu.serve``, hold requests in flight via an
+    induced worker stall, SIGTERM mid-flight: every accepted request must
+    complete, no new accepts, exit code 0, final drain report printed."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # stall the first dispatched batch 1.5s so SIGTERM lands mid-flight
+    env[ENV_FAULTS] = "serve.batcher:1:hang:1.5"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu.serve", model_path[0],
+         "--port", "0", "--max-delay-ms", "1", "--drain-timeout-s", "20"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        line = _read_line(proc)
+        assert line, "server never printed its startup line"
+        port = json.loads(line)["port"]
+        base = "http://127.0.0.1:%d" % port
+        Xt = _rows(4)
+        expected = model_path[1].predict(Xt)
+        statuses = []
+
+        def post():
+            req = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"rows": Xt.tolist()}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=30) as r:
+                body = json.loads(r.read())
+            assert np.array_equal(expected, np.asarray(body["predictions"]))
+            statuses.append(r.status)
+
+        threads = [threading.Thread(target=post) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)  # requests are now in flight (first batch stalled)
+        proc.send_signal(signal.SIGTERM)
+        # mid-drain the listener is still up: /healthz must report draining
+        # (in-flight requests can't finish before the induced 1.5s stall
+        # ends, so the drain window is open for this probe)
+        time.sleep(0.2)
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "draining" and health["ready"] is False
+        for t in threads:
+            t.join(timeout=30)
+        # zero dropped in-flight requests: every accepted request answered
+        assert statuses == [200, 200, 200]
+        rc = proc.wait(timeout=30)
+        assert rc == 0, (rc, proc.stderr.read()[-2000:])
+        # no new accepts after the drain
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            urllib.request.urlopen(base + "/healthz", timeout=3)
+        final = [
+            json.loads(l) for l in proc.stdout.read().splitlines()
+            if l.startswith("{")
+        ]
+        assert final, "no final drain report printed"
+        report = final[-1]
+        assert report["serving"] is False and report["drained"] is True
+        assert report["counters"].get("requests", 0) >= 3
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=15)
+
+
+def test_http_shed_sets_retry_after(model_path):
+    """Queue saturation over real HTTP: 503 + Retry-After + shed counter in
+    the Prometheus exposition."""
+    import http.client
+
+    from lightgbm_tpu.serve.server import make_server
+
+    os.environ[ENV_FAULTS] = "serve.batcher:1:hang:1.2"
+    faults.reset()
+    app = _app(model_path, batch=True, max_queue_depth=1)
+    srv = make_server("127.0.0.1", 0, app)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    Xt = _rows(3)
+
+    def post(payload, timeout=30):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        try:
+            conn.request("POST", "/predict", json.dumps(payload),
+                         {"Content-Type": "application/json"})
+            r = conn.getresponse()
+            return r.status, dict(r.getheaders()), json.loads(r.read())
+        finally:
+            conn.close()
+
+    try:
+        results = []
+        ts = [
+            threading.Thread(
+                target=lambda: results.append(post({"rows": Xt.tolist()}))
+            )
+            for _ in range(2)
+        ]
+        ts[0].start()
+        time.sleep(0.3)
+        ts[1].start()
+        time.sleep(0.3)
+        status, headers, body = post({"rows": Xt.tolist()})
+        assert status == 503
+        assert headers.get("Retry-After") == "1"
+        assert body["reason"] == "queue_full"
+        for th in ts:
+            th.join(timeout=10)
+        assert all(r[0] == 200 for r in results)
+    finally:
+        os.environ.pop(ENV_FAULTS, None)
+        srv.shutdown()
+        srv.server_close()
+        app.close()
+
+
+def test_http_deadline_maps_to_504(model_path):
+    import http.client
+
+    from lightgbm_tpu.serve.server import make_server
+
+    os.environ[ENV_FAULTS] = "serve.batcher:1:hang:1.0"
+    faults.reset()
+    app = _app(model_path, batch=True)
+    srv = make_server("127.0.0.1", 0, app)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request(
+            "POST", "/predict",
+            json.dumps({"rows": _rows(3).tolist(), "deadline_ms": 80}),
+            {"Content-Type": "application/json"},
+        )
+        r = conn.getresponse()
+        assert r.status == 504
+        assert "deadline" in json.loads(r.read())["error"]
+        conn.close()
+    finally:
+        os.environ.pop(ENV_FAULTS, None)
+        srv.shutdown()
+        srv.server_close()
+        app.close()

@@ -464,6 +464,113 @@ def jx009_raw_host_io(ctx: FileContext, project: ProjectContext) -> Iterator[Fin
 
 
 # --------------------------------------------------------------------------
+# artifact-naming heuristic for JX010: identifiers/strings that denote a
+# persisted model or training checkpoint in this codebase
+_ARTIFACT_RE = re.compile(r"(model|checkpoint|ckpt|snapshot)", re.I)
+_ATOMIC_WRITER_SUFFIX = "resil/atomic.py"
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    """The call's literal mode string when it opens for writing, else None."""
+    mode = None
+    if len(call.args) >= 2:
+        a = call.args[1]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            mode = a.value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) and isinstance(
+            kw.value.value, str
+        ):
+            mode = kw.value.value
+    # 'x' (exclusive create) publishes at the final name just like 'w' —
+    # a kill mid-write leaves the same truncated artifact
+    if mode and mode.startswith(("w", "a", "x")):
+        return mode
+    return None
+
+
+def _path_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The file-path expression: first positional arg, or ``file=`` /
+    ``path=`` keyword (open/vopen accept the path by keyword too)."""
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg in ("file", "path"):
+            return kw.value
+    return None
+
+
+def _mentions_artifact(node: ast.AST) -> Optional[str]:
+    """First identifier/attribute/string in ``node`` matching the artifact
+    vocabulary (the path expression names what it writes)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and _ARTIFACT_RE.search(sub.id):
+            return sub.id
+        if isinstance(sub, ast.Attribute) and _ARTIFACT_RE.search(sub.attr):
+            return sub.attr
+        if (
+            isinstance(sub, ast.Constant)
+            and isinstance(sub.value, str)
+            and _ARTIFACT_RE.search(sub.value)
+        ):
+            return sub.value
+    return None
+
+
+@rule("JX010", "model/checkpoint artifact written without the atomic publisher")
+def jx010_raw_artifact_write(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """A direct ``open(path, "w")`` / ``vopen(path, "w")`` of a model,
+    checkpoint or snapshot artifact can be killed mid-write and leave a
+    TRUNCATED published file — which a later load trusts. Route artifact
+    writes through ``resil/atomic.py`` (temp file + fsync + rename: readers
+    see the old complete file or the new complete file, never a prefix).
+    Scoped to ``lightgbm_tpu/``; the atomic writer module itself is exempt,
+    and so are paths whose expression/enclosing function names no artifact
+    (prediction outputs, traces, datasets have their own formats and
+    rewrite-from-source recovery).
+    """
+    if "lightgbm_tpu" not in ctx.rel_path.split("/")[:-1]:
+        return
+    if ctx.rel_path.endswith(_ATOMIC_WRITER_SUFFIX):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func)
+        if fname.rsplit(".", 1)[-1] not in ("open", "vopen"):
+            continue
+        path_arg = _path_arg(node)
+        mode = _write_mode(node)
+        if mode is None or path_arg is None:
+            continue
+        hit = _mentions_artifact(path_arg)
+        if hit is None:
+            for fn in ctx.enclosing_functions(node):
+                if _ARTIFACT_RE.search(fn.name):
+                    hit = fn.name
+                    break
+        if hit is not None:
+            if mode.startswith("a"):
+                # append has no atomic equivalent (rename replaces the whole
+                # file) — the right fix is a different artifact design, not
+                # a drop-in helper call
+                msg = (
+                    "append-mode %s(..., %r) of artifact %r is not "
+                    "crash-safe (a kill mid-append leaves a torn record); "
+                    "rewrite the whole artifact through resil/atomic.py or "
+                    "use a format that tolerates a truncated tail"
+                    % (fname, mode, hit)
+                )
+            else:
+                msg = (
+                    "direct %s(..., %r) of artifact %r can publish a "
+                    "truncated file on crash; route through resil/atomic.py "
+                    "(atomic_write_text/bytes)" % (fname, mode, hit)
+                )
+            yield ctx.finding("JX010", node, msg, detail="artifact=%s" % hit)
+
+
+# --------------------------------------------------------------------------
 @rule("JX008", "broad exception handler silently swallows")
 def jx008_silent_swallow(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
     """``except Exception: pass`` (or a bare ``except:``) with nothing in
