@@ -52,19 +52,10 @@ def _emit(value: float, vs_baseline: float, **extra) -> None:
     print(json.dumps(line), flush=True)
 
 
-def make_higgs_like(n: int, f: int, seed: int = 7):
-    rng = np.random.RandomState(seed)
-    # mix of unit-gaussian "low-level" features and derived positive "high-level"
-    # features, like the HIGGS csv: 21 kinematic + 7 derived
-    X = np.empty((n, f), np.float32)
-    X[:, :21] = rng.randn(n, 21).astype(np.float32)
-    for j in range(21, f):
-        a, b = rng.randint(0, 21, 2)
-        X[:, j] = np.abs(X[:, a] * X[:, b] + rng.randn(n).astype(np.float32) * 0.5)
-    w = rng.randn(f) * (rng.rand(f) > 0.3)
-    logits = X @ w * 0.3 + rng.randn(n) * 2.0
-    y = (logits > 0).astype(np.float32)
-    return X, y
+# shared with helpers/prof_grow.py and the bringup stages (helpers/bench_data
+# holds the one definition; re-exported here so `from bench import
+# make_higgs_like` call sites keep working)
+from helpers.bench_data import make_higgs_like  # noqa: E402,F401
 
 
 def _watchdog(limit_s: float) -> None:
@@ -366,6 +357,11 @@ def _run() -> None:
         pass
     platform = os.environ.get("BENCH_WORKER_PLATFORM", "unknown")
     platforms = os.environ.get("BENCH_FORCE_PLATFORMS")
+    # measured cost-analysis harvest (obs/costs.py): ON by default in the
+    # bench — the roofline's "measured" tier depends on it, and the
+    # persistent compilation cache below absorbs the harvest's second XLA
+    # compile. LIGHTGBM_TPU_COSTS=0 opts out.
+    os.environ.setdefault("LIGHTGBM_TPU_COSTS", "1")
     # CPU fallback: the native host learner (device_type=cpu,
     # ops/grow_native.py — C++ histogram/partition/split-scan kernels with
     # OpenMP) replaces the XLA serial grower; it measures faster than the
@@ -577,13 +573,47 @@ def _run() -> None:
         # its phase row silently and the artifact read as "never instrumented"
         phases_error = "%s: %s" % (type(e).__name__, str(e)[:200])
         print("bench: phase breakdown failed: %s" % e, file=sys.stderr)
-    # Work model per boosting iteration, from the actually-grown trees:
-    # histogram rows = sum over splits of the smaller child (subtraction
-    # trick), flops = rows x F x K x 2 (multiply-add per bin entry), bytes =
-    # hist rows x (F bins u8 + K f32 values) + one partition gather pass.
+    # Roofline: MEASURED flops/bytes from the XLA cost analysis of the very
+    # executable the timed loop dispatched (obs/costs.py harvest, keyed by
+    # the retrace names; train_chunk covers `chunk` iterations) against a
+    # proper per-device_kind peak table — falling back to the analytic work
+    # model, LABELED, never silently (roofline_source below). The analytic
+    # model is always computed too, as the cross-check column: histogram
+    # rows = sum over splits of the smaller child (subtraction trick),
+    # flops = rows x F x K x 2, bytes = hist rows x (F bins u8 + K f32
+    # values) + one partition gather pass.
     mfu_estimate = None
     roofline = {}
+    roofline_source = "analytic"
     try:
+        from lightgbm_tpu.obs import costs as costs_mod
+
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            kind = None
+        peaks = costs_mod.chip_peaks(kind, platform=platform)
+        peak_flops, peak_bw = peaks["peak_flops"], peaks["peak_bw"]
+        roofline_chip = peaks["chip"]
+        # MEASURED per-iteration time at the MEASURED n_rows — the
+        # scaled (1M-equivalent) rate would mismatch the tree's work
+        # model when the sliced CPU fallback ran (scaled != 1)
+        iter_s = bench_time / bench_iters
+        meas_name = "gbdt.train_chunk" if chunk > 1 else "ops.grow_tree"
+        meas = costs_mod.COSTS.get(meas_name)
+        if meas and meas.get("flops"):
+            per = float(chunk) if chunk > 1 else 1.0
+            meas_flops = float(meas["flops"]) / per
+            meas_bytes = float(meas.get("bytes_accessed") or 0.0) / per
+            roofline_source = "measured"
+            mfu_estimate = round(meas_flops / iter_s / peak_flops, 6)
+            roofline = {
+                "measured_executable": meas_name,
+                "measured_flops_per_iter": meas_flops,
+                "measured_bytes_per_iter": meas_bytes,
+                "hbm_utilization": round(meas_bytes / iter_s / peak_bw, 4),
+                "roofline_chip": roofline_chip,
+            }
         gbdt._materialize()
         trees = [t for t in gbdt.models if t is not None and t.num_leaves > 1]
         if trees:
@@ -606,32 +636,17 @@ def _run() -> None:
             hist_flops = small_rows * F * K * 2
             scan_flops = nsplit * 2 * F * Bn * 20  # two-direction cumsum scans
             hist_bytes = small_rows * (F + K * 4) + n_rows * (F + 8)
-            # v5e-1: ~197 TFLOP/s bf16 / ~99 TFLOP/s f32 MXU, ~819 GB/s HBM.
-            # The chip the constants assume is labeled in the JSON
-            # (roofline_chip) — on another TPU generation the utilization
-            # numbers would be vs the WRONG peak (ADVICE r4).
-            if platform in ("tpu", "axon"):
-                peak_flops, peak_bw = 99e12, 819e9
-                try:
-                    kind = jax.devices()[0].device_kind
-                except Exception:
-                    kind = "unknown"
-                roofline_chip = "v5e-1 (assumed; device_kind=%s)" % kind
-            else:
-                peak_flops, peak_bw = 1e11, 2e10
-                roofline_chip = "cpu-nominal"
-            # MEASURED per-iteration time at the MEASURED n_rows — the
-            # scaled (1M-equivalent) rate would mismatch the tree's work
-            # model when the sliced CPU fallback ran (scaled != 1)
-            iter_s = bench_time / bench_iters
-            mfu_estimate = round((hist_flops + scan_flops) / iter_s / peak_flops, 6)
-            roofline = {
-                "hist_small_rows_per_iter": int(small_rows),
-                "model_flops_per_iter": float(hist_flops + scan_flops),
-                "model_bytes_per_iter": float(hist_bytes),
-                "hbm_utilization": round(hist_bytes / iter_s / peak_bw, 4),
-                "roofline_chip": roofline_chip,
-            }
+            roofline["hist_small_rows_per_iter"] = int(small_rows)
+            roofline["model_flops_per_iter"] = float(hist_flops + scan_flops)
+            roofline["model_bytes_per_iter"] = float(hist_bytes)
+            roofline.setdefault("roofline_chip", roofline_chip)
+            if roofline_source == "analytic":
+                mfu_estimate = round(
+                    (hist_flops + scan_flops) / iter_s / peak_flops, 6
+                )
+                roofline["hbm_utilization"] = round(
+                    hist_bytes / iter_s / peak_bw, 4
+                )
     except Exception as e:
         print("bench: roofline model failed: %s" % e, file=sys.stderr)
 
@@ -686,6 +701,63 @@ def _run() -> None:
         predict_rec = {"error": "%s: %s" % (type(e).__name__, str(e)[:200])}
         print("bench: predict bench failed: %s" % e, file=sys.stderr)
 
+    # ---- segment profiler: named device time inside tree growth ----------
+    # (obs/prof.py): fused + segmented growth on identical inputs, fenced
+    # per-segment timings, and a bitwise-identity proof of the segmented
+    # model. The CPU-fallback headline uses the native host learner (no XLA
+    # segments), so a side booster at the same shape profiles the XLA
+    # grower instead — labeled via "side_booster". BENCH_PROF=0 skips;
+    # BENCH_PROF_ROWS caps the side-booster shape; runs only when >=300s of
+    # the worker budget remain (it compiles a second grower program).
+    growth_prof = None
+    if os.environ.get("BENCH_PROF", "1") not in ("", "0"):
+        try:
+            from lightgbm_tpu.obs import prof as prof_mod
+
+            remaining = float(
+                os.environ.get(
+                    "BENCH_WORKER_BUDGET_S",
+                    os.environ.get("BENCH_TIMEOUT_S", 2400),
+                )
+            ) - (time.time() - _WATCHDOG_T0)
+            prof_iters = int(os.environ.get("BENCH_PROF_ITERS", "2"))
+            if remaining < 300:
+                growth_prof = {
+                    "skipped": "tight budget (%.0fs left)" % remaining
+                }
+            else:
+                reason = prof_mod.unsupported_reason(booster._gbdt)
+                if reason is None:
+                    growth_prof = prof_mod.profile_growth(
+                        booster, iters=prof_iters
+                    )
+                else:
+                    rows = min(
+                        n_rows, int(os.environ.get("BENCH_PROF_ROWS", N_ROWS))
+                    )
+                    pparams = {
+                        k: v
+                        for k, v in params.items()
+                        if k
+                        not in ("device_type", "tree_learner",
+                                "device_chunk_size")
+                    }
+                    pbst = lgb.Booster(
+                        params=pparams,
+                        train_set=lgb.Dataset(X[:rows], label=y[:rows]),
+                    )
+                    growth_prof = prof_mod.profile_growth(
+                        pbst, iters=prof_iters
+                    )
+                    growth_prof["side_booster"] = reason
+            print(
+                "bench: growth segments -> %s" % json.dumps(growth_prof),
+                file=sys.stderr, flush=True,
+            )
+        except Exception as e:
+            growth_prof = {"error": "%s: %s" % (type(e).__name__, str(e)[:200])}
+            print("bench: segment profiler failed: %s" % e, file=sys.stderr)
+
     extra = {"platform": platform, "train_auc": round(float(auc), 6)}
     if predict_rec:
         extra["predict"] = predict_rec
@@ -739,9 +811,26 @@ def _run() -> None:
         extra["phases_dispatch_s"] = phases_dispatch
     elif phases_error:
         extra["phases_error"] = phases_error
+    # provenance stamp: downstream BENCH_r*.json comparisons (bench_diff)
+    # must know whether mfu/bytes came from XLA cost analysis or the model
+    extra["roofline_source"] = roofline_source
     if mfu_estimate is not None:
         extra["mfu_estimate"] = mfu_estimate
         extra.update(roofline)
+    if growth_prof:
+        extra["growth_prof"] = growth_prof
+        if growth_prof.get("segments_per_tree_s"):
+            extra["growth_segments_s"] = growth_prof["segments_per_tree_s"]
+    try:
+        from lightgbm_tpu.obs import costs as _costs_mod
+
+        book = _costs_mod.COSTS.report()
+        if book:
+            extra["cost_analysis"] = book
+    except Exception as e:
+        # surface it — a silently-absent cost_analysis block reads as
+        # "never instrumented" (the same failure mode phases_error covers)
+        print("bench: cost_analysis attach failed: %s" % e, file=sys.stderr)
     if scaled != 1.0:
         extra["cpu_fallback_measured_rows"] = n_rows
         extra["cpu_fallback_scale"] = scaled

@@ -1,0 +1,33 @@
+"""Shared synthetic-workload generators for bench/profiling harnesses.
+
+One definition of the Higgs-shaped dataset (was duplicated between bench.py
+and helpers/prof_grow.py, with silently different feature distributions —
+their numbers were not comparable). bench.py re-exports
+:func:`make_higgs_like`, so existing ``from bench import make_higgs_like``
+call sites (helpers/tpu_bringup.py stages) keep working.
+
+Stdlib + numpy only: importable from the bench orchestrator process, which
+must never touch jax.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_higgs_like(n: int, f: int, seed: int = 7):
+    """[n, f] float32 features + binary labels, HIGGS-shaped: 21 unit-
+    gaussian "low-level" kinematic features and f-21 derived positive
+    "high-level" features (products of low-level pairs plus noise), labels
+    from a sparse linear logit. Matches the reference's headline Higgs
+    experiment shape (binning/shape-equivalent, synthetic values)."""
+    rng = np.random.RandomState(seed)
+    X = np.empty((n, f), np.float32)
+    low = min(21, f)
+    X[:, :low] = rng.randn(n, low).astype(np.float32)
+    for j in range(low, f):
+        a, b = rng.randint(0, low, 2)
+        X[:, j] = np.abs(X[:, a] * X[:, b] + rng.randn(n).astype(np.float32) * 0.5)
+    w = rng.randn(f) * (rng.rand(f) > 0.3)
+    logits = X @ w * 0.3 + rng.randn(n) * 2.0
+    y = (logits > 0).astype(np.float32)
+    return X, y

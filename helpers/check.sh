@@ -18,6 +18,19 @@
 #                               # SIGKILL a checkpointing training run at an
 #                               # injected fault site, resume bit-identically;
 #                               # SIGTERM-drain the real server mid-flight
+#   helpers/check.sh --prof     # lint gate, then the performance-attribution
+#                               # smoke: segment-profiled mini-train —
+#                               # breakdown structure + fused-vs-segmented
+#                               # bitwise identity + cost-analysis cross-check
+#   helpers/check.sh --bench-diff [CUR BASE]
+#                               # the bench regression gate: golden-fixture
+#                               # self-test (synthetic regression must FAIL,
+#                               # improvement must PASS) + informational
+#                               # BENCH_r* series diff; with CUR and BASE
+#                               # paths it hard-gates that pair instead.
+#                               # Part of the pre-merge flow for any PR that
+#                               # claims (or risks) a perf change
+#                               # (docs/Observability.md).
 #
 # ruff/mypy are optional: the container may not ship them (no network
 # installs); when absent they are skipped with a notice — graftlint and
@@ -27,9 +40,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs or --resil)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -78,6 +91,23 @@ fi
 if [ "$MODE" = "--resil" ]; then
     echo "== resil smoke (SIGKILL/resume bit-identity + SIGTERM serve drain) =="
     exec env JAX_PLATFORMS=cpu python helpers/resil_smoke.py
+fi
+
+if [ "$MODE" = "--prof" ]; then
+    echo "== prof smoke (segment breakdown + bitwise identity + cost analysis) =="
+    exec env JAX_PLATFORMS=cpu python helpers/obs_smoke.py --prof
+fi
+
+if [ "$MODE" = "--bench-diff" ]; then
+    if [ $# -ge 3 ]; then
+        echo "== bench-diff gate ($2 vs $3) =="
+        exec python helpers/bench_diff.py "$2" "$3"
+    fi
+    echo "== bench-diff self-test (golden fixtures) =="
+    python helpers/bench_diff.py --self-test || exit 1
+    echo "== bench-diff series (informational) =="
+    python helpers/bench_diff.py --series 'BENCH_r*.json' || true
+    exit 0
 fi
 
 if [ "$MODE" = "--quick" ]; then

@@ -1,8 +1,21 @@
 """Per-split latency profiling harness at the bench shape.
 
 Usage: JAX_PLATFORMS=cpu python helpers/prof_grow.py [rows] [leaves] [iters]
-Prints compile time, steady-state iters/s, and (with LIGHTGBM_TPU_PROFILE
-set) writes a jax profiler trace.
+Prints compile time, steady-state iters/s and, when the corresponding env
+vars are set, richer attribution:
+
+  * LIGHTGBM_TPU_PROFILE=<dir>   — jax profiler trace (TensorBoard/Perfetto)
+  * LIGHTGBM_TPU_TRACE=<path>    — obs Chrome-trace spans (this harness
+    wraps each stage in a span, so the timeline carries bin/compile/steady
+    sections next to the training-phase spans)
+  * LIGHTGBM_TPU_PROF_SEGMENTS=1 — the segment profiler breakdown
+    (obs/prof.py): per-segment seconds inside tree growth + the
+    fused-vs-segmented bitwise identity verdict
+
+Clock: time.perf_counter throughout (the rule JX009 enforces inside
+ops//models/ — wall-clock NTP steps corrupt intervals); the dataset comes
+from helpers/bench_data.make_higgs_like, the SAME generator bench.py uses,
+so numbers here are comparable with bench output.
 """
 import os
 import sys
@@ -12,14 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-
-def make_higgs_like(n, f, seed=7):
-    rng = np.random.RandomState(seed)
-    X = rng.randn(n, f).astype(np.float32)
-    w = rng.randn(f) / np.sqrt(f)
-    logits = X @ w + 0.5 * np.sin(X[:, 0] * 2.0) + 0.25 * X[:, 1] * X[:, 2]
-    y = (logits + rng.randn(n) * 0.5 > 0).astype(np.float32)
-    return X, y
+from helpers.bench_data import make_higgs_like
 
 
 def main():
@@ -29,6 +35,7 @@ def main():
 
     import jax
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import trace as trace_mod
 
     X, y = make_higgs_like(rows, 28)
     params = {
@@ -38,19 +45,21 @@ def main():
         "learning_rate": 0.1,
         "verbosity": -1,
     }
-    t0 = time.time()
-    ds = lgb.Dataset(X, label=y)
-    booster = lgb.Booster(params=params, train_set=ds)
-    print("bin: %.1fs" % (time.time() - t0), flush=True)
+    t0 = time.perf_counter()
+    with trace_mod.span("prof_grow.bin", cat="prof_grow"):
+        ds = lgb.Dataset(X, label=y)
+        booster = lgb.Booster(params=params, train_set=ds)
+    print("bin: %.1fs" % (time.perf_counter() - t0), flush=True)
 
-    t0 = time.time()
+    t0 = time.perf_counter()
+    with trace_mod.span("prof_grow.compile", cat="prof_grow"):
+        booster.update()
+        jax.block_until_ready(booster._gbdt.scores)
+    print("first iter (compile): %.1fs" % (time.perf_counter() - t0), flush=True)
+    t0 = time.perf_counter()
     booster.update()
     jax.block_until_ready(booster._gbdt.scores)
-    print("first iter (compile): %.1fs" % (time.time() - t0), flush=True)
-    t0 = time.time()
-    booster.update()
-    jax.block_until_ready(booster._gbdt.scores)
-    print("second iter: %.2fs" % (time.time() - t0), flush=True)
+    print("second iter: %.2fs" % (time.perf_counter() - t0), flush=True)
 
     trace_dir = os.environ.get("LIGHTGBM_TPU_PROFILE")
     if trace_dir:
@@ -60,16 +69,39 @@ def main():
             jax.block_until_ready(booster._gbdt.scores)
         print("trace written to", trace_dir, flush=True)
 
-    t0 = time.time()
-    for _ in range(iters):
-        booster.update()
-    jax.block_until_ready(booster._gbdt.scores)
-    dt = time.time() - t0
+    t0 = time.perf_counter()
+    with trace_mod.span("prof_grow.steady", cat="prof_grow", iters=iters):
+        for _ in range(iters):
+            booster.update()
+        jax.block_until_ready(booster._gbdt.scores)
+    dt = time.perf_counter() - t0
     print(
         "steady: %d iters in %.2fs -> %.3f iters/s (%.1f ms/iter, %.0f us/split)"
         % (iters, dt, iters / dt, 1000 * dt / iters, 1e6 * dt / iters / max(leaves - 1, 1)),
         flush=True,
     )
+
+    from lightgbm_tpu.obs import prof as prof_mod
+
+    if prof_mod.segments_enabled():
+        reason = prof_mod.unsupported_reason(booster._gbdt)
+        if reason is not None:
+            print("segment profiler skipped: %s" % reason, flush=True)
+        else:
+            rec = prof_mod.profile_growth(
+                booster, iters=prof_mod.segments_iters()
+            )
+            print(
+                "growth segments (s/tree): %s" % rec["segments_per_tree_s"],
+                flush=True,
+            )
+            print(
+                "segment sum %.3fs vs fused %.3fs (ratio %.3f), bitwise=%s"
+                % (rec["segment_sum_s_per_tree"],
+                   rec["fused_growth_s_per_tree"],
+                   rec["segment_sum_ratio"], rec["bitwise_identical"]),
+                flush=True,
+            )
 
 
 if __name__ == "__main__":

@@ -85,6 +85,7 @@ STAGE_TIMEOUTS = {
     "smoke_psplit": 1800,  # opt-in Pallas split-scan kernel (first lowering)
     "bench_chunk": 3600,   # device-resident boosting sweep at the 1M shape
     "bench_predict": 1800,  # packed-inference serving bench (ISSUE 3)
+    "prof": 1800,   # segment-profiled mini-train (obs/prof.py, ISSUE 6)
     "bench": 3600,
 }
 
@@ -443,6 +444,87 @@ print(json.dumps({
 assert "fused_scores" in BENCH_PREDICT
 
 
+# Kernel-level performance attribution (ISSUE 6): run tree growth as
+# separately-dispatched fenced sub-steps (obs/prof.py) at a training smoke
+# shape, record the growth_segments_s breakdown + the measured cost-analysis
+# book, and prove the segmented model bitwise-identical to the fused
+# grower's ON SILICON — the instrument that makes the Pallas-kernel work
+# (ROADMAP item 2) measurable before and after.
+PROF = _COMMON + """
+sys.path.insert(0, %r)
+os.environ["LIGHTGBM_TPU_LATTICE"] = "pow2"   # cap first-contact compile cost
+os.environ["LIGHTGBM_TPU_COSTS"] = "1"
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import costs as costs_mod
+from lightgbm_tpu.obs import prof as prof_mod
+
+from bench import make_higgs_like
+
+on_chip = jax.default_backend() in ("tpu", "axon")
+N, LEAVES = (100_000, 255) if on_chip else (20_000, 63)
+X, y = make_higgs_like(N, 28)
+params = {"objective": "binary", "num_leaves": LEAVES, "max_bin": 255,
+          "learning_rate": 0.1, "verbosity": -1}
+bst = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+bst.update()  # one real iteration so gradients are post-root state
+reason = prof_mod.unsupported_reason(bst._gbdt)
+if reason is not None:
+    print(json.dumps({"ok": False, "error": "unsupported: " + reason,
+                      "platform": jax.default_backend()}))
+    sys.exit(0)
+rec = prof_mod.profile_growth(bst, iters=2)
+segs = rec["segments_per_tree_s"]
+structure_ok = all(
+    k in segs for k in
+    ("partition", "hist_build", "hist_subtract", "split_scan", "leaf_update"))
+print(json.dumps({
+    "ok": bool(rec["bitwise_identical"]) and structure_ok,
+    "platform": jax.default_backend(),
+    "rows": rec["rows"], "num_leaves": rec["num_leaves"],
+    "grow_mode": rec["grow_mode"],
+    "growth_segments_s": segs,
+    "segment_sum_ratio": rec["segment_sum_ratio"],
+    "fused_growth_s_per_tree": rec["fused_growth_s_per_tree"],
+    "bitwise_identical": rec["bitwise_identical"],
+    "cost_analysis": costs_mod.COSTS.report()}))
+""" % REPO
+assert "profile_growth" in PROF and "bitwise_identical" in PROF
+
+
+def _load_bench_diff():
+    """helpers/bench_diff.py by FILE path (stdlib-only module), keeping this
+    driver jax-free — same pattern as _load_backoff."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lgbtpu_bench_diff", os.path.join(REPO, "helpers", "bench_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _bench_diff_verdict(prev: dict, result: dict) -> dict:
+    """Regression verdict of this round's bench vs the previous on-chip
+    record (helpers/bench_diff.py thresholds). Recorded in the summary —
+    every bringup round carries its own regression verdict; never fatal to
+    the bringup itself."""
+    if not prev or "metric" not in result:
+        return {"status": "SKIP", "note": "no prior record or no result"}
+    try:
+        bd = _load_bench_diff()
+        current = {k: v for k, v in result.items()
+                   if k not in ("ok", "wall_s", "attempts")}
+        rows, failed = bd.compare(current, prev)
+        return {
+            "status": "FAIL" if failed else "PASS",
+            "baseline_t": prev.get("t") or prev.get("recorded_at"),
+            "rows": rows,
+        }
+    except Exception as e:
+        return {"status": "ERROR", "note": "%s: %s" % (type(e).__name__, e)}
+
+
 def _check_spec_seq_match(summary: dict) -> None:
     """ADVICE r5 #1: the smoke/smoke_seq pair trains the same data and seed
     under the spec and sequential growers — their model strings must agree
@@ -619,6 +701,13 @@ def main() -> int:
     # pack4 is a shelved-accelerator measurement and goes last
     summary = {"t": time.strftime("%Y-%m-%dT%H:%M:%S"), "stages": {},
                "verdict": "in progress"}
+    # the previous on-chip record, captured BEFORE run_bench can overwrite
+    # it — this round's regression verdict diffs against it (bench_diff)
+    try:
+        with open(os.path.join(REPO, "BENCH_TPU.json")) as f:
+            prev_bench = json.load(f)
+    except Exception:
+        prev_bench = None
     for stage, src in (("matmul", MATMUL), ("pallas", PALLAS),
                        ("smoke", SMOKE),
                        ("smoke_seq", SMOKE_SEQ),
@@ -638,6 +727,9 @@ def main() -> int:
                        ("bench_chunk", BENCH_CHUNK),
                        # serving throughput/latency capture (ISSUE 3)
                        ("bench_predict", BENCH_PREDICT),
+                       # kernel-level attribution: segment breakdown +
+                       # bitwise proof + cost analysis, on silicon (ISSUE 6)
+                       ("prof", PROF),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         with _stage_span(stage):
@@ -665,6 +757,13 @@ def main() -> int:
     with _stage_span("bench"):
         summary["stages"]["bench"] = run_with_retry("bench", run_bench)
     ok = summary["stages"]["bench"].get("ok", False)
+    # regression verdict vs the previous on-chip record: every bringup
+    # round records where the perf trajectory moved (helpers/bench_diff.py)
+    summary["bench_diff"] = _bench_diff_verdict(
+        prev_bench, summary["stages"]["bench"]
+    )
+    print("bringup: bench_diff -> %s" % summary["bench_diff"].get("status"),
+          flush=True)
     summary["verdict"] = "ok" if ok else "bench failed"
     _dump(summary)
     if _trace_path():

@@ -26,6 +26,7 @@ import numpy as np
 from ..config import Config
 from ..dataset import BinnedDataset
 from ..metric import Metric
+from ..obs import costs as costs_mod
 from ..obs import memwatch, retrace as retrace_mod
 from ..objective import ObjectiveFunction
 from ..ops import grow_native
@@ -677,10 +678,22 @@ class GBDT:
         with timers.phase("chunked boosting") as ph:
             fmasks = self._sample_feature_masks(n)
             fn = self._chunk_fn(n)
+            # snapshot avals BEFORE the donating call (obs/costs.py)
+            harvest = None
+            if costs_mod.enabled():
+                harvest = costs_mod.sds_args(
+                    (self.scores, self._bag_mask, jnp.int32(self.iter_),
+                     fmasks, self._finish_scalar(0)),
+                    {},
+                )
             self.scores, self._bag_mask, trees_out, nl_dev = fn(
                 self.scores, self._bag_mask, jnp.int32(self.iter_), fmasks,
                 self._finish_scalar(0),
             )
+            if harvest is not None:
+                costs_mod.COSTS.harvest(
+                    "gbdt.train_chunk", fn, harvest[0], harvest[1]
+                )
             ph.mark(nl_dev)
         try:
             nl_dev.copy_to_host_async()  # [n, K]
@@ -934,13 +947,29 @@ class GBDT:
                 if sbuf is None or sbuf.shape != (M, F, self.num_bins, 3):
                     sbuf = jnp.zeros((M, F, self.num_bins, 3), jnp.float32)
                 self._spec_buf = None  # consumed by donation below
+            grow_kwargs = dict(
+                forced_splits=self._forced_splits, cegb=self.cegb_params,
+                cegb_state=self._cegb_state, hist_buf=buf,
+                bins_nf=self.bins_dev_nf, hist_pool_slots=slots,
+                spec_buf=sbuf, **common,
+            )
+            # measured cost analysis (obs/costs.py, LIGHTGBM_TPU_COSTS=1):
+            # snapshot the avals BEFORE the call — donation consumes buf/sbuf
+            harvest = None
+            if costs_mod.enabled():
+                harvest = costs_mod.sds_args(
+                    (self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
+                     self.feature_meta),
+                    grow_kwargs,
+                )
             out = grow_tree(
                 self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
-                self.feature_meta, forced_splits=self._forced_splits,
-                cegb=self.cegb_params, cegb_state=self._cegb_state,
-                hist_buf=buf, bins_nf=self.bins_dev_nf,
-                hist_pool_slots=slots, spec_buf=sbuf, **common,
+                self.feature_meta, **grow_kwargs,
             )
+            if harvest is not None:
+                costs_mod.COSTS.harvest(
+                    "ops.grow_tree", grow_tree, harvest[0], harvest[1]
+                )
             if sbuf is not None:
                 out, self._spec_buf = out[:-1], out[-1]
             out, self._hist_buf = out[:-1], out[-1]

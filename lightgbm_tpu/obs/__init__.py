@@ -1,6 +1,6 @@
 """lightgbm_tpu.obs: the unified observability layer (docs/Observability.md).
 
-Four pieces, one spine:
+Six pieces, one spine:
 
  * :mod:`~lightgbm_tpu.obs.trace`    — structured span tracer; Chrome-trace
    JSON via ``LIGHTGBM_TPU_TRACE=<path>``, Perfetto-viewable, device-aligned
@@ -10,6 +10,13 @@ Four pieces, one spine:
    retraces after warmup.
  * :mod:`~lightgbm_tpu.obs.memwatch` — device-memory snapshots at named
    points + shape-math attribution of the known large carries.
+ * :mod:`~lightgbm_tpu.obs.costs`    — measured XLA cost analysis per core
+   executable (flops / bytes via ``lower().compile().cost_analysis()``,
+   env-gated ``LIGHTGBM_TPU_COSTS=1``) + the per-``device_kind`` roofline
+   peak table bench.py reads.
+ * :mod:`~lightgbm_tpu.obs.prof`     — the segment profiler: tree growth as
+   separately-dispatched fenced sub-steps (``LIGHTGBM_TPU_PROF_SEGMENTS``),
+   proven bitwise-identical to the fused grower.
  * :mod:`~lightgbm_tpu.obs.registry` — the one metrics registry (counters /
    gauges / histograms / rates) behind the serve ``/metrics`` Prometheus
    endpoint, the training callback, and the bench/bringup run reports.
@@ -18,8 +25,12 @@ Importing this package never touches a jax backend.
 """
 from __future__ import annotations
 
-from . import memwatch, registry, retrace, trace  # noqa: F401
+from . import costs, memwatch, registry, retrace, trace  # noqa: F401
 from .registry import REGISTRY, MetricsRegistry  # noqa: F401
+
+# NOTE: obs.prof is imported lazily by its callers (it pulls ops/ modules,
+# which import jax-heavy code paths this package promises to avoid at
+# import time).
 
 # cross-wiring: the default registry's watchdog/memory gauges pull live
 # values at read time, so any exposition (serve /metrics, run_report) is
@@ -31,10 +42,13 @@ REGISTRY.gauge(
     "jit_retraces_after_warmup"
 ).set_fn(lambda: float(retrace.WATCHDOG.total_retraces()))
 REGISTRY.gauge("device_peak_bytes").set_fn(memwatch.peak_device_bytes)
+# the measured-cost book rides in every run report (empty dict -> omitted)
+REGISTRY.register_report_section("cost_analysis", costs.COSTS.report)
 
 __all__ = [
     "REGISTRY",
     "MetricsRegistry",
+    "costs",
     "memwatch",
     "registry",
     "retrace",

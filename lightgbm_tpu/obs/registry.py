@@ -202,7 +202,19 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
+        self._sections: Dict[str, Callable[[], object]] = {}
         self._lock = threading.Lock()
+
+    def register_report_section(
+        self, name: str, fn: Callable[[], object]
+    ) -> None:
+        """Attach a pull section to ``run_report()``: ``fn()`` is called at
+        report time and its JSON-able return lands under ``name`` (skipped
+        when empty/None or raising — a section must never break a report).
+        The cost-analysis book (obs/costs.py) and the segment profiler
+        (obs/prof.py) publish their structured blocks this way."""
+        with self._lock:
+            self._sections[name] = fn
 
     def _get_or_create(self, name: str, factory, kind) -> object:
         with self._lock:
@@ -331,12 +343,22 @@ class MetricsRegistry:
                     k: (round(v, 6) if isinstance(v, float) else v)
                     for k, v in snap.items()
                 }
-        return {
+        out: Dict[str, object] = {
             "counters": counters,
             "gauges": gauges,
             "summaries": summaries,
             "rates": rates,
         }
+        with self._lock:
+            sections = list(self._sections.items())
+        for name, fn in sorted(sections):
+            try:
+                block = fn()
+            except Exception:
+                continue  # a report section must never break the report
+            if block:
+                out[name] = block
+        return out
 
 
 def _num(v: float) -> str:

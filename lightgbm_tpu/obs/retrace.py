@@ -35,6 +35,7 @@ from typing import Dict, Iterable, Optional
 
 from ..utils import log
 from ..utils.log import LightGBMError
+from . import registry as registry_mod
 
 ENV_RETRACE = "LIGHTGBM_TPU_RETRACE"
 
@@ -58,6 +59,14 @@ class RetraceWatchdog:
         with self._lock:
             count = self._counts[name] = self._counts.get(name, 0) + 1
             retrace = self._armed and name in self._warm
+        # labeled per-name compile count, published next to the xla_cost_*
+        # gauges (obs/costs.py) so ONE /metrics scrape answers "what
+        # compiled, how big, how hot" — the aggregate jit_traces_total pull
+        # gauge (obs/__init__.py) stays for dashboards that sum anyway
+        try:
+            registry_mod.REGISTRY.gauge("jit_traces").set(count, name=name)
+        except Exception as e:  # metrics must never break a trace
+            log.debug("retrace: jit_traces gauge update failed: %r" % e)
         if retrace:
             msg = (
                 "jit retrace after warmup: %r compiled again (%d traces "

@@ -41,7 +41,7 @@ converts thresholds to real values with the BinMappers for prediction on raw dat
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple, Optional, Tuple
+from typing import Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -289,6 +289,272 @@ def _ceil_log2(n: int) -> int:
 
 MIN_BUCKET_LOG2 = 8  # smallest gathered-segment bucket (256 rows)
 
+
+def _branch_steps(cap: int):
+    """Branch-size family up to ``cap``, honoring the same
+    LIGHTGBM_TPU_LATTICE compile-cost knob as the bucket lattice:
+    branches execute ALL their lanes, so the default {2^k, 3*2^(k-1)}
+    family caps round-up waste at 33% (pure powers of two allow 2x),
+    while pow2/coarse trade waste for fewer compiled branches."""
+    fam = set()
+    k = 0
+    while (1 << k) < cap * 2:
+        if _ENV_LATTICE != "coarse" or k % 2 == 0:
+            fam.add(1 << k)
+        if _ENV_LATTICE == "":
+            fam.add(3 << k)
+        k += 1
+    return sorted({min(v, cap) for v in fam} | {cap})
+
+
+class BucketKernels(NamedTuple):
+    """The bucketed grower's SEGMENT SEAMS: the per-split partition and
+    segment-histogram kernels, extracted from grow_tree so the fused
+    while_loop grower and the segmented profiler (obs/prof.py) trace the
+    exact same ops — the bitwise-identity guarantee between the two comes
+    from sharing THIS code, not from a tolerance."""
+
+    #: (order, begin[W], pcnt[W], feat[W], thr[W], dleft[W], member[W, B])
+    #: -> (new order, left physical counts [W])
+    partition_batch: Callable
+    #: (vals_all [N, 3], order, begin[W], cnt[W]) -> [W, F, B_hist, 3]
+    segment_histogram_batch: Callable
+    sizes: Tuple[int, ...]  # gathered-segment bucket lattice
+    part_sizes: Tuple[int, ...]  # flat-partition branch lattice
+
+
+def make_bucket_kernels(
+    bins: jax.Array,
+    feature_meta: Dict[str, jax.Array],
+    num_bins: int,
+    num_group_bins: Optional[int] = None,
+    bins_nf: Optional[jax.Array] = None,
+    chunk: int = 4096,
+    hist_dtype: str = "float32",
+    feature_sharded: bool = False,
+    kb: int = 0,
+) -> BucketKernels:
+    """Build the bucketed partition / segment-histogram kernels for one
+    dataset layout. ``kb`` is the speculative-batch width the caller will
+    trace with (it only widens the flat-partition branch lattice's cap);
+    the sequential profiler passes 0. Bodies are the ones grow_tree always
+    traced — moved, not rewritten."""
+    N = bins.shape[1]
+    B = num_bins
+    F = feature_meta["num_bin"].shape[0]
+    f32 = jnp.float32
+    num_bin_arr = feature_meta["num_bin"].astype(jnp.int32)
+    missing_arr = feature_meta["missing_type"].astype(jnp.int32)
+    default_bin_arr = feature_meta["default_bin"].astype(jnp.int32)
+    is_cat_arr = feature_meta.get("is_categorical")
+    if is_cat_arr is None:
+        is_cat_arr = jnp.zeros((F,), bool)
+    else:
+        is_cat_arr = is_cat_arr.astype(bool)
+    bundled = "group_id" in feature_meta
+    if bundled:
+        gid_arr = feature_meta["group_id"].astype(jnp.int32)  # [F]
+        off_arr = feature_meta["bin_offset"].astype(jnp.int32)  # [F]
+        B_hist = num_group_bins if num_group_bins is not None else B
+
+        def decode_col(group_col, f):
+            """Group-encoded column -> feature f's sub-bins (efb.decode_subbin)."""
+            r = group_col - off_arr[f]
+            in_range = (r >= 0) & (r < num_bin_arr[f] - 1)
+            s = r + (r >= default_bin_arr[f]).astype(jnp.int32)
+            return jnp.where(in_range, s, default_bin_arr[f])
+    else:
+        B_hist = B
+
+    # gathered-segment bucket sizes for the bucketed partition/histogram:
+    # the {2^k} ∪ {3·2^k} lattice (x1.33/x1.5 steps) caps round-up waste at
+    # 33% where pure powers of two waste up to 2x — worth ~15% of total
+    # histogram work at large shapes for ~1.6x the switch branches.
+    # _ENV_LATTICE (import-time, like histogram._ENV_IMPL) trades bounded
+    # histogram over-work for lax.switch branch count and therefore
+    # first-contact compile time (20-40s+ per branch class on TPU).
+    step = 2 if _ENV_LATTICE == "coarse" else 1
+    sizes = {
+        min(1 << b, N)
+        for b in range(MIN_BUCKET_LOG2, _ceil_log2(N) + 1, step)
+    }
+    if _ENV_LATTICE == "":
+        sizes |= {
+            min(3 << b, N)
+            for b in range(MIN_BUCKET_LOG2 - 1, _ceil_log2(N) + 1)
+        }
+    SIZES = sorted(sizes | {N})
+    sizes_arr = jnp.asarray(SIZES, jnp.int32)
+
+    # flat-partition branch lattice over 256-row units, up to the worst
+    # case (every row plus per-slot 256-alignment)
+    _part_cap = -(-N // 256) * 256 + max(kb, 1) * 256
+    _part_sizes = [
+        u * 256 for u in _branch_steps(-(-_part_cap // 256))
+    ]
+    _part_sizes_arr = jnp.asarray(_part_sizes, jnp.int32)
+
+    def partition_batch(order, begin, pcnt, feat, thr, dleft, member):
+        """Stably partition W disjoint leaf segments in ONE flat segmented
+        pass; returns (new order, left physical counts [W]). The W axis is
+        the leading axis of every operand; W=1 is the sequential grower's
+        per-split partition, W=KB a speculative batch — one implementation,
+        so the two modes cannot drift, and arithmetic is proportional to the
+        segments' TOTAL rows (a vmapped common-max form would pay
+        W x max(segment)).
+
+        Layout after a partition (DataPartition::Split, data_partition.hpp:111):
+        [pre-segment | left | right | post-segment], stably, via a segmented
+        prefix-sum rank — O(L) scatter instead of an O(L log L) stable sort.
+        Integer-exact and idempotent: re-partitioning an already-partitioned
+        segment yields the same layout, so work done for a speculated-but-
+        unapplied split stays valid when that leaf wins later."""
+        W = begin.shape[0]
+        miss = missing_arr[feat]
+        dbin = default_bin_arr[feat]
+        nanb = num_bin_arr[feat] - 1
+        iscat = is_cat_arr[feat]
+        rows_of = (gid_arr[feat] if bundled else feat).astype(jnp.int32)
+        Frows = bins.shape[0]
+
+        padded = ((pcnt + 255) // 256) * 256  # [W]
+        ends = jnp.cumsum(padded)
+        offs = ends - padded
+        L = ends[-1]
+
+        def make_branch(Lb):
+            def branch(order, begin, pcnt, offs, ends, rows_of, feat, thr,
+                       dleft, miss, dbin, nanb, iscat, member):
+                t = jnp.arange(Lb, dtype=jnp.int32)
+                j = jnp.minimum(
+                    jnp.searchsorted(ends, t, side="right").astype(jnp.int32),
+                    W - 1,
+                )
+                q = t - offs[j]
+                valid = q < pcnt[j]
+                src = jnp.clip(
+                    begin[j] + jnp.minimum(q, jnp.maximum(pcnt[j] - 1, 0)),
+                    0, N - 1,
+                )
+                rows = order[src]
+                # per-row feature column through ONE flat gather (each row's
+                # slot picks its own split feature)
+                flat_idx = rows_of[j] * N + rows
+                colraw = (
+                    jnp.take(bins_nf.reshape(-1), rows * Frows + rows_of[j])
+                    if bins_nf is not None
+                    else jnp.take(bins.reshape(-1), flat_idx)
+                ).astype(jnp.int32)
+                colv = decode_col(colraw, feat[j]) if bundled else colraw
+                gl = _decision_go_left(
+                    colv, thr[j], dleft[j], miss[j], dbin[j], nanb[j],
+                    iscat[j], member[j, jnp.clip(colv, 0, B - 1)],
+                )
+                is_left = valid & gl
+                is_right = valid & ~gl
+                # segmented inclusive count of lefts (resets at slot starts);
+                # int adds are reassociation-exact
+                seg_start = t == offs[j]
+
+                def comb(a, b):
+                    av, af = a
+                    bv, bf = b
+                    return jnp.where(bf, bv, av + bv), af | bf
+
+                lc_inc, _ = jax.lax.associative_scan(
+                    comb, (is_left.astype(jnp.int32), seg_start)
+                )
+                # lefts per slot = inclusive count at the slot's last lane
+                # (pad lanes contribute 0); zero-width slots read a stale
+                # lane and are masked to 0
+                left_cnt = jnp.where(
+                    padded > 0, lc_inc[jnp.maximum(ends - 1, 0)], 0
+                )
+                tgt_local = jnp.where(
+                    is_left,
+                    lc_inc - 1,
+                    left_cnt[j] + q - lc_inc,
+                )
+                write = is_left | is_right
+                gt = jnp.where(write, begin[j] + tgt_local, N + t)
+                order2 = order.at[gt].set(rows, unique_indices=True)
+                return order2, left_cnt
+
+            return branch
+
+        idx = jnp.clip(
+            jnp.searchsorted(_part_sizes_arr, L, side="left"),
+            0, len(_part_sizes) - 1,
+        )
+        return jax.lax.switch(
+            idx, [make_branch(Lb) for Lb in _part_sizes],
+            order, begin, pcnt, offs, ends, rows_of, feat, thr, dleft, miss,
+            dbin, nanb, iscat, member,
+        )
+
+    def segment_histogram_batch(vals_all, order, begin, cnt):
+        """[W, F, B, 3] histograms of W disjoint segments via ONE lattice-
+        switch launch: one fused gather for all segments, then a vmapped
+        chunked pass. W=1 is the sequential per-split histogram, W=KB a
+        speculative batch — the launch amortization that attacks the
+        per-split fixed cost dominating the r4 on-silicon breakdown.
+
+        Cost tracks leaf size like the reference's ordered-index histograms
+        (dense_bin.hpp:71); one gather from the precomputed [N, 3]
+        (grad*bag, hess*bag, bag) instead of three masked takes — bag/valid
+        are exact {0,1} multipliers so the product order cannot change f32
+        results."""
+        W = begin.shape[0]
+        Frows = bins.shape[0]
+
+        def make_branch(S):
+            def branch(vals_all, order, begin, cnt):
+                def geo(begin_j, cnt_j):
+                    # zero-based (NOT the clamped _segment_slice window):
+                    # real rows sit at positions [0, cnt) so chunk
+                    # boundaries are segment-relative — the invariant that
+                    # makes the flat batched form bitwise-identical
+                    pos = jnp.arange(S, dtype=jnp.int32)
+                    seg = order[jnp.clip(begin_j + pos, 0, N - 1)]
+                    return seg, pos < cnt_j
+
+                seg, valid = jax.vmap(geo)(begin, cnt)  # [W, S]
+                flat = seg.reshape(-1)
+                vals = jnp.take(vals_all, flat, axis=0).reshape(W, S, 3)
+                vals = vals * valid[..., None].astype(f32)
+                if bins_nf is not None:
+                    b_seg = jnp.take(bins_nf, flat, axis=0).reshape(
+                        W, S, Frows
+                    ).transpose(0, 2, 1)
+                else:
+                    b_seg = jnp.take(bins, flat, axis=1).reshape(
+                        Frows, W, S
+                    ).transpose(1, 0, 2)
+                return jax.vmap(
+                    lambda b, v: leaf_histogram(
+                        b, v, B_hist, chunk=chunk, hist_dtype=hist_dtype,
+                        feature_sharded=feature_sharded,
+                    )
+                )(b_seg, vals)
+
+            return branch
+
+        idx = jnp.clip(
+            jnp.searchsorted(sizes_arr, jnp.max(cnt), side="left"),
+            0, len(SIZES) - 1,
+        )
+        return jax.lax.switch(
+            idx, [make_branch(S) for S in SIZES], vals_all, order, begin, cnt
+        )
+
+    return BucketKernels(
+        partition_batch=partition_batch,
+        segment_histogram_batch=segment_histogram_batch,
+        sizes=tuple(SIZES),
+        part_sizes=tuple(_part_sizes),
+    )
+
+
 # node_i column indices for apply_split's fused 6-element scatter (numpy so
 # the module builds it once without touching the jax backend at import)
 _NODE_I_COLS = np.array([0, 1, 2, 3, 2, 3], np.int32)
@@ -515,214 +781,34 @@ def grow_tree(
     else:
         is_cat_arr = is_cat_arr.astype(bool)
 
-    # gathered-segment bucket sizes for the bucketed partition/histogram:
-    # the {2^k} ∪ {3·2^k} lattice (x1.33/x1.5 steps) caps round-up waste at
-    # 33% where pure powers of two waste up to 2x — worth ~15% of total
-    # histogram work at large shapes for ~1.6x the switch branches.
-    # _ENV_LATTICE (import-time, like histogram._ENV_IMPL) trades bounded
-    # histogram over-work for lax.switch branch count and therefore
-    # first-contact compile time (20-40s+ per branch class on TPU).
+    # Bucketed partition / segment-histogram kernels come from the shared
+    # seam factory (make_bucket_kernels above): one implementation serves
+    # the fused while_loop grower here AND the segmented profiler
+    # (obs/prof.py), so the two can never drift numerically.
     if bucketed:
-        step = 2 if _ENV_LATTICE == "coarse" else 1
-        sizes = {
-            min(1 << b, N)
-            for b in range(MIN_BUCKET_LOG2, _ceil_log2(N) + 1, step)
-        }
-        if _ENV_LATTICE == "":
-            sizes |= {
-                min(3 << b, N)
-                for b in range(MIN_BUCKET_LOG2 - 1, _ceil_log2(N) + 1)
-            }
-        SIZES = sorted(sizes | {N})
-        sizes_arr = jnp.asarray(SIZES, jnp.int32)
-        def _branch_steps(cap: int):
-            """Branch-size family up to ``cap``, honoring the same
-            LIGHTGBM_TPU_LATTICE compile-cost knob as the bucket lattice:
-            branches execute ALL their lanes, so the default {2^k, 3*2^(k-1)}
-            family caps round-up waste at 33% (pure powers of two allow 2x),
-            while pow2/coarse trade waste for fewer compiled branches."""
-            fam = set()
-            k = 0
-            while (1 << k) < cap * 2:
-                if _ENV_LATTICE != "coarse" or k % 2 == 0:
-                    fam.add(1 << k)
-                if _ENV_LATTICE == "":
-                    fam.add(3 << k)
-                k += 1
-            return sorted({min(v, cap) for v in fam} | {cap})
-
-        # flat-partition branch lattice over 256-row units, up to the worst
-        # case (every row plus per-slot 256-alignment)
-        _part_cap = -(-N // 256) * 256 + max(KB, 1) * 256
-        _part_sizes = [
-            u * 256 for u in _branch_steps(-(-_part_cap // 256))
-        ]
-        _part_sizes_arr = jnp.asarray(_part_sizes, jnp.int32)
-
-    def partition_batch(order, begin, pcnt, feat, thr, dleft, member):
-        """Stably partition W disjoint leaf segments in ONE flat segmented
-        pass; returns (new order, left physical counts [W]). The W axis is
-        the leading axis of every operand; W=1 is the sequential grower's
-        per-split partition, W=KB a speculative batch — one implementation,
-        so the two modes cannot drift, and arithmetic is proportional to the
-        segments' TOTAL rows (a vmapped common-max form would pay
-        W x max(segment)).
-
-        Layout after a partition (DataPartition::Split, data_partition.hpp:111):
-        [pre-segment | left | right | post-segment], stably, via a segmented
-        prefix-sum rank — O(L) scatter instead of an O(L log L) stable sort.
-        Integer-exact and idempotent: re-partitioning an already-partitioned
-        segment yields the same layout, so work done for a speculated-but-
-        unapplied split stays valid when that leaf wins later."""
-        W = begin.shape[0]
-        miss = missing_arr[feat]
-        dbin = default_bin_arr[feat]
-        nanb = num_bin_arr[feat] - 1
-        iscat = is_cat_arr[feat]
-        rows_of = (gid_arr[feat] if bundled else feat).astype(jnp.int32)
-        Frows = bins.shape[0]
-
-        padded = ((pcnt + 255) // 256) * 256  # [W]
-        ends = jnp.cumsum(padded)
-        offs = ends - padded
-        L = ends[-1]
-
-        def make_branch(Lb):
-            def branch(order, begin, pcnt, offs, ends, rows_of, feat, thr,
-                       dleft, miss, dbin, nanb, iscat, member):
-                t = jnp.arange(Lb, dtype=jnp.int32)
-                j = jnp.minimum(
-                    jnp.searchsorted(ends, t, side="right").astype(jnp.int32),
-                    W - 1,
-                )
-                q = t - offs[j]
-                valid = q < pcnt[j]
-                src = jnp.clip(
-                    begin[j] + jnp.minimum(q, jnp.maximum(pcnt[j] - 1, 0)),
-                    0, N - 1,
-                )
-                rows = order[src]
-                # per-row feature column through ONE flat gather (each row's
-                # slot picks its own split feature)
-                flat_idx = rows_of[j] * N + rows
-                colraw = (
-                    jnp.take(bins_nf.reshape(-1), rows * Frows + rows_of[j])
-                    if bins_nf is not None
-                    else jnp.take(bins.reshape(-1), flat_idx)
-                ).astype(jnp.int32)
-                colv = decode_col(colraw, feat[j]) if bundled else colraw
-                gl = _decision_go_left(
-                    colv, thr[j], dleft[j], miss[j], dbin[j], nanb[j],
-                    iscat[j], member[j, jnp.clip(colv, 0, B - 1)],
-                )
-                is_left = valid & gl
-                is_right = valid & ~gl
-                # segmented inclusive count of lefts (resets at slot starts);
-                # int adds are reassociation-exact
-                seg_start = t == offs[j]
-
-                def comb(a, b):
-                    av, af = a
-                    bv, bf = b
-                    return jnp.where(bf, bv, av + bv), af | bf
-
-                lc_inc, _ = jax.lax.associative_scan(
-                    comb, (is_left.astype(jnp.int32), seg_start)
-                )
-                # lefts per slot = inclusive count at the slot's last lane
-                # (pad lanes contribute 0); zero-width slots read a stale
-                # lane and are masked to 0
-                left_cnt = jnp.where(
-                    padded > 0, lc_inc[jnp.maximum(ends - 1, 0)], 0
-                )
-                tgt_local = jnp.where(
-                    is_left,
-                    lc_inc - 1,
-                    left_cnt[j] + q - lc_inc,
-                )
-                write = is_left | is_right
-                gt = jnp.where(write, begin[j] + tgt_local, N + t)
-                order2 = order.at[gt].set(rows, unique_indices=True)
-                return order2, left_cnt
-
-            return branch
-
-        idx = jnp.clip(
-            jnp.searchsorted(_part_sizes_arr, L, side="left"),
-            0, len(_part_sizes) - 1,
+        _kern = make_bucket_kernels(
+            bins, feature_meta, B, num_group_bins=num_group_bins,
+            bins_nf=bins_nf, chunk=chunk, hist_dtype=hist_dtype,
+            feature_sharded=feature_sharded, kb=KB,
         )
-        return jax.lax.switch(
-            idx, [make_branch(Lb) for Lb in _part_sizes],
-            order, begin, pcnt, offs, ends, rows_of, feat, thr, dleft, miss,
-            dbin, nanb, iscat, member,
-        )
+        partition_batch = _kern.partition_batch
 
-    def partition_segment(order, begin, pcnt, f, threshold, default_left, member):
-        """One split's partition — the W=1 case of partition_batch."""
-        order2, left_cnt = partition_batch(
-            order, begin[None], pcnt[None], f[None], threshold[None],
-            default_left[None], member[None],
-        )
-        return order2, left_cnt[0]
+        def segment_histogram_batch(order, begin, cnt):
+            # vals_all (the per-tree [N, 3] accumulands) binds below, before
+            # the first call
+            return _kern.segment_histogram_batch(vals_all, order, begin, cnt)
 
-    def segment_histogram_batch(order, begin, cnt):
-        """[W, F, B, 3] histograms of W disjoint segments via ONE lattice-
-        switch launch: one fused gather for all segments, then a vmapped
-        chunked pass. W=1 is the sequential per-split histogram, W=KB a
-        speculative batch — the launch amortization that attacks the
-        per-split fixed cost dominating the r4 on-silicon breakdown.
+        def partition_segment(order, begin, pcnt, f, threshold, default_left, member):
+            """One split's partition — the W=1 case of partition_batch."""
+            order2, left_cnt = partition_batch(
+                order, begin[None], pcnt[None], f[None], threshold[None],
+                default_left[None], member[None],
+            )
+            return order2, left_cnt[0]
 
-        Cost tracks leaf size like the reference's ordered-index histograms
-        (dense_bin.hpp:71); one gather from the precomputed [N, 3]
-        (grad*bag, hess*bag, bag) instead of three masked takes — bag/valid
-        are exact {0,1} multipliers so the product order cannot change f32
-        results."""
-        W = begin.shape[0]
-        Frows = bins.shape[0]
-
-        def make_branch(S):
-            def branch(order, begin, cnt):
-                def geo(begin_j, cnt_j):
-                    # zero-based (NOT the clamped _segment_slice window):
-                    # real rows sit at positions [0, cnt) so chunk
-                    # boundaries are segment-relative — the invariant that
-                    # makes the flat batched form bitwise-identical
-                    pos = jnp.arange(S, dtype=jnp.int32)
-                    seg = order[jnp.clip(begin_j + pos, 0, N - 1)]
-                    return seg, pos < cnt_j
-
-                seg, valid = jax.vmap(geo)(begin, cnt)  # [W, S]
-                flat = seg.reshape(-1)
-                vals = jnp.take(vals_all, flat, axis=0).reshape(W, S, 3)
-                vals = vals * valid[..., None].astype(f32)
-                if bins_nf is not None:
-                    b_seg = jnp.take(bins_nf, flat, axis=0).reshape(
-                        W, S, Frows
-                    ).transpose(0, 2, 1)
-                else:
-                    b_seg = jnp.take(bins, flat, axis=1).reshape(
-                        Frows, W, S
-                    ).transpose(1, 0, 2)
-                return jax.vmap(
-                    lambda b, v: leaf_histogram(
-                        b, v, B_hist, chunk=chunk, hist_dtype=hist_dtype,
-                        feature_sharded=feature_sharded,
-                    )
-                )(b_seg, vals)
-
-            return branch
-
-        idx = jnp.clip(
-            jnp.searchsorted(sizes_arr, jnp.max(cnt), side="left"),
-            0, len(SIZES) - 1,
-        )
-        return jax.lax.switch(
-            idx, [make_branch(S) for S in SIZES], order, begin, cnt
-        )
-
-    def segment_histogram(order, begin, cnt):
-        """One segment's histogram — the W=1 case of the batch launch."""
-        return segment_histogram_batch(order, begin[None], cnt[None])[0]
+        def segment_histogram(order, begin, cnt):
+            """One segment's histogram — the W=1 case of the batch launch."""
+            return segment_histogram_batch(order, begin[None], cnt[None])[0]
 
     if KB:
         from .histogram import _pick_chunk, onehot_chunk_partial

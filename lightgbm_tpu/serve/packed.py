@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models.model_text import model_fingerprint, save_model_to_string
+from ..obs import costs as costs_mod
 from ..models.tree import (
     K_CATEGORICAL_MASK,
     K_DEFAULT_LEFT_MASK,
@@ -135,9 +136,13 @@ class PackedEnsemble:
         """[N, T] int32 leaf indices (== Booster.predict(pred_leaf=True))."""
         X = self._check_width(X)
         codes, isnan = self._host_codes(X)
-        leaves = packed_predict_leaves(
-            jnp.asarray(codes), jnp.asarray(isnan), self.packed
-        )
+        codes_dev, isnan_dev = jnp.asarray(codes), jnp.asarray(isnan)
+        leaves = packed_predict_leaves(codes_dev, isnan_dev, self.packed)
+        if costs_mod.enabled():
+            costs_mod.COSTS.harvest(
+                "ops.packed_predict_leaves", packed_predict_leaves,
+                (codes_dev, isnan_dev, self.packed),
+            )
         return np.asarray(leaves).T.astype(np.int32)
 
     def predict_raw(self, X: np.ndarray) -> np.ndarray:
@@ -175,11 +180,25 @@ class PackedEnsemble:
         """[K, N] f32 scores from f32 raw rows — one jitted dispatch
         (bin + traverse + sum). Device in, device out; callers slice/convert."""
         codes, isnan = packed_bin_rows(X_dev, self.bounds_dev, self.is_cat_dev)
-        return packed_predict_values(
+        out = packed_predict_values(
             codes, isnan, self.packed,
             num_class=self.num_tree_per_iteration,
             average_output=self.average_output,
         )
+        if costs_mod.enabled():
+            # measured cost analysis for the serving executables, keyed by
+            # the retrace-watchdog names; deduped per shape inside the book
+            costs_mod.COSTS.harvest(
+                "ops.packed_bin_rows", packed_bin_rows,
+                (X_dev, self.bounds_dev, self.is_cat_dev),
+            )
+            costs_mod.COSTS.harvest(
+                "ops.packed_predict_values", packed_predict_values,
+                (codes, isnan, self.packed),
+                dict(num_class=self.num_tree_per_iteration,
+                     average_output=self.average_output),
+            )
+        return out
 
     def finalize_fused(self, out: np.ndarray, raw_score: bool = False) -> np.ndarray:
         """[K, N] f32 device scores -> the ``predict`` output convention

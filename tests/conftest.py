@@ -59,7 +59,8 @@ _QUICK_MODULES = {
     "test_binning_equiv", "test_bringup_stages", "test_device_chunk",
     "test_errors", "test_graftlint", "test_hist_modes", "test_metric_alias",
     "test_micro_exact", "test_model_io", "test_native", "test_obs",
-    "test_ops", "test_param_docs", "test_resil", "test_serve_packed",
+    "test_ops", "test_param_docs", "test_prof", "test_resil",
+    "test_serve_packed",
     "test_serve_resil", "test_serve_server", "test_snapshot_timers",
     "test_vfile",
 }

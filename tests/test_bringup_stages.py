@@ -13,7 +13,7 @@ import helpers.tpu_bringup as tb
 STAGES = (
     "MATMUL", "PALLAS", "PACK4", "SMOKE", "SMOKE_SEQ", "SMOKE_PALLAS",
     "SMOKE_XLA_RADIX", "SMOKE_BF16", "SMOKE_PSPLIT", "BENCH_CHUNK",
-    "BENCH_PREDICT",
+    "BENCH_PREDICT", "PROF",
 )
 
 
@@ -27,7 +27,7 @@ def test_stage_table_complete():
     assert set(tb.STAGE_TIMEOUTS) == {
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "bench_early",
         "smoke_pallas", "smoke_xla_radix", "smoke_bf16", "smoke_psplit",
-        "bench_chunk", "bench_predict", "bench",
+        "bench_chunk", "bench_predict", "prof", "bench",
     }
 
 
@@ -73,6 +73,33 @@ def test_bench_predict_measures_serving_numbers():
     assert tb.BENCH_PREDICT.index("LIGHTGBM_TPU_LATTICE") < tb.BENCH_PREDICT.index(
         "import lightgbm_tpu"
     )
+
+
+def test_prof_stage_records_attribution():
+    """The prof stage (ISSUE 6) must emit the segment breakdown, the
+    bitwise-identity verdict and the cost-analysis book, with the env knobs
+    set before the import (they are read at import/call time)."""
+    for needle in ("growth_segments_s", "bitwise_identical",
+                   "segment_sum_ratio", "cost_analysis", "profile_growth",
+                   "unsupported_reason"):
+        assert needle in tb.PROF, needle
+    assert tb.PROF.index("LIGHTGBM_TPU_COSTS") < tb.PROF.index(
+        "import lightgbm_tpu"
+    )
+
+
+def test_bench_diff_verdict_wiring():
+    """Every bringup round stamps a regression verdict vs the previous
+    on-chip record; the helper is stdlib-only and non-fatal."""
+    assert tb._bench_diff_verdict(None, {"metric": "x"})["status"] == "SKIP"
+    prev = {"metric": "higgs1m_boost_iters_per_sec", "value": 2.0,
+            "platform": "tpu", "t": "2026-01-01"}
+    good = {"metric": "higgs1m_boost_iters_per_sec", "value": 2.2,
+            "platform": "tpu", "ok": True, "wall_s": 1.0}
+    bad = {"metric": "higgs1m_boost_iters_per_sec", "value": 1.0,
+           "platform": "tpu", "ok": True, "wall_s": 1.0}
+    assert tb._bench_diff_verdict(prev, good)["status"] == "PASS"
+    assert tb._bench_diff_verdict(prev, bad)["status"] == "FAIL"
 
 
 def test_smoke_emits_model_hash():
