@@ -13,6 +13,9 @@ apply per-metric thresholds and emit a markdown verdict table:
   * ``predict.p99_ms`` rise > 25%                      -> WARN
   * ``growth_segments_s`` share shift > 10 points      -> WARN
   * ``roofline_source`` measured -> analytic           -> WARN
+  * serve drift alert counted / PSI gauge > 0.2        -> WARN
+    (serve/drift.py: drifted input invalidates comparisons but is a data
+    condition, not a code regression)
 
 Throughput comparisons apply only between records from the SAME platform —
 a CPU-fallback capture vs an on-chip record is apples-to-oranges and every
@@ -181,6 +184,30 @@ def compare(
         status = WARN if (brs == "measured" and crs != "measured") else PASS
         rows.append(_row("roofline_source", brs, crs, "no measured->analytic",
                          status, ""))
+
+    # serve feature drift (serve/drift.py): any PSI alert in the current
+    # capture, or a tracked PSI gauge above 0.2, is a WARN — drifted input
+    # makes every other row's comparison suspect (the model was measured
+    # against traffic it wasn't trained on), but it is a data condition,
+    # not a code regression, so it never FAILs the gate
+    obs = current.get("obs_report") or {}
+    drift_alerts = sum(
+        v for k, v in (obs.get("counters") or {}).items()
+        if k.startswith("serve_drift_alerts")
+    )
+    drift_psis = {
+        k: float(v) for k, v in (obs.get("gauges") or {}).items()
+        if k.startswith("serve_drift_psi")
+    }
+    if drift_alerts or drift_psis:
+        worst_k = max(drift_psis, key=drift_psis.get) if drift_psis else None
+        worst_v = drift_psis.get(worst_k, 0.0) if worst_k else 0.0
+        status = WARN if (drift_alerts > 0 or worst_v > 0.2) else PASS
+        rows.append(_row(
+            "serve_drift", None,
+            "%d alert(s)" % int(drift_alerts), "0 alerts, psi<=0.2", status,
+            "max psi %.3f (%s)" % (worst_v, worst_k) if worst_k else "",
+        ))
 
     # growth-segment share drift (profiler breakdown, obs/prof.py)
     bs = baseline.get("growth_segments_s") or {}

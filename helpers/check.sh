@@ -18,6 +18,10 @@
 #                               # SIGKILL a checkpointing training run at an
 #                               # injected fault site, resume bit-identically;
 #                               # SIGTERM-drain the real server mid-flight
+#   helpers/check.sh --drift    # lint gate, then the model/data-observability
+#                               # smoke: flight-recorded train (JSONL schema),
+#                               # drift-monitored serve (shifted traffic must
+#                               # alert, in-dist must not), HTML run report
 #   helpers/check.sh --prof     # lint gate, then the performance-attribution
 #                               # smoke: segment-profiled mini-train —
 #                               # breakdown structure + fused-vs-segmented
@@ -40,9 +44,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -96,6 +100,11 @@ fi
 if [ "$MODE" = "--prof" ]; then
     echo "== prof smoke (segment breakdown + bitwise identity + cost analysis) =="
     exec env JAX_PLATFORMS=cpu python helpers/obs_smoke.py --prof
+fi
+
+if [ "$MODE" = "--drift" ]; then
+    echo "== drift smoke (flight JSONL + PSI separation + HTML report) =="
+    exec env JAX_PLATFORMS=cpu python helpers/obs_smoke.py --drift
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

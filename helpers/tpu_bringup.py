@@ -491,6 +491,35 @@ print(json.dumps({
 assert "profile_growth" in PROF and "bitwise_identical" in PROF
 
 
+def _render_report(summary: dict) -> str:
+    """Render the round's HTML run report (obs/report.py by file path):
+    the BENCH_r*.json series plus this round's bench record; returns the
+    output path (recorded in the summary)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "lgbtpu_obs_report",
+        os.path.join(REPO, "lightgbm_tpu", "obs", "report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bench_records = mod.load_bench_records(
+        os.path.join(REPO, "BENCH_r*.json")
+    )
+    bench = (summary.get("stages") or {}).get("bench") or {}
+    # the bench stage result IS the parsed bench record (run_bench); its
+    # obs_report block is what render() unwraps for the metrics sections
+    metrics = bench if "metric" in bench else None
+    html = mod.render(
+        metrics=metrics, bench_records=bench_records,
+        title="TPU bringup report (%s)" % summary.get("t", ""),
+    )
+    out = SUMMARY.replace(".json", "_report.html")
+    with open(out, "w", encoding="utf-8") as fh:
+        fh.write(html)
+    return out
+
+
 def _load_bench_diff():
     """helpers/bench_diff.py by FILE path (stdlib-only module), keeping this
     driver jax-free — same pattern as _load_backoff."""
@@ -765,6 +794,14 @@ def main() -> int:
     print("bringup: bench_diff -> %s" % summary["bench_diff"].get("status"),
           flush=True)
     summary["verdict"] = "ok" if ok else "bench failed"
+    # self-contained HTML run report next to the summary (obs/report.py,
+    # loaded by FILE path — stdlib-only, the driver stays jax-free): the
+    # BENCH_r* series + this round's obs_report render into the one
+    # artifact a bringup round attaches for humans
+    try:
+        summary["report_html"] = _render_report(summary)
+    except Exception as e:  # the report must never fail the round
+        print("bringup: report render failed: %r" % (e,), flush=True)
     _dump(summary)
     if _trace_path():
         from lightgbm_tpu.obs import trace as trace_mod

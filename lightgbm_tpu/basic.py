@@ -995,7 +995,38 @@ class Booster:
         atomic_write_text(
             filename, self.model_to_string(num_iteration, start_iteration)
         )
+        import os as _os
+
+        if _os.environ.get("LIGHTGBM_TPU_DRIFT_SIDECAR", "") not in ("", "0"):
+            # drift reference sidecar (<filename>.drift.json): the training
+            # set's bin occupancy mapped through the model lattice, for the
+            # serve-time drift monitor (serve/drift.py; docs/Serving.md).
+            # Env-gated + full-model only: a clipped save's lattice (or a
+            # start_iteration-shifted one) would not match what the sidecar
+            # fingerprints — serving would refuse it with a misleading
+            # "different model" warning.
+            if (num_iteration is not None and num_iteration > 0) or (
+                start_iteration or 0
+            ) > 0:
+                log.warning(
+                    "drift: sidecar skipped for %r (iteration-clipped "
+                    "save; use save_drift_reference on the full model)"
+                    % filename
+                )
+            else:
+                self.save_drift_reference(filename)
         return self
+
+    def save_drift_reference(self, model_filename: str) -> Optional[str]:
+        """Write ``<model_filename>.drift.json`` — the training-distribution
+        reference the serve-time drift monitor scores live traffic against
+        (serve/drift.py). Needs the live training set (call before
+        free_dataset); returns the sidecar path, or None when no reference
+        could be built. ``save_model`` emits it automatically under
+        ``LIGHTGBM_TPU_DRIFT_SIDECAR=1``."""
+        from .serve.drift import write_sidecar
+
+        return write_sidecar(model_filename, self)
 
     def model_to_string(self, num_iteration: int = -1, start_iteration: int = 0) -> str:
         s = save_model_to_string(self._gbdt, start_iteration, num_iteration)

@@ -114,6 +114,12 @@ def record_metrics(registry=None) -> Callable:
     ``train_eval_boundaries``. The registry defaults to the process-wide
     one, so a serving process that also trains exposes training progress on
     the same /metrics endpoint.
+
+    The training flight recorder (obs/flight.py, ``flight_record=``/
+    ``LIGHTGBM_TPU_FLIGHT``) captures the same per-boundary eval values —
+    plus per-tree stats and run events — into its JSONL log directly from
+    the boosting loop, so it works without this callback being attached;
+    attach this one when you want the LIVE gauge view on /metrics too.
     """
     from .obs import registry as registry_mod
 

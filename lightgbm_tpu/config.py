@@ -292,6 +292,16 @@ class Config:
     checkpoint_path: str = ""
     checkpoint_rounds: int = -1
     resume_from: str = ""
+    # Model/data observability (obs/flight.py, obs/modelstats.py,
+    # docs/Observability.md): flight_record=<path> writes a JSONL run-event
+    # log (manifest + per-iteration evals + per-tree gain/shape records);
+    # model_stats=true publishes importance-evolution / bin-occupancy /
+    # leaf-shape gauges and the model_stats run-report section. Both are
+    # POPPED by engine.train so the model's parameters footer is identical
+    # with observability on or off; LIGHTGBM_TPU_FLIGHT /
+    # LIGHTGBM_TPU_MODELSTATS are the env spellings.
+    flight_record: str = ""
+    model_stats: bool = False
     input_model: str = ""
     output_result: str = "LightGBM_predict_result.txt"
     initscore_filename: str = ""
@@ -500,6 +510,15 @@ class Config:
         return {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
 
 
+def coerce_bool(v: Any) -> bool:
+    """The ONE truthy-string vocabulary for bool parameters (shared by the
+    dataclass coercion below and engine.train's popped params, so a
+    spelling Config accepts can never be rejected by the pop path)."""
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes", "+", "t", "y")
+
+
 def _coerce(f: dataclasses.Field, v: Any):
     """Coerce a raw (possibly string) parameter value to the field's type."""
     ty = f.type
@@ -510,7 +529,7 @@ def _coerce(f: dataclasses.Field, v: Any):
         if ty in ("float", float):
             return float(sv)
         if ty in ("bool", bool):
-            return sv.lower() in ("true", "1", "yes", "+", "t", "y")
+            return coerce_bool(sv)
         if str(ty).startswith("List[int]") or "List[int]" in str(ty):
             return [int(float(x)) for x in sv.replace(" ", ",").split(",") if x != ""]
         if "List[float]" in str(ty):

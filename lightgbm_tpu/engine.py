@@ -14,10 +14,12 @@ import numpy as np
 
 from . import callback as callback_mod
 from .basic import Booster, Dataset
+from .obs import flight as flight_mod
 from .obs import registry as obs_registry
 from .obs import trace as trace_mod
 from .resil import faults
 from .utils import timer as timer_mod
+from . import config as config_mod
 from .config import Config
 from .utils import log
 from .utils.log import LightGBMError
@@ -63,6 +65,17 @@ def train(
     if "resume_from" in params:
         v = str(params.pop("resume_from"))
         resume_from = resume_from or v
+    # model/data observability params (docs/Observability.md): POPPED like
+    # the resil params so the model's parameters footer stays byte-identical
+    # with recording on or off — the bitwise-identity contract the
+    # flight-recorder tests assert
+    flight_path = None
+    if "flight_record" in params:
+        flight_path = str(params.pop("flight_record")) or None
+    flight_path = flight_path or flight_mod.env_path()
+    model_stats = False
+    if "model_stats" in params:
+        model_stats = config_mod.coerce_bool(params.pop("model_stats"))
     if resume_from and not checkpoint_path:
         # a resumed run keeps checkpointing to the file it resumed from: the
         # crash that made the checkpoint necessary can strike again, and a
@@ -202,14 +215,46 @@ def train(
             if chunk > 1 and isinstance(sr, int) and sr > 0:
                 chunk = min(chunk, sr)
 
-    evaluation_result_list: List = []
-    with timer_mod.maybe_profile():
-        evaluation_result_list = _boost_loop(
-            booster, params, fobj, feval, valid_sets, is_valid_contain_train,
-            train_data_name, init_iteration, num_boost_round,
-            cbs_before, cbs_after, chunk,
-            start_iteration=start_iteration, ckpt_writer=ckpt_writer,
+    # training flight recorder (obs/flight.py): run manifest now — the
+    # checkpoint restore above already positioned a resumed run, so the
+    # manifest's provenance fields are final. start() returning None (bad
+    # path, nested run) silently leaves recording off.
+    flight_rec = None
+    if flight_path:
+        flight_rec = flight_mod.start(
+            flight_path,
+            flight_mod.build_manifest(
+                booster, num_boost_round, init_iteration,
+                resume_from=resume_from, checkpoint_path=checkpoint_path,
+            ),
         )
+
+    evaluation_result_list: List = []
+    try:
+        with timer_mod.maybe_profile():
+            evaluation_result_list = _boost_loop(
+                booster, params, fobj, feval, valid_sets,
+                is_valid_contain_train, train_data_name, init_iteration,
+                num_boost_round, cbs_before, cbs_after, chunk,
+                start_iteration=start_iteration, ckpt_writer=ckpt_writer,
+            )
+        return _finish_train(
+            booster, evaluation_result_list, flight_rec, model_stats
+        )
+    finally:
+        # a crashed/interrupted run (anywhere — the loop, the deferred stop
+        # readback, the profiler, the harvest) still closes its flight log:
+        # the records up to the failure are exactly the evidence wanted,
+        # and a leaked _ACTIVE recorder would silently disable recording
+        # for every later train() in the process
+        if flight_rec is not None and flight_mod.active() is flight_rec:
+            flight_mod.note_event("aborted")
+            flight_mod.stop()
+
+
+def _finish_train(booster, evaluation_result_list, flight_rec, model_stats):
+    """Post-loop bookkeeping (split from train() so its flight-recorder
+    finally can distinguish a clean finish from an abort)."""
     # resolve the deferred no-split check before handing the booster back:
     # a stop inside the FINAL chunk (or final iteration) would otherwise
     # leave rolled-back-to-be trees visible to num_trees/current_iteration
@@ -248,6 +293,17 @@ def train(
         booster.best_score[dname][ename] = v
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration
+
+    # model/data observability tier (docs/Observability.md): both read only
+    # host state — the trained model is bitwise-unaffected and nothing new
+    # compiles. modelstats also engages whenever a flight log was recorded
+    # (one opt-in should yield the whole model-observability picture).
+    if flight_rec is not None:
+        flight_mod.finish_training(booster)
+    from .obs import modelstats as modelstats_mod
+
+    if model_stats or flight_rec is not None or modelstats_mod.env_enabled():
+        modelstats_mod.publish(booster)
     return booster
 
 
@@ -280,6 +336,10 @@ def _boost_loop(
         # would re-run eval + callbacks the uninterrupted run never had
         return evaluation_result_list
     iter_counter = obs_registry.REGISTRY.counter("train_iterations")
+    import time as _time
+
+    flight_on = flight_mod.active() is not None
+    t_boundary = _time.perf_counter()
     while i < end:
         # named fault site: the crash tests SIGKILL here mid-run and prove
         # resume_from replays to a byte-identical model (resil/faults.py)
@@ -323,6 +383,15 @@ def _boost_loop(
             hist = booster._gbdt._eval_history
             for (dname, mname, val, _) in evaluation_result_list:
                 hist.setdefault(dname, {}).setdefault(mname, []).append(val)
+        if flight_on:
+            # one flight record per boundary: the eval-history values plus
+            # the boundary's wall time (host clock only — the dispatch is
+            # async either way, so this is dispatch+eval time, not a fence)
+            now = _time.perf_counter()
+            flight_mod.note_boundary(
+                i - 1, done, now - t_boundary, evaluation_result_list
+            )
+            t_boundary = now
         try:
             for cb in cbs_after:
                 cb(
@@ -339,12 +408,19 @@ def _boost_loop(
         except callback_mod.EarlyStopException as es:
             booster.best_iteration = es.best_iteration + 1
             evaluation_result_list = es.best_score
+            if flight_on:
+                flight_mod.note_event(
+                    "early_stop", iteration=i - 1,
+                    best_iteration=es.best_iteration + 1,
+                )
             break
         if ckpt_writer is not None and ckpt_writer.due(i, done):
             # after the boundary's eval + callbacks, so the early-stopping
             # bests captured are exactly the ones a resumed run needs next
             try:
                 ckpt_writer.write(booster, init_iteration, end)
+                if flight_on:
+                    flight_mod.note_event("checkpoint", iteration=i)
             except LightGBMError:
                 raise  # structural refusal (e.g. dart): a config error, loud
             except Exception as e:
@@ -358,6 +434,10 @@ def _boost_loop(
                     % (type(e).__name__, str(e)[:200])
                 )
         if finished:
+            # the deferred no-split stop (models/gbdt.py) resolved at this
+            # boundary: the splitless iteration was rolled back already
+            if flight_on:
+                flight_mod.note_event("no_split_stop", iteration=i - 1)
             break
     return evaluation_result_list
 

@@ -1405,6 +1405,22 @@ class GBDT:
     def eval_history(self) -> Dict:
         return self._eval_history
 
+    def train_bin_occupancy(self):
+        """Cached per-feature bin-occupancy histograms of the binned
+        training matrix (host bincounts, computed once on first use): the
+        data-distribution reference shared by the model-stats tier
+        (obs/modelstats.py) and the serve drift sidecar (serve/drift.py).
+        None when there is no live train set or the matrix is EFB-bundled."""
+        if not hasattr(self, "_bin_occupancy_cache"):
+            from ..obs import modelstats
+
+            # getattr: model-string-loaded boosters skip the training
+            # __init__ and carry no train_set attribute at all
+            self._bin_occupancy_cache = modelstats.train_bin_occupancy(
+                getattr(self, "train_set", None)
+            )
+        return self._bin_occupancy_cache
+
     def _train_bins_t_dev(self) -> jax.Array:
         """Cached row-major [N, F] bin matrix on device for traversals."""
         if getattr(self, "_train_bins_t_cache", None) is None:

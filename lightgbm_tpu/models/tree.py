@@ -430,6 +430,24 @@ class Tree:
 
         return depth(0, 0)
 
+    def leaf_depths(self) -> np.ndarray:
+        """Depth of every leaf (root = 0), iteratively — the model/data
+        observability tier's leaf-shape distributions (obs/modelstats.py)
+        read this for num_leaves up to the hundreds, where the recursive
+        max_depth walk would be fine but a per-leaf recursion would not."""
+        out = np.zeros(self.num_leaves, np.int32)
+        if self.num_leaves <= 1:
+            return out
+        stack = [(0, 0)]
+        while stack:
+            node, d = stack.pop()
+            for child in (int(self.left_child[node]), int(self.right_child[node])):
+                if child < 0:
+                    out[-(child + 1)] = d + 1
+                else:
+                    stack.append((child, d + 1))
+        return out
+
     # -- SHAP feature contributions (Tree::PredictContrib, tree.h:123,470) -
 
     def _data_count(self, node: int) -> float:

@@ -1,6 +1,10 @@
 """lightgbm_tpu.obs: the unified observability layer (docs/Observability.md).
 
-Six pieces, one spine:
+System tier (trace/retrace/memwatch/costs/prof/registry) plus the model/data
+tier — :mod:`~lightgbm_tpu.obs.flight` (training flight recorder),
+:mod:`~lightgbm_tpu.obs.modelstats` (importance evolution, bin occupancy,
+leaf shape) and :mod:`~lightgbm_tpu.obs.report` (the self-contained HTML run
+report); the serve-side drift monitor lives in serve/drift.py. One spine:
 
  * :mod:`~lightgbm_tpu.obs.trace`    — structured span tracer; Chrome-trace
    JSON via ``LIGHTGBM_TPU_TRACE=<path>``, Perfetto-viewable, device-aligned
@@ -25,12 +29,13 @@ Importing this package never touches a jax backend.
 """
 from __future__ import annotations
 
-from . import costs, memwatch, registry, retrace, trace  # noqa: F401
+from . import costs, flight, memwatch, modelstats, registry, retrace, trace  # noqa: F401
 from .registry import REGISTRY, MetricsRegistry  # noqa: F401
 
 # NOTE: obs.prof is imported lazily by its callers (it pulls ops/ modules,
 # which import jax-heavy code paths this package promises to avoid at
-# import time).
+# import time). obs.report is the run-report CLI
+# (`python -m lightgbm_tpu.obs.report`) and is imported on use.
 
 # cross-wiring: the default registry's watchdog/memory gauges pull live
 # values at read time, so any exposition (serve /metrics, run_report) is
@@ -49,7 +54,9 @@ __all__ = [
     "REGISTRY",
     "MetricsRegistry",
     "costs",
+    "flight",
     "memwatch",
+    "modelstats",
     "registry",
     "retrace",
     "trace",

@@ -28,6 +28,7 @@ from typing import List, Optional
 
 from ..obs import trace as trace_mod
 from ..utils import log
+from .drift import DEFAULT_THRESHOLD as DRIFT_DEFAULT_THRESHOLD
 from .server import (
     DEFAULT_DEADLINE_S,
     DEFAULT_MAX_QUEUE_DEPTH,
@@ -67,6 +68,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="max seconds the SIGTERM drain waits for in-flight "
                         "requests before force-failing the remainder")
+    p.add_argument("--drift", action="store_true",
+                   help="enable the feature-drift monitor (serve/drift.py): "
+                        "per-feature PSI vs the model's .drift.json sidecar "
+                        "(or a self-calibrated baseline) on /drift and "
+                        "/metrics; LIGHTGBM_TPU_DRIFT=1 is the env spelling")
+    p.add_argument("--drift-threshold", type=float,
+                   default=DRIFT_DEFAULT_THRESHOLD,
+                   help="PSI above this warns once + counts "
+                        "serve_drift_alerts_total (0.1=moderate 0.25=major)")
     return p
 
 
@@ -81,6 +91,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         warmup_rows=args.warmup_rows,  # loads (and hot swaps) pre-warm
         default_deadline_s=args.deadline_s,
         max_queue_depth=args.max_queue_depth,
+        drift=args.drift or None,  # None defers to LIGHTGBM_TPU_DRIFT
+        drift_threshold=args.drift_threshold,
     )
     for spec in args.models:
         if "=" in spec:

@@ -52,6 +52,12 @@ class ServeMetrics:
     so ``prometheus_text()`` is the complete serving exposition:
     request/dispatch latency summaries, qps / rows_per_second gauges, queue
     depth, batch occupancy, and every ``incr`` counter (as ``*_total``).
+
+    The feature-drift monitor (serve/drift.py) publishes onto this same
+    registry: ``serve_drift_psi{model=,feature=}`` gauges (set at scrape
+    time by ``ServeApp.prometheus_metrics``) and the
+    ``serve_drift_alerts_total{feature=}`` counter (incremented the first
+    time a feature crosses its PSI threshold).
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:

@@ -219,25 +219,16 @@ class PackedEnsemble:
         )
 
 
-def pack_booster(booster, num_iteration: int = -1) -> PackedEnsemble:
-    """Compile ``booster`` (trained in-process OR loaded from model text)
-    into a :class:`PackedEnsemble`. ``num_iteration`` clips the ensemble the
-    same way ``Booster.predict`` does."""
-    gbdt = booster._gbdt
-    trees = gbdt.trees()
-    K = max(gbdt.num_tree_per_iteration, 1)
-    use = len(trees)
-    if num_iteration is not None and num_iteration > 0:
-        use = min(use, num_iteration * K)
-    trees = trees[:use]
-    if not trees:
-        raise LightGBMError("Cannot pack a model with no trees")
-    F = gbdt.max_feature_idx + 1
-
-    # per-feature threshold lattice (float64, model-derived) + kind
-    thr_lists: List[List[float]] = [[] for _ in range(F)]
-    is_cat_feat = np.zeros(F, bool)
-    is_num_feat = np.zeros(F, bool)
+def model_lattice(trees, num_features: int):
+    """(feat_bounds, is_cat_feat) — the per-feature float64 threshold
+    lattice of a tree list: sorted unique split thresholds plus the
+    +/-kZeroThreshold sentinels bounding LightGBM's missing-zero window.
+    The exactness spine of the packed serving path, and the bin edges the
+    drift monitor (serve/drift.py) histograms traffic against — factored so
+    the two can never disagree on what "bin" means."""
+    thr_lists: List[List[float]] = [[] for _ in range(num_features)]
+    is_cat_feat = np.zeros(num_features, bool)
+    is_num_feat = np.zeros(num_features, bool)
     for t in trees:
         miss, dl, cat = _decode_nodes(t)
         for n in range(max(t.num_leaves - 1, 0)):
@@ -254,9 +245,29 @@ def pack_booster(booster, num_iteration: int = -1) -> PackedEnsemble:
             "cannot build a rank lattice" % int(np.nonzero(both)[0][0])
         )
     feat_bounds = []
-    for f in range(F):
+    for f in range(num_features):
         vals = thr_lists[f] + [-K_ZERO_THRESHOLD, K_ZERO_THRESHOLD]
         feat_bounds.append(np.unique(np.asarray(vals, np.float64)))
+    return feat_bounds, is_cat_feat
+
+
+def pack_booster(booster, num_iteration: int = -1) -> PackedEnsemble:
+    """Compile ``booster`` (trained in-process OR loaded from model text)
+    into a :class:`PackedEnsemble`. ``num_iteration`` clips the ensemble the
+    same way ``Booster.predict`` does."""
+    gbdt = booster._gbdt
+    trees = gbdt.trees()
+    K = max(gbdt.num_tree_per_iteration, 1)
+    use = len(trees)
+    if num_iteration is not None and num_iteration > 0:
+        use = min(use, num_iteration * K)
+    trees = trees[:use]
+    if not trees:
+        raise LightGBMError("Cannot pack a model with no trees")
+    F = gbdt.max_feature_idx + 1
+
+    # per-feature threshold lattice (float64, model-derived) + kind
+    feat_bounds, is_cat_feat = model_lattice(trees, F)
     rank0 = np.asarray(
         [np.searchsorted(b, 0.0, side="left") for b in feat_bounds], np.int32
     )

@@ -13,6 +13,8 @@ Endpoints:
                       process-wide obs registry: train phases, jit retraces,
                       device memory; docs/Observability.md)
   GET  /metrics.json  the legacy JSON snapshot + per-model bucket stats
+  GET  /drift     per-feature PSI vs the training distribution (serve/drift.py;
+                  enabled with --drift / LIGHTGBM_TPU_DRIFT=1)
   GET  /models    registry listing (fingerprint, version, shape, objective)
   POST /models    {"name": ..., "path": ...} — load or atomically hot-swap
   POST /predict   {"rows": [[...]], "model"?, "raw_score"?, "pred_leaf"?,
@@ -54,6 +56,7 @@ from ..resil import backoff, faults
 from ..utils import log
 from ..utils.log import LightGBMError
 from ..utils.vfile import vopen
+from . import drift as drift_mod
 from .batcher import BatcherClosed, MicroBatcher
 from .cache import BucketedDispatcher
 from .metrics import ServeMetrics
@@ -66,6 +69,8 @@ DEFAULT_DEADLINE_S = 120.0
 DEFAULT_MAX_QUEUE_DEPTH = 1024
 #: Retry-After seconds a shed response advertises
 SHED_RETRY_AFTER_S = 1
+#: rows a drift monitor must see before its PSI alerts arm
+DEFAULT_DRIFT_MIN_COUNT = drift_mod.DEFAULT_MIN_COUNT
 
 
 def _check_deadline(deadline: float) -> float:
@@ -135,6 +140,7 @@ class ServedModel:
         file_sha: str,
         version: int,
         min_bucket_rows: int = 16,
+        drift_monitor: Optional["drift_mod.DriftMonitor"] = None,
     ) -> None:
         import jax.numpy as jnp
 
@@ -146,6 +152,9 @@ class ServedModel:
         self.file_sha = file_sha
         self.version = version
         self.loaded_at = time.time()
+        # feature-drift monitor (serve/drift.py): host-side occupancy
+        # accumulation on the batcher thread; None when drift is disabled
+        self.drift = drift_monitor
         ens = ensemble
         self.leaves_disp = BucketedDispatcher(
             lambda codes, isnan: np.asarray(
@@ -166,11 +175,18 @@ class ServedModel:
         ens = self.ensemble
         X = ens._check_width(X)
         if kind == "fused" or kind == "fused_raw":
+            if self.drift is not None:
+                # the fused path bins on device; drift recomputes the ranks
+                # host-side (same f64 searchsorted) — dispatch untouched
+                self._observe_drift(self.drift.observe_rows, X)
             return ens.finalize_fused(
                 self.fused_disp(X.astype(np.float32)),
                 raw_score=(kind == "fused_raw"),
             )
         codes, isnan = ens._host_codes(X)
+        if self.drift is not None:
+            # the exact path's ranks come for free — they ARE the codes
+            self._observe_drift(self.drift.observe_codes, codes)
         leaves = self.leaves_disp(codes, isnan).T.astype(np.int32)  # [N, T]
         if kind == "leaf":
             return leaves
@@ -178,6 +194,16 @@ class ServedModel:
         if kind == "raw" or ens.objective is None:
             return raw
         return ens.objective.convert_output(raw)
+
+    def _observe_drift(self, fn, arr: np.ndarray) -> None:
+        try:
+            fn(arr)
+        except Exception as e:  # monitoring must never fail a prediction
+            log.warn_once(
+                "serve-drift-observe-" + self.name,
+                "drift: observation failed on model %r (%s: %s); monitor "
+                "degraded" % (self.name, type(e).__name__, str(e)[:120]),
+            )
 
     def warmup(self, max_rows: int) -> List[int]:
         F = self.ensemble.num_features
@@ -217,7 +243,12 @@ class ModelRegistry:
     fail its first requests on the new model's legitimate first compiles.
     """
 
-    def __init__(self, min_bucket_rows: int = 16, warmup_rows: int = 0) -> None:
+    def __init__(
+        self,
+        min_bucket_rows: int = 16,
+        warmup_rows: int = 0,
+        drift_opts: Optional[Dict[str, object]] = None,
+    ) -> None:
         self._models: Dict[str, ServedModel] = {}
         self._lock = threading.Lock()
         # serializes whole load/hot-swap builds (rare operator actions):
@@ -227,6 +258,9 @@ class ModelRegistry:
         self._load_lock = threading.Lock()
         self.min_bucket_rows = min_bucket_rows
         self.warmup_rows = warmup_rows
+        # feature-drift monitoring (serve/drift.py): kwargs for
+        # monitor_from_model per load; None keeps drift fully off
+        self.drift_opts = drift_opts
 
     def load(self, name: str, path: str) -> ServedModel:
         """Load (or atomically replace) ``name`` from a model-text file. The
@@ -241,11 +275,20 @@ class ModelRegistry:
             booster = Booster(model_str=text)
             ensemble = booster.to_packed()
             file_sha = model_fingerprint(text)
+            monitor = None
+            if self.drift_opts is not None:
+                # per-load monitor: a hot swap starts fresh against the NEW
+                # model's lattice + sidecar (old PSI state would be scored
+                # against bins that no longer exist)
+                monitor = drift_mod.monitor_from_model(
+                    ensemble, path, model_name=name, **self.drift_opts
+                )
             # the whole build — parse, pack, dispatchers — happens OFF the
             # registry lock; only the version stamp + dict swap hold it, so
             # concurrent predicts never block behind a hot swap
             served = ServedModel(
-                name, path, ensemble, file_sha, 0, self.min_bucket_rows
+                name, path, ensemble, file_sha, 0, self.min_bucket_rows,
+                drift_monitor=monitor,
             )
             # the incoming model's warmup compiles are legitimate — they
             # must not trip an armed watchdog (LIGHTGBM_TPU_RETRACE=fail
@@ -319,13 +362,33 @@ class ServeApp:
         warmup_rows: int = 0,
         default_deadline_s: float = DEFAULT_DEADLINE_S,
         max_queue_depth: int = DEFAULT_MAX_QUEUE_DEPTH,
+        drift: Optional[bool] = None,
+        drift_threshold: float = drift_mod.DEFAULT_THRESHOLD,
+        drift_min_count: int = DEFAULT_DRIFT_MIN_COUNT,
     ) -> None:
         if mode not in ("exact", "fused"):
             raise LightGBMError("serve mode must be 'exact' or 'fused'")
         self.mode = mode
         self.backend = ensure_backend()
         self.metrics = ServeMetrics()
-        self.registry = ModelRegistry(min_bucket_rows, warmup_rows)
+        # feature-drift monitoring (serve/drift.py, docs/Serving.md):
+        # explicit flag wins, else the LIGHTGBM_TPU_DRIFT env gate;
+        # disabled by default — zero host work on the dispatch path
+        self.drift_enabled = (
+            drift_mod.env_enabled() if drift is None else bool(drift)
+        )
+        drift_opts = (
+            {
+                "threshold": float(drift_threshold),
+                "min_count": int(drift_min_count),
+                "registry": self.metrics.registry,
+            }
+            if self.drift_enabled
+            else None
+        )
+        self.registry = ModelRegistry(
+            min_bucket_rows, warmup_rows, drift_opts=drift_opts
+        )
         # fail at startup, not per-request: a bad --deadline-s would
         # otherwise surface as a 400 on every single /predict
         self.default_deadline_s = _check_deadline(float(default_deadline_s))
@@ -569,6 +632,16 @@ class ServeApp:
         m.request_latency.record(time.perf_counter() - t0)
         return out, served
 
+    def drift_snapshot(self) -> Dict[str, object]:
+        """The /drift endpoint body: per-model PSI state (serve/drift.py)."""
+        models: Dict[str, object] = {}
+        for info in self.registry.list():
+            name = str(info["name"])
+            served = self.registry.get(name)
+            if served.drift is not None:
+                models[name] = served.drift.snapshot()
+        return {"enabled": self.drift_enabled, "models": models}
+
     def dispatcher_stats(self) -> Dict[str, object]:
         out: Dict[str, object] = {}
         for info in self.registry.list():
@@ -602,6 +675,12 @@ class ServeApp:
                 g_retrace.set(
                     stats[kind]["retraces"], model=name, kind=kind
                 )
+        if self.drift_enabled:
+            # scrape-time PSI pull: serve_drift_psi{model=,feature=}
+            for info in self.registry.list():
+                served = self.registry.get(str(info["name"]))
+                if served.drift is not None:
+                    served.drift.publish(self.metrics.registry)
         return (
             self.metrics.prometheus_text()
             + obs_registry.REGISTRY.prometheus_text()
@@ -728,6 +807,10 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif path == "/metrics.json":
             self._json(200, app.metrics.snapshot(app.dispatcher_stats()))
+        elif path == "/drift":
+            # per-feature PSI vs the training reference (serve/drift.py);
+            # {"enabled": false} when the monitor is off
+            self._json(200, app.drift_snapshot())
         elif path == "/models":
             self._json(200, {"models": app.registry.list()})
         else:

@@ -1,0 +1,135 @@
+"""Booster.feature_importance: direct coverage (ISSUE 7 satellite).
+
+The importance-evolution telemetry (obs/modelstats.py) builds on this
+surface, which previously had no test of its own. Checks:
+
+  * gain vs split semantics against hand-computed sums read back from the
+    MODEL TEXT (an independent path: the text carries every node's
+    split_feature and split_gain, so the expected totals are re-derived
+    without touching the importance code);
+  * ``iteration=`` slicing limits the aggregation to the first trees;
+  * multiclass models sum across every class's trees per iteration;
+  * a model-string round trip preserves both importance types.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _parse_trees_from_text(text):
+    """[(split_feature list, split_gain list)] straight from model text."""
+    trees = []
+    for block in text.split("\nTree=")[1:]:
+        feats, gains = [], []
+        for line in block.splitlines():
+            if line.startswith("split_feature="):
+                feats = [int(v) for v in line.split("=", 1)[1].split()]
+            elif line.startswith("split_gain="):
+                gains = [float(v) for v in line.split("=", 1)[1].split()]
+        trees.append((feats, gains))
+    return trees
+
+
+def _expected_importance(text, num_features, kind, num_trees=None):
+    trees = _parse_trees_from_text(text)
+    if num_trees is not None:
+        trees = trees[:num_trees]
+    out = np.zeros(num_features, np.float64)
+    for feats, gains in trees:
+        for f, g in zip(feats, gains):
+            out[f] += g if kind == "gain" else 1.0
+    return out
+
+
+@pytest.fixture(scope="module")
+def binary_booster():
+    rng = np.random.RandomState(13)
+    X = rng.randn(1200, 6)
+    y = (X[:, 0] + 0.6 * X[:, 2] > 0).astype(float)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "verbosity": -1},
+        lgb.Dataset(X, label=y), 5,
+    )
+    return bst, X
+
+
+def test_gain_importance_matches_model_text(binary_booster):
+    bst, _ = binary_booster
+    text = bst.model_to_string()
+    expected = _expected_importance(text, 6, "gain")
+    got = bst.feature_importance("gain")
+    # the text rounds gains to 8 significant digits (_short_float): the
+    # comparison is against the independently re-summed text values, so
+    # tolerate exactly that rounding
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-8)
+    assert got[0] == max(got), "the label-defining feature must lead"
+
+
+def test_split_importance_is_exact_node_count(binary_booster):
+    bst, _ = binary_booster
+    text = bst.model_to_string()
+    expected = _expected_importance(text, 6, "split")
+    got = bst.feature_importance("split")
+    np.testing.assert_array_equal(got, expected)
+    # split counts are integers and total the model's split nodes
+    total_splits = sum(
+        t.num_leaves - 1 for t in bst._gbdt.trees() if t.num_leaves > 1
+    )
+    assert got.sum() == total_splits
+
+
+def test_iteration_slicing(binary_booster):
+    bst, _ = binary_booster
+    text = bst.model_to_string()
+    for k in (1, 2, 5):
+        expected = _expected_importance(text, 6, "gain", num_trees=k)
+        got = bst.feature_importance("gain", iteration=k)
+        np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-8)
+    # iteration=-1 (and 0/None-ish defaults) mean ALL trees
+    np.testing.assert_array_equal(
+        bst.feature_importance("split", iteration=-1),
+        bst.feature_importance("split"),
+    )
+
+
+def test_multiclass_sums_across_class_trees():
+    rng = np.random.RandomState(14)
+    X = rng.randn(900, 5)
+    y = rng.randint(0, 3, 900).astype(float)
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "verbosity": -1},
+        lgb.Dataset(X, label=y), 4,
+    )
+    assert bst.num_trees() == 12  # 4 iterations x 3 classes
+    text = bst.model_to_string()
+    np.testing.assert_allclose(
+        bst.feature_importance("gain"),
+        _expected_importance(text, 5, "gain"),
+        rtol=1e-5, atol=1e-8,
+    )
+    # iteration=2 takes the first 2*3 trees (every class of the iteration)
+    np.testing.assert_allclose(
+        bst.feature_importance("gain", iteration=2),
+        _expected_importance(text, 5, "gain", num_trees=6),
+        rtol=1e-5, atol=1e-8,
+    )
+    np.testing.assert_array_equal(
+        bst.feature_importance("split"),
+        _expected_importance(text, 5, "split"),
+    )
+
+
+def test_importance_survives_model_string_round_trip(binary_booster):
+    bst, _ = binary_booster
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    # gain: the text stores 8 significant digits, so the reloaded values
+    # agree to that precision; split counts are exact integers
+    np.testing.assert_allclose(
+        loaded.feature_importance("gain"), bst.feature_importance("gain"),
+        rtol=1e-5,
+    )
+    np.testing.assert_array_equal(
+        loaded.feature_importance("split"), bst.feature_importance("split"),
+    )

@@ -1,0 +1,257 @@
+"""Model/data observability tier (docs/Observability.md §Model & data
+observability): the training flight recorder (obs/flight.py), model stats
+(obs/modelstats.py) and the self-contained HTML run report (obs/report.py).
+
+Acceptance criteria covered here:
+  * flight recorder + modelstats are NO-OPS when disabled, and the final
+    model is BITWISE identical — with ZERO additional jit traces — when
+    enabled (the recorder only reads host state);
+  * the flight JSONL parses back with manifest / per-boundary / per-tree /
+    end records, early-stop events included, and tolerates a torn tail;
+  * modelstats' published block agrees with Booster.feature_importance;
+  * the report renders non-empty inline-SVG HTML from a flight log.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import REGISTRY, flight, modelstats, report, retrace
+
+
+@pytest.fixture
+def clean_flight(monkeypatch):
+    monkeypatch.delenv("LIGHTGBM_TPU_FLIGHT", raising=False)
+    monkeypatch.delenv("LIGHTGBM_TPU_MODELSTATS", raising=False)
+    flight.stop()
+    yield
+    flight.stop()
+
+
+def _data(n=600, f=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+
+
+def _train(extra=None, rounds=5, valid=True, **kw):
+    X, y = _data()
+    params = dict(PARAMS, **(extra or {}))
+    vs = [lgb.Dataset(X[:200], label=y[:200])] if valid else None
+    return lgb.train(
+        params, lgb.Dataset(X, label=y), rounds, valid_sets=vs,
+        verbose_eval=False, **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+def test_flight_schema_and_load(clean_flight, tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    bst = _train({"flight_record": path})
+    assert flight.active() is None  # closed by engine.train
+    rec = flight.load(path)
+    man = rec["manifest"]
+    assert man["num_data"] == 600 and man["num_features"] == 5
+    assert man["num_boost_round"] == 5 and man["config_digest"]
+    assert man["label_digest"] and man["objective"] == "binary"
+    assert len(rec["iterations"]) == 5
+    for it in rec["iterations"]:
+        assert it["chunk"] >= 1 and it["dt_s"] >= 0
+        assert it["evals"] and it["evals"][0][0] == "valid_0"
+    assert len(rec["trees"]) == bst.num_trees()
+    t0 = rec["trees"][0]
+    assert t0["num_leaves"] > 1 and t0["total_gain"] > 0
+    assert t0["max_gain"] <= t0["total_gain"] + 1e-9
+    assert t0["top_gain_features"]
+    assert rec["end"]["num_trees"] == bst.num_trees()
+    assert rec["end"]["stopped"] is False
+    # seq strictly increasing
+    seqs = [r["seq"] for r in
+            rec["iterations"] + rec["trees"] + [rec["end"]]]
+    assert seqs == sorted(seqs)
+
+
+def test_flight_bitwise_identity_and_zero_new_traces(clean_flight, tmp_path):
+    """The acceptance contract: recording must not change the model by one
+    bit nor compile one extra program (same shapes => full jit cache hits)."""
+    base = _train()
+    before = dict(retrace.counts())
+    path = str(tmp_path / "run.jsonl")
+    rec_bst = _train({"flight_record": path})
+    after = dict(retrace.counts())
+    assert base.model_to_string() == rec_bst.model_to_string()
+    assert after == before, "flight recording compiled something new"
+    assert os.path.exists(path)
+
+
+def test_flight_disabled_is_silent(clean_flight, tmp_path):
+    _train()
+    assert flight.active() is None
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_flight_env_gate(clean_flight, tmp_path, monkeypatch):
+    path = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("LIGHTGBM_TPU_FLIGHT", path)
+    _train(rounds=2, valid=False)
+    rec = flight.load(path)
+    assert len(rec["iterations"]) == 2
+    # no valid sets and no training metric -> empty eval lists, still logged
+    assert all(it["evals"] == [] for it in rec["iterations"])
+
+
+def test_flight_early_stop_event(clean_flight, tmp_path):
+    path = str(tmp_path / "es.jsonl")
+    X, y = _data()
+    yr = np.random.RandomState(0).rand(600)  # pure noise: no improvement
+    lgb.train(
+        dict(PARAMS, objective="regression", flight_record=path,
+             metric="l2"),
+        lgb.Dataset(X, label=yr), 60,
+        valid_sets=[lgb.Dataset(X[:200], label=yr[:200])],
+        early_stopping_rounds=2, verbose_eval=False,
+    )
+    rec = flight.load(path)
+    kinds = {e["event"] for e in rec["events"]}
+    assert "early_stop" in kinds, kinds
+    assert rec["end"] is not None
+
+
+def test_flight_closes_on_interrupted_run(clean_flight, tmp_path):
+    """A crashed/interrupted train still closes its flight log (an
+    'aborted' event marks it) and never leaks the active recorder — a
+    leaked one would silently disable recording for every later train()."""
+    path = str(tmp_path / "aborted.jsonl")
+
+    def bomb(env):
+        if env.iteration >= 1:
+            raise KeyboardInterrupt
+
+    bomb.order = 99
+    with pytest.raises(KeyboardInterrupt):
+        _train({"flight_record": path}, rounds=5, callbacks=[bomb])
+    assert flight.active() is None, "recorder leaked past the failed run"
+    rec = flight.load(path)
+    assert any(e["event"] == "aborted" for e in rec["events"])
+    assert rec["iterations"], "pre-crash boundaries missing"
+    # the next run records normally again
+    path2 = str(tmp_path / "after.jsonl")
+    _train({"flight_record": path2}, rounds=2)
+    assert flight.load(path2)["end"] is not None
+
+
+def test_flight_load_tolerates_torn_tail(clean_flight, tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    _train({"flight_record": path}, rounds=2)
+    with open(path, "a") as fh:
+        fh.write('{"event": "iteration", "iterati')  # SIGKILL mid-write
+    rec = flight.load(path)
+    assert len(rec["iterations"]) == 2 and rec["manifest"]
+
+
+def test_flight_param_pops_from_model_footer(clean_flight, tmp_path):
+    """The recording path must never reach the model's parameters footer —
+    the footer keeps the field at its (empty) default, byte-identical to an
+    unrecorded run's."""
+    path = str(tmp_path / "run.jsonl")
+    bst = _train({"flight_record": path, "model_stats": True})
+    text = bst.model_to_string()
+    assert path not in text
+    assert "[flight_record: ]" in text
+    assert "[model_stats: False]" in text
+
+
+# ---------------------------------------------------------------------------
+# modelstats
+# ---------------------------------------------------------------------------
+
+def test_modelstats_block_and_gauges(clean_flight):
+    bst = _train({"model_stats": True})
+    rep = REGISTRY.run_report()
+    block = rep.get("model_stats")
+    assert block, sorted(rep)
+    assert block["num_trees"] == bst.num_trees()
+    # importance agrees with Booster.feature_importance
+    gain = bst.feature_importance("gain")
+    top_feat = int(np.argmax(gain))
+    name = "Column_%d" % top_feat
+    top = block["importance_gain_top"]
+    assert name in top
+    assert top[name] == pytest.approx(float(gain[top_feat]), rel=1e-5)
+    # evolution: cumulative and ending at the final totals
+    evo = block["importance_evolution"]
+    assert evo and evo[-1]["iteration"] == bst.current_iteration
+    assert evo[-1]["gain"][name] == pytest.approx(
+        float(gain[top_feat]), rel=1e-5
+    )
+    vals = [e["gain"].get(name, 0.0) for e in evo]
+    assert vals == sorted(vals)  # cumulative gain never decreases
+    # leaf stats + occupancy
+    ls = block["leaf_stats"]
+    assert ls["trees_with_splits"] > 0 and ls["depth_max"] >= 1
+    occ = block["train_bin_occupancy"]
+    assert occ and all(e["bins_used"] >= 1 for e in occ)
+    prom = REGISTRY.prometheus_text()
+    assert "lgbtpu_model_feature_importance" in prom
+    assert "lgbtpu_model_trees" in prom
+
+
+def test_modelstats_disabled_by_default(clean_flight):
+    REGISTRY._sections.pop("model_stats", None)
+    _train()
+    assert "model_stats" not in REGISTRY.run_report()
+
+
+def test_tree_leaf_depths():
+    bst = _train()
+    for t in bst._gbdt.trees():
+        d = t.leaf_depths()
+        assert len(d) == t.num_leaves
+        if t.num_leaves > 1:
+            assert int(d.max()) == t.max_depth()
+            assert int(d.min()) >= 1
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def test_report_renders_from_flight(clean_flight, tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    _train({"flight_record": path, "model_stats": True})
+    rec = flight.load(path)
+    html = report.render(
+        flight=rec, metrics={"obs_report": REGISTRY.run_report()},
+    )
+    for needle in ("<svg", "Run manifest", "Learning curves",
+                   "Importance evolution", "Per-tree shape"):
+        assert needle in html, needle
+    assert len(html) > 2000
+
+
+def test_report_cli_writes_file(clean_flight, tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    _train({"flight_record": path}, rounds=2)
+    metrics = str(tmp_path / "metrics.json")
+    with open(metrics, "w") as fh:
+        json.dump(REGISTRY.run_report(), fh)
+    out = str(tmp_path / "r.html")
+    assert report.main(
+        ["--flight", path, "--metrics", metrics, "-o", out]
+    ) == 0
+    text = open(out).read()
+    assert text.startswith("<!doctype html>") and "<svg" in text
+
+
+def test_report_requires_an_input(tmp_path):
+    with pytest.raises(SystemExit):
+        report.main(["-o", str(tmp_path / "x.html")])
