@@ -887,6 +887,10 @@ def _run() -> None:
             print("bench: segment profiler failed: %s" % e, file=sys.stderr)
 
     extra = {"platform": platform, "train_auc": round(float(auc), 6)}
+    # visible device world: the multichip scaling analysis joins bench
+    # records on this (helpers/multichip_bench.py, docs/DataParallel.md)
+    extra["n_devices"] = len(jax.devices())
+    extra["tree_learner"] = params.get("tree_learner", "serial")
     if predict_rec:
         extra["predict"] = predict_rec
     # the shared structured run report (obs/registry.py): phase gauges, jit

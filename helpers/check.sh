@@ -26,6 +26,13 @@
 #                               # smoke: segment-profiled mini-train —
 #                               # breakdown structure + fused-vs-segmented
 #                               # bitwise identity + cost-analysis cross-check
+#   helpers/check.sh --multichip
+#                               # lint gate, then the multichip smoke: the
+#                               # composed data-parallel sharded-chunk path
+#                               # on 8 forced CPU devices — serial-loop vs
+#                               # sharded-chunk model strings must match
+#                               # bit for bit, one train_chunk compile,
+#                               # serial-learner structural cross-check
 #   helpers/check.sh --bench-diff [CUR BASE]
 #                               # the bench regression gate: golden-fixture
 #                               # self-test (synthetic regression must FAIL,
@@ -44,9 +51,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -105,6 +112,11 @@ fi
 if [ "$MODE" = "--drift" ]; then
     echo "== drift smoke (flight JSONL + PSI separation + HTML report) =="
     exec env JAX_PLATFORMS=cpu python helpers/obs_smoke.py --drift
+fi
+
+if [ "$MODE" = "--multichip" ]; then
+    echo "== multichip smoke (8 forced CPU devices, sharded-chunk bit-identity) =="
+    exec python helpers/multichip_smoke.py
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

@@ -1,0 +1,121 @@
+"""check.sh --multichip: the composed sharded-chunk path on 8 forced CPU
+devices, gated on model-string equality.
+
+Runs ONE worker subprocess pinned to 8 virtual CPU devices
+(``XLA_FLAGS=--xla_force_host_platform_device_count=8``) that trains the
+same data three ways:
+
+  * the data-parallel learner with the per-iteration (serial) loop
+    (``device_chunk_size=1`` — one shard_map dispatch per tree);
+  * the data-parallel learner with the composed sharded-chunk path
+    (``device_chunk_size=5`` — a whole chunk of iterations is ONE
+    shard_map dispatch with psum over the mesh);
+  * the serial single-device learner (``tree_learner=serial``) as the
+    structural cross-check.
+
+HARD FAILURES: any serial-loop-vs-sharded-chunk model-string mismatch
+(the PR 2 bit-identity obligation extended to meshes), a fallback away
+from the chunked path, more than one train_chunk compile, or a
+serial-learner structural divergence (split features/thresholds must
+match; leaf values may differ in late ulps — the psum regroups the f32
+histogram sums, docs/DataParallel.md §Exactness).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import json, sys
+    sys.path.insert(0, "@REPO@")
+    from lightgbm_tpu.utils.platform import force_cpu_devices
+    jax = force_cpu_devices(8)
+    assert len(jax.devices()) == 8, jax.devices()
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import retrace as retrace_mod
+
+    rng = np.random.RandomState(7)
+    N, F, ROUNDS, CHUNK = 4096, 8, 11, 5
+    X = rng.randn(N, F)
+    w = rng.randn(F) * (rng.rand(F) > 0.3)
+    y = (X @ w + 0.5 * rng.randn(N) > 0).astype(float)
+
+    def train(learner, chunk):
+        p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+             "tree_learner": learner, "device_chunk_size": chunk,
+             "bagging_freq": 2, "bagging_fraction": 0.8}
+        return lgb.train(p, lgb.Dataset(X, label=y), ROUNDS)
+
+    serial_loop = train("data", 1)
+    before = retrace_mod.counts().get("gbdt.train_chunk", 0)
+    sharded = train("data", CHUNK)
+    compiles = retrace_mod.counts().get("gbdt.train_chunk", 0) - before
+    assert sharded._gbdt.device_chunk_fallback_reason() is None, (
+        "sharded chunk path fell back: %s"
+        % sharded._gbdt.device_chunk_fallback_reason())
+    # iteration 0 is sequential, then 2 full chunks of 5 -> ONE compile
+    assert compiles == 1, "expected 1 train_chunk compile, saw %d" % compiles
+    m_loop = serial_loop.model_to_string().split("parameters:")[0]
+    m_shard = sharded.model_to_string().split("parameters:")[0]
+    assert m_loop == m_shard, (
+        "serial-loop vs sharded-chunk MODEL STRING MISMATCH")
+    s_loop = np.asarray(serial_loop._gbdt.scores)[:, :N]
+    s_shard = np.asarray(sharded._gbdt.scores)[:, :N]
+    assert np.array_equal(s_loop, s_shard), "score carries differ"
+
+    single = train("serial", 1)
+    t_single, t_shard = single._gbdt.trees(), sharded._gbdt.trees()
+    assert len(t_single) == len(t_shard)
+    for i, (a, b) in enumerate(zip(t_single, t_shard)):
+        assert np.array_equal(a.split_feature, b.split_feature), (
+            "serial-vs-sharded split features diverge at tree %d" % i)
+        assert np.array_equal(a.threshold_bin, b.threshold_bin), (
+            "serial-vs-sharded thresholds diverge at tree %d" % i)
+        np.testing.assert_allclose(a.leaf_value, b.leaf_value,
+                                   rtol=2e-4, atol=2e-6)
+    print("RESULT " + json.dumps({
+        "ok": True, "devices": 8, "rounds": ROUNDS, "chunk": CHUNK,
+        "train_chunk_compiles": compiles,
+        "model_match": True, "serial_struct_match": True,
+    }), flush=True)
+    """
+).replace("@REPO@", REPO)
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    out = subprocess.run(
+        [sys.executable, "-c", WORKER], env=env, capture_output=True,
+        text=True, cwd=REPO, timeout=1500,
+    )
+    sys.stderr.write(out.stderr[-2000:] if out.stderr else "")
+    rec = None
+    for line in (out.stdout or "").splitlines():
+        if line.startswith("RESULT "):
+            rec = json.loads(line[len("RESULT "):])
+    if out.returncode != 0 or not rec or not rec.get("ok"):
+        print("multichip_smoke FAILED (rc=%d)" % out.returncode)
+        if out.stdout:
+            print(out.stdout[-1000:])
+        return 1
+    print(
+        "multichip_smoke OK: %d devices, %d rounds, chunk=%d, "
+        "%d train_chunk compile(s), serial-loop==sharded-chunk model "
+        "strings, serial-learner structure matched"
+        % (rec["devices"], rec["rounds"], rec["chunk"],
+           rec["train_chunk_compiles"])
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

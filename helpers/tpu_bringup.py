@@ -84,6 +84,7 @@ STAGE_TIMEOUTS = {
     "smoke_bf16": 1800,  # same smoke, bf16 MXU operands (AUC delta record)
     "smoke_psplit": 1800,  # opt-in Pallas split-scan kernel (first lowering)
     "bench_chunk": 3600,   # device-resident boosting sweep at the 1M shape
+    "bench_multichip": 3600,  # devices∈{1,4,8} sharded-chunk scaling (ISSUE 8)
     "bench_predict": 1800,  # packed-inference serving bench (ISSUE 3)
     "prof": 1800,   # segment-profiled mini-train (obs/prof.py, ISSUE 6)
     "bench": 3600,
@@ -505,6 +506,9 @@ def _render_report(summary: dict) -> str:
     spec.loader.exec_module(mod)
     bench_records = mod.load_bench_records(
         os.path.join(REPO, "BENCH_r*.json")
+    ) + mod.load_bench_records(
+        # multichip scaling records chart in their own section
+        os.path.join(REPO, "MULTICHIP_r*.json")
     )
     bench = (summary.get("stages") or {}).get("bench") or {}
     # the bench stage result IS the parsed bench record (run_bench); its
@@ -717,6 +721,47 @@ def run_bench(stage: str = "bench") -> dict:
     return result
 
 
+def run_multichip(stage: str = "bench_multichip") -> dict:
+    """Device-count scaling sweep (helpers/multichip_bench.py --sweep):
+    tree_learner=data + device_chunk_size over devices∈{1,4,8} — the
+    ISSUE-8 scaling-curve evidence. On success the summary record (it
+    carries a "metric" key, the load_bench_records adoption shape) is also
+    written as the next MULTICHIP_r*.json so the HTML run report charts
+    the scaling series next to BENCH_r*."""
+    env = dict(os.environ)
+    if _REHEARSAL:
+        env["JAX_PLATFORMS"] = "cpu"
+    result = _run_child(
+        stage,
+        [sys.executable, os.path.join(REPO, "helpers", "multichip_bench.py"),
+         "--sweep", "1,4,8"],
+        env=env,
+    )
+    result.setdefault("ok", bool(result.get("scaling")))
+    if result.get("ok") and "metric" in result:
+        import glob
+        import re
+
+        # next index past the HIGHEST existing round (a count would renumber
+        # into a gap and overwrite evidence after any cleanup)
+        taken = [
+            int(m.group(1))
+            for p in glob.glob(os.path.join(REPO, "MULTICHIP_r*.json"))
+            if (m := re.search(r"MULTICHIP_r(\d+)\.json$", p))
+        ]
+        path = os.path.join(
+            REPO, "MULTICHIP_r%02d.json" % (max(taken, default=0) + 1)
+        )
+        with open(path, "w") as f:
+            json.dump(
+                {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                 **{k: v for k, v in result.items()
+                    if k not in ("ok", "wall_s", "attempts")}}, f)
+            f.write("\n")
+        result["record_path"] = os.path.basename(path)
+    return result
+
+
 def _dump(summary) -> None:
     """Persist after EVERY stage: the relay dies unpredictably, and a
     partial summary still feeds bench.py's bake-off auto-adoption."""
@@ -754,6 +799,9 @@ def main() -> int:
                        # chunked-boosting sweep before pack4: it feeds the
                        # final bench's device_chunk_size auto-adoption
                        ("bench_chunk", BENCH_CHUNK),
+                       # data-parallel sharded-chunk scaling curve
+                       # (ISSUE 8): its own worker sweep, not a _COMMON src
+                       ("bench_multichip", "MULTICHIP"),
                        # serving throughput/latency capture (ISSUE 3)
                        ("bench_predict", BENCH_PREDICT),
                        # kernel-level attribution: segment breakdown +
@@ -762,12 +810,13 @@ def main() -> int:
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         with _stage_span(stage):
-            result = run_with_retry(
-                stage,
-                (lambda s=stage: run_bench(s))
-                if src is None
-                else (lambda s=stage, c=src: run_stage(s, c)),
-            )
+            if src == "MULTICHIP":
+                runner = lambda s=stage: run_multichip(s)  # noqa: E731
+            elif src is None:
+                runner = lambda s=stage: run_bench(s)  # noqa: E731
+            else:
+                runner = lambda s=stage, c=src: run_stage(s, c)  # noqa: E731
+            result = run_with_retry(stage, runner)
         summary["stages"][stage] = result
         if stage == "smoke_seq":
             _check_spec_seq_match(summary)

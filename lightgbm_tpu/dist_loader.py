@@ -365,3 +365,21 @@ def apply_sidecars(
             md.query_boundaries = bounds
     md._validate()
     return binned
+
+
+def shard_binned_rows(binned: BinnedDataset, mesh):
+    """Place a loaded dataset's packed ``[F, N]`` bin matrix directly as
+    per-device row shards on ``mesh``'s 'data' axis (parallel/mesh.py
+    ``shard_rows`` — the trailing shard is zero-padded when N does not
+    divide the mesh).
+
+    The in-process complement of the rank row-sharding above
+    (dataset_loader.cpp:762-798): a multi-host run keeps only its rank's
+    rows at load time; a single-host multi-device run lands the whole
+    matrix here, sharded at upload, so the data-parallel trainer
+    (models/gbdt.py) never materializes an unsharded device copy. jax is
+    imported lazily — everything else in this module is numpy-only and the
+    loader must stay importable in jax-free drivers."""
+    from .parallel.mesh import shard_rows
+
+    return shard_rows(mesh, binned.bins, 1)

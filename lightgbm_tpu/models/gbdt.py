@@ -97,15 +97,28 @@ class GBDT:
         cfg = self.config
         self.num_data = train_set.num_data
         self.max_feature_idx = train_set.num_total_features - 1
-        self.bins_dev = jnp.asarray(train_set.bins)
-        # CPU: keep a [N, F] transposed copy for the serial grower's segment
-        # gathers (contiguous rows; ~3x faster than [F, N] column gathers).
-        # TPU keeps only [F, N] — the lane-friendly layout.
-        self.bins_dev_nf = (
-            jnp.asarray(np.ascontiguousarray(train_set.bins.T))
-            if jax.default_backend() == "cpu"
-            else None
-        )
+        if self._learner_kind() == "data":
+            # data-parallel learner: the [F, N] matrix lands DIRECTLY as
+            # per-device row shards (dist_loader.shard_binned_rows ->
+            # parallel/mesh.shard_rows, trailing shard zero-padded) — an
+            # unsharded device copy never materializes, which is what lets
+            # the binned matrix exceed one device's HBM at pod scale
+            from ..dist_loader import shard_binned_rows
+
+            self.bins_dev = shard_binned_rows(train_set, self._mesh())
+            self._sharded_bins = self.bins_dev
+            self.bins_dev_nf = None
+        else:
+            self.bins_dev = jnp.asarray(train_set.bins)
+            # CPU: keep a [N, F] transposed copy for the serial grower's
+            # segment gathers (contiguous rows; ~3x faster than [F, N]
+            # column gathers). TPU keeps only [F, N] — the lane-friendly
+            # layout.
+            self.bins_dev_nf = (
+                jnp.asarray(np.ascontiguousarray(train_set.bins.T))
+                if jax.default_backend() == "cpu"
+                else None
+            )
         meta_np = train_set.feature_meta_arrays()
         self.feature_meta = {k: jnp.asarray(v) for k, v in meta_np.items()}
         self._feature_meta_np = meta_np  # host copies for the native learner
@@ -426,6 +439,9 @@ class GBDT:
         same end state as the reference's immediate check."""
         cfg = self.config
         K = self.num_tree_per_iteration
+        # a sequential iteration after sharded chunks (the tail shorter
+        # than a chunk) addresses the canonical [.., N] carries
+        self._unshard_chunk_carries()
         if self._consume_pending_stop() or self._stopped:
             return True
         timers = self.timers
@@ -622,8 +638,26 @@ class GBDT:
             return "untrained constant class (class_need_train=False)"
         if self.cegb_params.enabled:
             return "CEGB carries cross-tree acquisition state on the host"
-        if self._learner_kind() != "serial":
-            return "parallel learner (sharding is applied per dispatch)"
+        lk = self._learner_kind()
+        if lk in ("feature", "voting"):
+            return "%s-parallel learner (sharding is applied per dispatch)" % lk
+        if lk == "data":
+            # the data-parallel learner COMPOSES with the chunked scan: the
+            # whole chunk runs under one shard_map dispatch with psum over
+            # ICI (docs/DataParallel.md). Only objectives whose gradient is
+            # elementwise over rows can evaluate per shard.
+            if self.objective.is_renew_tree_output:
+                return (
+                    "renew objective %r needs a global per-leaf order "
+                    "statistic the row shards cannot compute locally"
+                    % self.objective.name
+                )
+            if not getattr(self.objective, "supports_row_sharding", True):
+                return (
+                    "objective %r reads cross-row state that does not "
+                    "row-shard" % self.objective.name
+                )
+            return None
         if (
             grow_native.unsupported_reason(
                 cfg, self.feature_meta, self._forced_splits, self.cegb_params,
@@ -677,18 +711,26 @@ class GBDT:
         timers = self.timers
         with timers.phase("chunked boosting") as ph:
             fmasks = self._sample_feature_masks(n)
+            # data-parallel learner: the chunk runs under ONE shard_map
+            # dispatch — build/convert the mesh-resident inputs first so
+            # _chunk_fn can close over the same row-state triples
+            extra = (
+                self._sharded_chunk_args()
+                if self._learner_kind() == "data"
+                else ()
+            )
             fn = self._chunk_fn(n)
             # snapshot avals BEFORE the donating call (obs/costs.py)
             harvest = None
             if costs_mod.enabled():
                 harvest = costs_mod.sds_args(
                     (self.scores, self._bag_mask, jnp.int32(self.iter_),
-                     fmasks, self._finish_scalar(0)),
+                     fmasks, self._finish_scalar(0)) + tuple(extra),
                     {},
                 )
             self.scores, self._bag_mask, trees_out, nl_dev = fn(
                 self.scores, self._bag_mask, jnp.int32(self.iter_), fmasks,
-                self._finish_scalar(0),
+                self._finish_scalar(0), *extra,
             )
             if harvest is not None:
                 costs_mod.COSTS.harvest(
@@ -720,12 +762,93 @@ class GBDT:
                 return n, True
         return n, False
 
+    def _sharded_chunk_args(self):
+        """Mesh-resident inputs of the SHARDED chunk program (the
+        data-parallel learner's train_chunk), built once per training and
+        cached: the row-validity mask (False on shard padding) and the
+        objective's per-row device arrays, each zero-padded to the mesh
+        multiple and row-sharded (parallel/mesh.shard_rows). Also converts
+        the score/bag carries to their padded sharded layout — shape-driven,
+        so a checkpoint restore or a sequential tail iteration transparently
+        re-enters the sharded domain on the next chunk."""
+        from ..parallel import mesh as mesh_mod
+
+        mesh = self._mesh()
+        N = self.num_data
+        pad = mesh_mod.row_pad(mesh, N)
+        Np = N + pad
+        if getattr(self, "_sharded_bins", None) is None:
+            self._sharded_bins = mesh_mod.shard_rows(mesh, self.bins_dev, 1)
+        cached = getattr(self, "_chunk_shard_cache", None)
+        if cached is None:
+            valid = np.zeros(Np, np.bool_)
+            valid[:N] = True
+            valid_s = mesh_mod.shard_rows(mesh, jnp.asarray(valid), 0)
+            triples = self.objective.row_state()
+            row_args = tuple(
+                mesh_mod.shard_rows(mesh, arr, arr.ndim - 1)
+                for _, _, arr in triples
+            )
+            cached = (triples, (self._sharded_bins, valid_s) + row_args)
+            self._chunk_shard_cache = cached
+        if (
+            self.scores.shape[1] != Np
+            or not getattr(self, "_chunk_carries_placed", False)
+        ):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            s = self.scores
+            if s.shape[1] != Np:
+                s = jnp.pad(s, ((0, 0), (0, pad)))
+            self.scores = jax.device_put(
+                s, NamedSharding(mesh, P(None, "data"))
+            )
+            b = self._bag_mask
+            if b.shape[0] != Np:
+                b = jnp.pad(b, (0, pad))
+            self._bag_mask = jax.device_put(b, NamedSharding(mesh, P("data")))
+            self._chunk_carries_placed = True
+        return cached[1]
+
+    def _unshard_chunk_carries(self) -> None:
+        """Return the score/bag carries to their canonical [.., N] layout:
+        the per-iteration paths (sequential tail, rollback) and every host
+        consumer address unpadded rows. Slicing is exact — the padded tail
+        never held real data (the finish step's validity select keeps it at
+        zero), so chunked-then-sequential training stays bit-identical to
+        the all-sequential run."""
+        if getattr(self, "_chunk_carries_placed", False):
+            N = self.num_data
+            if self.scores.shape[1] != N:
+                self.scores = self.scores[:, :N]
+            if self._bag_mask.shape[0] != N:
+                self._bag_mask = self._bag_mask[:N]
+            self._chunk_carries_placed = False
+
+    def scores_canonical_np(self) -> np.ndarray:
+        """The train score carry as [K, N] numpy with any sharded-chunk row
+        padding dropped — the canonical form checkpoints store, so the
+        artifact bytes do not depend on the mesh that produced them."""
+        return np.asarray(self.scores)[:, : self.num_data]
+
     def _chunk_fn(self, n: int):
         """Build (and cache) the jitted ``n``-iteration boosting scan. The
         cache key pins every trace-time constant the closure bakes in, so a
         reset_parameter between train() calls can never reuse a stale
         program. ``scores`` and the bag mask are donated — the caller
-        re-adopts both from the outputs."""
+        re-adopts both from the outputs.
+
+        With the data-parallel learner the SAME scan body runs once per
+        shard under ONE shard_map dispatch: bins/scores/bag/gradient state
+        arrive row-sharded, per-shard histograms combine with one psum per
+        split level inside the grower (ops/histogram.py HistogramSource),
+        and every shard applies the identical global split — the
+        reference's SyncUpGlobalBestSplit record exchange
+        (data_parallel_tree_learner.cpp:241) is a no-op by construction.
+        RNG draws (bagging permutation, feature masks) are computed in the
+        GLOBAL row space and sliced per shard, so tree sequences stay
+        bit-identical to the per-iteration chunk=1 path on the same mesh
+        (docs/DataParallel.md)."""
         cfg = self.config
         K = self.num_tree_per_iteration
         N = self.num_data
@@ -736,18 +859,20 @@ class GBDT:
         freq = cfg.bagging_freq
         finish = [self._finish_step(k) for k in range(K)]
         slots = self._hist_pool_slots()
+        sharded = self._learner_kind() == "data"
+        mesh = self._mesh() if sharded else None
         key = (
             n, K, N, bag_on, bag_cnt, freq, slots,
             tuple(fk for fk, _ in finish),
             cfg.num_leaves, cfg.max_depth, self.num_bins, self.num_group_bins,
             self.split_params, cfg.tpu_hist_chunk, cfg.tpu_hist_dtype,
             cfg.tpu_hist_mode, self._two_way, self._forced_splits,
+            ("data", int(mesh.shape["data"])) if sharded else None,
         )
         fn = self._chunk_fns.get(key)
         if fn is not None:
             return fn
         obj = self.objective
-        bins = self.bins_dev
         feature_meta = self.feature_meta
         bag_key = self._bag_key
         steps = [s for _, s in finish]
@@ -758,11 +883,17 @@ class GBDT:
             hist_dtype=cfg.tpu_hist_dtype, hist_mode=cfg.tpu_hist_mode,
             two_way=self._two_way, forced_splits=self._forced_splits,
             cegb=self.cegb_params, cegb_state=None, hist_buf=None,
-            bins_nf=self.bins_dev_nf, hist_pool_slots=slots,
+            bins_nf=None if sharded else self.bins_dev_nf,
+            hist_pool_slots=slots,
         )
+        if sharded:
+            grow_kwargs["axis_name"] = "data"
 
-        def chunk_fn(scores, bag_mask, it0, fmasks, rate):
-            retrace_mod.note_trace("gbdt.train_chunk")  # once per XLA trace
+        n_shards = int(mesh.shape["data"]) if sharded else 1
+
+        def make_body(bins, valid, meta, rate):
+            """The n-iteration scan body over ONE shard's rows (the whole
+            row space when not sharded: bins [F, N], valid None)."""
 
             def body(carry, xs):
                 scores, bag, stopped = carry
@@ -771,21 +902,39 @@ class GBDT:
                 grad, hess = obj.get_gradients(scores if K > 1 else scores[0])
                 if K == 1:
                     grad, hess = grad[None, :], hess[None, :]
+                if valid is not None:
+                    # shard-padding rows must carry EXACT zeros: the
+                    # objective saw arbitrary (zero) labels there, and a
+                    # NaN/inf gradient would poison the bag-masked histogram
+                    # products (NaN * 0 == NaN). Real rows pass the select
+                    # untouched — bitwise identity with the unsharded path.
+                    grad = jnp.where(valid[None, :], grad, jnp.float32(0.0))
+                    hess = jnp.where(valid[None, :], hess, jnp.float32(0.0))
                 if bag_on:
                     # same draw the sequential _bagging makes, keyed by the
                     # global iteration counter (fold_in is integer-exact, so
-                    # the mask sequence is bit-identical)
-                    bag = jax.lax.cond(
-                        it % freq == 0,
-                        lambda: _device_bag_mask(
+                    # the mask sequence is bit-identical). Under shard_map
+                    # every shard draws the GLOBAL [N] mask and slices its
+                    # own window — redundant arithmetic, zero communication,
+                    # and exactly the per-iteration path's padded slices.
+                    def draw():
+                        full = _device_bag_mask(
                             jax.random.fold_in(bag_key, it), N, bag_cnt
-                        ),
-                        lambda: bag,
-                    )
+                        )
+                        if valid is None:
+                            return full
+                        L = bag.shape[0]
+                        n_pad = L * n_shards - N
+                        if n_pad:
+                            full = jnp.pad(full, (0, n_pad))
+                        start = jax.lax.axis_index("data") * L
+                        return jax.lax.dynamic_slice(full, (start,), (L,))
+
+                    bag = jax.lax.cond(it % freq == 0, draw, lambda: bag)
                 trees = []
                 for k in range(K):
                     ta, leaf_id = grow_tree_scan(
-                        bins, grad[k], hess[k], bag, fmask_k[k], feature_meta,
+                        bins, grad[k], hess[k], bag, fmask_k[k], meta,
                         **grow_kwargs,
                     )
                     # once an earlier iteration of this chunk failed to split
@@ -795,10 +944,15 @@ class GBDT:
                     # equal to the sequential path across mid-chunk stops
                     # (the trees themselves are popped by the boundary check)
                     nl_eff = jnp.where(stopped, jnp.int32(1), ta.num_leaves)
-                    scores, leaf_value, internal_value = steps[k](
+                    out = steps[k](
                         scores, ta.leaf_value, ta.internal_value, leaf_id,
-                        bag, nl_eff, rate,
+                        bag, nl_eff, rate, valid,
                     )
+                    # the data learner's step returns a 4th (pin) output;
+                    # inside the scan it is dead and DCE'd — the scan body
+                    # performs the plain add on its own (measured; the
+                    # quick-tier bit-identity suite re-proves it every run)
+                    scores, leaf_value, internal_value = out[0], out[1], out[2]
                     trees.append(
                         ta._replace(
                             leaf_value=leaf_value, internal_value=internal_value
@@ -812,19 +966,91 @@ class GBDT:
                 )
                 return (scores, bag, stopped), stacked_k
 
-            its = it0 + jnp.arange(n, dtype=jnp.int32)
-            (scores, bag_mask, _), stacked = jax.lax.scan(
-                body, (scores, bag_mask, jnp.bool_(False)), (its, fmasks)
-            )
+            return body
+
+        def unstack(stacked):
             # unstack INSIDE the jit: one dispatch yields n*K per-tree
             # output buffers (iteration-major), instead of n*K*15 tiny
             # host-issued slice dispatches per chunk boundary
-            trees_out = [
+            return [
                 jax.tree_util.tree_map(lambda a: a[i, k], stacked)
                 for i in range(n)
                 for k in range(K)
             ]
-            return scores, bag_mask, trees_out, stacked.num_leaves
+
+        if not sharded:
+            bins = self.bins_dev
+
+            def chunk_fn(scores, bag_mask, it0, fmasks, rate):
+                retrace_mod.note_trace("gbdt.train_chunk")  # per XLA trace
+                its = it0 + jnp.arange(n, dtype=jnp.int32)
+                (scores, bag_mask, _), stacked = jax.lax.scan(
+                    make_body(bins, None, feature_meta, rate),
+                    (scores, bag_mask, jnp.bool_(False)), (its, fmasks),
+                )
+                return scores, bag_mask, unstack(stacked), stacked.num_leaves
+
+            fn = jax.jit(chunk_fn, donate_argnums=(0, 1))
+            self._chunk_fns[key] = fn
+            return fn
+
+        # ---- data-parallel: the WHOLE chunk under one shard_map ----------
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.data_parallel import shard_map
+
+        cache = getattr(self, "_chunk_shard_cache", None)
+        triples = cache[0] if cache else self.objective.row_state()
+        meta_keys = sorted(feature_meta.keys())
+        meta_vals = tuple(feature_meta[kk] for kk in meta_keys)
+        n_meta = len(meta_keys)
+
+        def shard_body(scores, bag, it0, fmasks, rate, bins, valid, *rest):
+            meta = dict(zip(meta_keys, rest[:n_meta]))
+            row_loc = rest[n_meta:]
+            # swap the objective's per-row device arrays for this shard's
+            # blocks for the duration of the TRACE (restored in finally):
+            # get_gradients is elementwise over rows (supports_row_sharding
+            # gates the fallback), so the same program runs on [.., N/D]
+            saved = [(ow, name, getattr(ow, name)) for ow, name, _ in triples]
+            try:
+                for (ow, name, _), loc in zip(triples, row_loc):
+                    setattr(ow, name, loc)
+                its = it0 + jnp.arange(n, dtype=jnp.int32)
+                (scores, bag, _), stacked = jax.lax.scan(
+                    make_body(bins, valid, meta, rate),
+                    (scores, bag, jnp.bool_(False)), (its, fmasks),
+                )
+                return scores, bag, stacked, stacked.num_leaves
+            finally:
+                for ow, name, old in saved:
+                    setattr(ow, name, old)
+
+        row = P("data")
+        rep = P()
+        col = P(None, "data")
+        state_specs = tuple(
+            P(*([None] * (arr.ndim - 1) + ["data"]))
+            for _, _, arr in triples
+        )
+        fn_sm = shard_map(
+            shard_body,
+            mesh=mesh,
+            in_specs=(col, row, rep, rep, rep, col, row)
+            + (rep,) * n_meta
+            + state_specs,
+            out_specs=(col, row, rep, rep),
+            check_vma=False,
+        )
+
+        def chunk_fn(scores, bag_mask, it0, fmasks, rate, bins_s, valid_s,
+                     *row_state):
+            retrace_mod.note_trace("gbdt.train_chunk")  # once per XLA trace
+            scores, bag_mask, stacked, nl = fn_sm(
+                scores, bag_mask, it0, fmasks, rate, bins_s, valid_s,
+                *meta_vals, *row_state,
+            )
+            return scores, bag_mask, unstack(stacked), nl
 
         fn = jax.jit(chunk_fn, donate_argnums=(0, 1))
         self._chunk_fns[key] = fn
@@ -844,7 +1070,7 @@ class GBDT:
         if fn is None:
             fn = jax.jit(step, donate_argnums=(0,))
             self._finish_fns[key] = fn
-        self.scores, leaf_value, internal_value = fn(
+        out = fn(
             self.scores,
             tree_arrays.leaf_value,
             tree_arrays.internal_value,
@@ -853,6 +1079,9 @@ class GBDT:
             nl_dev,
             self._finish_scalar(k),
         )
+        # the data learner's step carries a 4th output (the materialized
+        # add vector — the FMA-contraction pin, see _finish_step); unused
+        self.scores, leaf_value, internal_value = out[0], out[1], out[2]
         return tree_arrays._replace(
             leaf_value=leaf_value, internal_value=internal_value
         )
@@ -867,18 +1096,40 @@ class GBDT:
         )
         use_bag = self._bagging_active
         M = self.config.num_leaves
+        # Data-parallel learner: pin the score update to PLAIN f32 adds of
+        # the shrunk leaf values by making the gathered add vector a
+        # PROGRAM OUTPUT. Without the materialization, XLA's CPU loop
+        # fusion recomputes the shrink-multiply inside the score-add kernel
+        # and LLVM contracts it into an FMA (jax.lax.optimization_barrier
+        # is stripped before fusion, measured) — but only in the
+        # per-iteration program, not in the shard_map chunk scan, so the
+        # chunk=1 vs chunk=K bit-identity contract would silently become
+        # fusion-dependent (observed as a 1-ulp score drift). With `add`
+        # materialized both programs perform the identical plain add
+        # (tests/test_parallel_chunk.py re-proves this every run). The
+        # serial learner keeps its historical 3-output arithmetic.
+        pin_adds = self._learner_kind() == "data"
 
-        def step(scores, leaf_value, internal_value, lid, bag, nl, rate):
+        def step(scores, leaf_value, internal_value, lid, bag, nl, rate,
+                 valid=None):
             if renew is not None:
                 leaf_value = renew(
                     scores[k], lid, bag if use_bag else None, M, leaf_value
                 )
             leaf_value = jnp.where(nl > 1, leaf_value * rate, jnp.float32(0.0))
             internal_value = internal_value * rate
-            scores = scores.at[k].add(leaf_value[lid])
+            add = leaf_value[lid]
+            if valid is not None:
+                # sharded chunk path: shard-padding rows stay EXACTLY zero
+                # forever — real rows pass through the select untouched, so
+                # the masked add equals the unmasked one bitwise on [0, N)
+                add = jnp.where(valid, add, jnp.float32(0.0))
+            scores = scores.at[k].add(add)
+            if pin_adds:
+                return scores, leaf_value, internal_value, add
             return scores, leaf_value, internal_value
 
-        return (k, renew is not None, use_bag), step
+        return (k, renew is not None, use_bag, pin_adds), step
 
     def _finish_scalar(self, k: int):
         return np.float32(self.shrinkage_rate)
@@ -1091,37 +1342,30 @@ class GBDT:
             if self._learner_kind() == "feature":
                 self._mesh_cache = feature_mesh()
             else:
-                self._mesh_cache = data_mesh()
+                # num_machines > 1 caps the data mesh to that many devices —
+                # the TPU-native reading of the reference's parallel world
+                # size (config.h num_machines); the default uses every
+                # local device
+                nm = self.config.num_machines
+                self._mesh_cache = data_mesh(
+                    num_devices=nm if nm and nm > 1 else None
+                )
         return self._mesh_cache
 
     def _shard_rows(self, grad_k, hess_k):
-        """Row-shard bins/grad/hess/bag over the data mesh (pads rows to the
-        shard count; padded rows carry zero bag weight so they are inert)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
+        """Row-shard bins/grad/hess/bag over the data mesh via the ONE
+        padding rule (parallel/mesh.shard_rows: trailing shard zero-padded;
+        padded rows carry zero bag weight so they are inert)."""
+        from ..parallel import mesh as mesh_mod
 
         mesh = self._mesh()
-        n_sh = mesh.shape["data"]
-        N = self.num_data
-        pad = (-N) % n_sh
         if getattr(self, "_sharded_bins", None) is None:
-            bins = self.bins_dev
-            if pad:
-                bins = jnp.pad(bins, ((0, 0), (0, pad)))
-            self._sharded_bins = jax.device_put(
-                bins, NamedSharding(mesh, P(None, "data"))
-            )
-        row = NamedSharding(mesh, P("data"))
-        if pad:
-            grad_k = jnp.pad(grad_k, (0, pad))
-            hess_k = jnp.pad(hess_k, (0, pad))
-            bag = jnp.pad(self._bag_mask, (0, pad))
-        else:
-            bag = self._bag_mask
+            self._sharded_bins = mesh_mod.shard_rows(mesh, self.bins_dev, 1)
         return (
             self._sharded_bins,
-            jax.device_put(grad_k, row),
-            jax.device_put(hess_k, row),
-            jax.device_put(bag, row),
+            mesh_mod.shard_rows(mesh, grad_k, 0),
+            mesh_mod.shard_rows(mesh, hess_k, 0),
+            mesh_mod.shard_rows(mesh, self._bag_mask, 0),
         )
 
     def _update_valid_scores(self, tree_arrays, class_id: int) -> None:
@@ -1133,7 +1377,8 @@ class GBDT:
             self.valid_scores[i] = self.valid_scores[i].at[class_id].add(val)
 
     def _train_score_np(self) -> np.ndarray:
-        s = np.asarray(self.scores, np.float64)
+        # slice off any sharded-chunk row padding (no-op when unpadded)
+        s = np.asarray(self.scores, np.float64)[:, : self.num_data]
         return s[0] if self.num_tree_per_iteration == 1 else s
 
     def _valid_score_np(self, i: int) -> np.ndarray:
@@ -1303,6 +1548,7 @@ class GBDT:
             )
         # scores rebuild from zero on the refit dataset (fresh ScoreUpdater)
         self.scores = jnp.zeros((K, N), jnp.float32)
+        self._chunk_carries_placed = False
         num_iterations = len(self.models) // K
         for it in range(num_iterations):
             grad, hess = self._compute_gradients([0.0] * K)
@@ -1357,6 +1603,7 @@ class GBDT:
         """RollbackOneIter (gbdt.cpp:415-431)."""
         if self.iter_ <= 0:
             return
+        self._unshard_chunk_carries()
         if getattr(self, "_pending_chunk", None) is not None:
             # resolve the chunk's deferred check first: a no-split tail always
             # includes the last iteration, so when it fires the rollback this

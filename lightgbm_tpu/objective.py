@@ -175,6 +175,13 @@ class ObjectiveFunction:
     # that reads or mutates HOST state per iteration must set this False to
     # force the per-iteration loop.
     supports_device_chunk = True
+    # True when get_gradients is ELEMENTWISE over rows (possibly per class):
+    # row i's gradient depends only on row i's score/label/weight, so the
+    # data-parallel chunked trainer may evaluate it per row shard with the
+    # per-row state swapped for shard-local blocks (row_state below).
+    # Cross-row objectives (LambdaRank's query-grouped pairwise lambdas)
+    # set this False and fall back to the per-iteration sharded loop.
+    supports_row_sharding = True
 
     def __init__(self, config: Config) -> None:
         self.config = config
@@ -253,6 +260,31 @@ class ObjectiveFunction:
 
     def class_need_train(self, class_id: int) -> bool:
         return True
+
+    def row_state(self) -> List[Tuple[object, str, jax.Array]]:
+        """Every per-row DEVICE array ``get_gradients`` reads, as
+        ``(owner, attribute, array)`` triples — any attribute whose value is
+        a jax array with trailing dimension ``num_data`` (``_label_dev``,
+        ``_weight_dev``, binary's ``_y_dev``/``_lw_dev``, multiclass's
+        ``[K, N]`` one-hot, OVA's nested per-class copies).
+
+        The data-parallel chunked trainer (models/gbdt.py) row-shards these
+        over the device mesh and swaps the shard-local blocks in for the
+        trace, so the elementwise gradient program runs on ``[.., N/D]``
+        shards unchanged. Only valid when ``supports_row_sharding``; the
+        generic scan is deliberate — a subclass that adds a per-row device
+        array is covered without remembering a registry."""
+        out: List[Tuple[object, str, jax.Array]] = []
+        owners = [self] + list(getattr(self, "_binary", []))
+        for ow in owners:
+            for attr, val in vars(ow).items():
+                if (
+                    isinstance(val, jax.Array)
+                    and val.ndim >= 1
+                    and val.shape[-1] == self.num_data
+                ):
+                    out.append((ow, attr, val))
+        return out
 
     def to_string(self) -> str:
         return self.name
@@ -857,6 +889,9 @@ def _lambdarank_bucket(score, idx, labs, gains, invq, weight, sigmoid):
 
 class LambdarankNDCG(ObjectiveFunction):
     name = "lambdarank"
+    # query-grouped pairwise lambdas read the whole query's scores; a row
+    # shard boundary through a query would silently change the gradients
+    supports_row_sharding = False
 
     def __init__(self, config: Config) -> None:
         super().__init__(config)

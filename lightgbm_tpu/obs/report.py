@@ -353,7 +353,47 @@ def _section_drift(metrics: Dict, drift: Optional[Dict]) -> str:
     )
 
 
+def _section_multichip(records: List[Tuple[str, Dict]]) -> str:
+    """Devices-vs-iters/s scaling curves (MULTICHIP_r*.json records carry a
+    ``scaling`` list; helpers/multichip_bench.py) charted next to the
+    BENCH_r* series so one report answers both 'how fast' and 'how does it
+    scale'."""
+    series: List[Tuple[str, List[Point]]] = []
+    rows = []
+    for name, rec in records:
+        pts = [
+            (float(p["devices"]), float(p["iters_per_sec"]))
+            for p in rec.get("scaling") or []
+            if p.get("iters_per_sec")
+        ]
+        if not pts:
+            continue
+        series.append((name.replace(".json", ""), sorted(pts)))
+        rows.append((
+            name, rec.get("platform", "?"),
+            " / ".join("%g@%d" % (v, int(d)) for d, v in sorted(pts)),
+            "-" if rec.get("speedup_vs_1dev") is None
+            else "%.2fx" % rec["speedup_vs_1dev"],
+        ))
+    if not series:
+        return ""
+    out = ["<h2>Multichip scaling</h2>"]
+    out.append(svg_line_chart(
+        series, title="devices vs iters/s (data-parallel sharded chunk)",
+        y_zero=True,
+    ))
+    out.append(_table(
+        ("record", "platform", "iters/s @ devices", "speedup vs 1 dev"), rows
+    ))
+    return "".join(out)
+
+
 def _section_bench(bench_records: List[Tuple[str, Dict]]) -> str:
+    if not bench_records:
+        return ""
+    bench_records = [
+        (n, r) for n, r in bench_records if not r.get("scaling")
+    ]
     if not bench_records:
         return ""
     pts_v: List[Point] = []
@@ -442,6 +482,7 @@ def render(
         _section_segments(mblock),
         _section_drift(mblock, drift),
         _section_bench(bench_records or []),
+        _section_multichip(bench_records or []),
         _section_registry_digest(mblock),
         "<div class='small'>generated by python -m lightgbm_tpu.obs.report"
         "</div></body></html>",

@@ -49,7 +49,12 @@ import numpy as np
 
 from ..obs import retrace as retrace_mod
 from ..utils.platform import env_choice, env_int
-from .histogram import _default_backend, leaf_histogram, leaf_values
+from .histogram import (
+    _default_backend,
+    histogram_source,
+    leaf_histogram,
+    leaf_values,
+)
 from .split import (
     MISSING_NAN,
     MISSING_ZERO,
@@ -1063,9 +1068,12 @@ def grow_tree(
     root_h = jnp.sum(hess * bag_mask)
     root_n = jnp.sum(bag_mask)
     if axis_name is not None:
-        root_g = jax.lax.psum(root_g, axis_name)
-        root_h = jax.lax.psum(root_h, axis_name)
-        root_n = jax.lax.psum(root_n, axis_name)
+        # shard-linear root reductions ride the same partial-accumulation
+        # seam as the histograms (HistogramSource, ops/histogram.py)
+        _root_src = histogram_source(axis_name)
+        root_g = _root_src.combine(root_g)
+        root_h = _root_src.combine(root_h)
+        root_n = _root_src.combine(root_n)
     if bundled:
         if axis_name is not None and not psum_hist:
             # voting-parallel shard-local mode: remap with LOCAL totals (the
@@ -1364,7 +1372,7 @@ def grow_tree(
             if hist_axis is not None:
                 # collective AFTER the bucket switch: shards may pick different
                 # bucket branches, so no psum may live inside them
-                small_hist = jax.lax.psum(small_hist, hist_axis)
+                small_hist = histogram_source(hist_axis).combine(small_hist)
         else:
             small_mask = (leaf_id == small_idx).astype(f32)
             small_hist = leaf_histogram(
@@ -1392,7 +1400,7 @@ def grow_tree(
                 lg_cnt = jnp.where(left_smaller, right_phys, left_phys)
                 h = segment_histogram(order, lg_begin, lg_cnt)
                 if hist_axis is not None:
-                    h = jax.lax.psum(h, hist_axis)
+                    h = histogram_source(hist_axis).combine(h)
             else:
                 lmask = (leaf_id == large_idx).astype(f32)
                 h = leaf_histogram(
@@ -1630,7 +1638,7 @@ def grow_tree(
         )(order2, small_begin, small_cnt)
         if hist_axis is not None:
             # ONE collective for the whole batch (vs one per split)
-            small_hist = jax.lax.psum(small_hist, hist_axis)
+            small_hist = histogram_source(hist_axis).combine(small_hist)
         if bundled:
             small_hist = jax.vmap(remap_hist)(
                 small_hist,

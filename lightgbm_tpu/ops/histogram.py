@@ -32,12 +32,99 @@ import numpy as np
 from . import hist_pallas
 
 
+class HistogramSource:
+    """Partial-histogram accumulation seam (ROADMAP items 1 + 5).
+
+    A histogram — or any reduction that is linear across row shards, like
+    the root grad/hess/count sums — may arrive in PARTIALS: one per mesh
+    shard today (the data-parallel learner), one per streamed row shard in
+    the out-of-core engine. ``combine(partial)`` turns a shard's partial
+    into the total; exactly one implementation exists per distribution
+    mechanism, so every consumer (the ``leaf_histogram`` tail, the grower's
+    post-bucket-switch collective, the root sums) spells accumulation the
+    same way. Instances are value-hashable so they can ride jit statics.
+    """
+
+    def combine(self, partial):
+        raise NotImplementedError
+
+
+class LocalHistogramSource(HistogramSource):
+    """Single-shard: the partial IS the total."""
+
+    def combine(self, partial):
+        return partial
+
+    def __eq__(self, other):
+        return type(other) is LocalHistogramSource
+
+    def __hash__(self):
+        return hash(LocalHistogramSource)
+
+
+class MeshHistogramSource(HistogramSource):
+    """Mesh-sharded partials: ONE psum over the named axis — the
+    data-parallel learner's ReduceScatter of HistogramBinEntry
+    (data_parallel_tree_learner.cpp:161) collapsed into an XLA collective
+    over ICI."""
+
+    def __init__(self, axis_name: str) -> None:
+        self.axis_name = axis_name
+
+    def combine(self, partial):
+        return jax.lax.psum(partial, self.axis_name)
+
+    def __eq__(self, other):
+        return (
+            type(other) is MeshHistogramSource
+            and other.axis_name == self.axis_name
+        )
+
+    def __hash__(self):
+        return hash((MeshHistogramSource, self.axis_name))
+
+
+class StreamAccumHistogramSource(HistogramSource):
+    """Streamed partials (ROADMAP item 5, the out-of-core engine): a host
+    loop feeds ``add(partial)`` once per streamed row shard; ``total()``
+    is the running sum. ``combine`` is the identity — a streamed shard's
+    partial is combined by repeated addition, not by a collective — so a
+    grower fed one shard at a time composes with the same seam the mesh
+    path uses."""
+
+    def __init__(self) -> None:
+        self._acc = None
+
+    def combine(self, partial):
+        return partial
+
+    def add(self, partial):
+        self._acc = partial if self._acc is None else self._acc + partial
+        return self._acc
+
+    def total(self):
+        return self._acc
+
+    def reset(self) -> None:
+        self._acc = None
+
+
+_SOURCES = {None: LocalHistogramSource()}
+
+
+def histogram_source(axis_name: Optional[str]) -> HistogramSource:
+    """The process-wide HistogramSource for a mesh axis (None = local)."""
+    src = _SOURCES.get(axis_name)
+    if src is None:
+        src = _SOURCES[axis_name] = MeshHistogramSource(axis_name)
+    return src
+
+
 def _combine(hist, axis_name):
     """Shared cross-shard combine tail of every leaf_histogram impl — the
-    data-parallel ReduceScatter analogue lives in exactly one place."""
-    if axis_name is not None:
-        hist = jax.lax.psum(hist, axis_name)
-    return hist
+    data-parallel ReduceScatter analogue lives in exactly one place
+    (the HistogramSource seam above)."""
+    return histogram_source(axis_name).combine(hist)
 
 
 def _default_backend() -> str:

@@ -41,6 +41,12 @@ except ImportError:  # pragma: no cover
 from ..ops.grow import grow_tree
 from ..ops.split import CegbParams, SplitParams
 
+# jitted shard_map wrappers keyed by every trace-time constant the local
+# closure bakes in. A fresh jax.jit per call (the old form) compiled a NEW
+# executable for EVERY tree — the per-iteration data-parallel path paid a
+# full XLA compile per dispatch. Mirrors models/gbdt.py's _chunk_fns cache.
+_FN_CACHE: Dict = {}
+
 
 def grow_tree_data_parallel(
     mesh: Mesh,
@@ -82,43 +88,52 @@ def grow_tree_data_parallel(
             jnp.zeros((F, N) if cegb.has_lazy else (1, 1), bool),
         )
 
-    def local(bins_l, grad_l, hess_l, bag_l, fmask, fu, uid, *meta_flat):
-        meta = dict(zip(meta_keys, meta_flat))
-        return grow_tree(
-            bins_l,
-            grad_l,
-            hess_l,
-            bag_l,
-            fmask,
-            meta,
-            num_leaves=num_leaves,
-            max_depth=max_depth,
-            num_bins=num_bins,
-            num_group_bins=num_group_bins,
-            params=params,
-            chunk=chunk,
-            hist_dtype=hist_dtype,
-            hist_mode=hist_mode,
-            two_way=two_way,
-            axis_name="data",
-            forced_splits=forced_splits,
-            cegb=cegb,
-            hist_pool_slots=hist_pool_slots,
-            cegb_state=(fu, uid) if cegb_on else None,
-        )
-
-    row = P("data")
-    rep = P()
-    uid_spec = P(None, "data") if cegb.has_lazy else rep
-    state_out = ((rep, uid_spec),) if cegb_on else ()
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(None, "data"), row, row, row, rep, rep, uid_spec)
-        + (rep,) * len(meta_vals),
-        out_specs=(rep, row) + state_out,
-        check_vma=False,
+    key = (
+        mesh, tuple(meta_keys), num_leaves, max_depth, num_bins,
+        num_group_bins, params, chunk, hist_dtype, hist_mode, forced_splits,
+        cegb, two_way, hist_pool_slots,
     )
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+
+        def local(bins_l, grad_l, hess_l, bag_l, fmask, fu, uid, *meta_flat):
+            meta = dict(zip(meta_keys, meta_flat))
+            return grow_tree(
+                bins_l,
+                grad_l,
+                hess_l,
+                bag_l,
+                fmask,
+                meta,
+                num_leaves=num_leaves,
+                max_depth=max_depth,
+                num_bins=num_bins,
+                num_group_bins=num_group_bins,
+                params=params,
+                chunk=chunk,
+                hist_dtype=hist_dtype,
+                hist_mode=hist_mode,
+                two_way=two_way,
+                axis_name="data",
+                forced_splits=forced_splits,
+                cegb=cegb,
+                hist_pool_slots=hist_pool_slots,
+                cegb_state=(fu, uid) if cegb_on else None,
+            )
+
+        row = P("data")
+        rep = P()
+        uid_spec = P(None, "data") if cegb.has_lazy else rep
+        state_out = ((rep, uid_spec),) if cegb_on else ()
+        fn = jax.jit(shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(None, "data"), row, row, row, rep, rep, uid_spec)
+            + (rep,) * len(meta_vals),
+            out_specs=(rep, row) + state_out,
+            check_vma=False,
+        ))
+        _FN_CACHE[key] = fn
     if not cegb_on:
         import jax.numpy as jnp
 
@@ -126,6 +141,6 @@ def grow_tree_data_parallel(
         fu_in, uid_in = dummy
     else:
         fu_in, uid_in = cegb_state
-    return jax.jit(fn)(
+    return fn(
         bins, grad, hess, bag_mask, feature_mask, fu_in, uid_in, *meta_vals
     )

@@ -17,9 +17,14 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# The two parallelism axes ("data", "feature") are DECLARED as string
+# literals in the Mesh(...) calls below — graftlint JX007 collects declared
+# axes from those call sites and polices every other axis-name string in
+# the tree against them.
 
 
 def data_mesh(num_devices: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
@@ -39,8 +44,25 @@ def data_feature_mesh(data: int, feature: int, devices: Optional[Sequence] = Non
     return Mesh(arr, ("data", "feature"))
 
 
+def row_pad(mesh: Mesh, n: int) -> int:
+    """Rows of zero-padding shard_rows appends so ``n`` divides evenly over
+    the mesh's 'data' axis (0 when already divisible)."""
+    return (-n) % int(mesh.shape["data"])
+
+
 def shard_rows(mesh: Mesh, arr: jax.Array, row_axis: int) -> jax.Array:
-    """Place an array with its row dimension sharded over the 'data' mesh axis."""
+    """Place an array with its row dimension sharded over the 'data' mesh
+    axis, ZERO-PADDING the trailing shard when the row count does not divide
+    the mesh size (shard_map needs even shards; jax rejects an uneven
+    device_put outright). Padded rows are inert by construction: the
+    trainer's bag/validity masks ride through this same helper, so their
+    padding is 0.0 and the padded rows never contribute to histogram counts
+    or root grad/hess sums (the masked products in ops/grow.py)."""
+    pad = row_pad(mesh, arr.shape[row_axis])
+    if pad:
+        widths = [(0, 0)] * arr.ndim
+        widths[row_axis] = (0, pad)
+        arr = jnp.pad(arr, widths)
     spec = [None] * arr.ndim
     spec[row_axis] = "data"
     return jax.device_put(arr, NamedSharding(mesh, P(*spec)))

@@ -31,6 +31,11 @@ from ..ops.split import (
 )
 from .data_parallel import shard_map
 
+# jitted shard_map wrappers keyed by the trace-time constants (same fix as
+# data_parallel._FN_CACHE: the old fresh-jit-per-call form recompiled the
+# whole voting program for every tree)
+_FN_CACHE: Dict = {}
+
 
 @functools.lru_cache(maxsize=None)
 def _voting_split_fn(top_k: int, axis_name: str, two_way: bool = True):
@@ -172,9 +177,7 @@ def grow_tree_voting_parallel(
     all leaves at once) instead of the per-child split_fn."""
     meta_keys = sorted(feature_meta.keys())
     meta_vals = tuple(feature_meta[k] for k in meta_keys)
-    split_fn = _voting_split_fn(top_k, "data", two_way)
     cegb_on = cegb.enabled
-    rescan_fn = _voting_rescan_fn(top_k, "data", two_way) if cegb_on else None
     if cegb_on and cegb_state is None:
         F, N = bins.shape
         cegb_state = (
@@ -182,50 +185,63 @@ def grow_tree_voting_parallel(
             jnp.zeros((F, N) if cegb.has_lazy else (1, 1), bool),
         )
 
-    def local(bins_l, grad_l, hess_l, bag_l, fmask, fu, uid, *meta_flat):
-        meta = dict(zip(meta_keys, meta_flat))
-        return grow_tree(
-            bins_l,
-            grad_l,
-            hess_l,
-            bag_l,
-            fmask,
-            meta,
-            num_leaves=num_leaves,
-            max_depth=max_depth,
-            num_bins=num_bins,
-            params=params,
-            chunk=chunk,
-            hist_dtype=hist_dtype,
-            hist_mode=hist_mode,
-            two_way=two_way,
-            axis_name="data",
-            split_fn=split_fn,
-            psum_hist=False,  # histograms stay local; split_fn psums elected slice
-            forced_splits=forced_splits,
-            num_group_bins=num_group_bins,
-            cegb=cegb,
-            hist_pool_slots=hist_pool_slots,
-            cegb_state=(fu, uid) if cegb_on else None,
-            cegb_rescan=rescan_fn,
+    key = (
+        mesh, tuple(meta_keys), num_leaves, max_depth, num_bins,
+        num_group_bins, params, top_k, chunk, hist_dtype, hist_mode,
+        forced_splits, cegb, two_way, hist_pool_slots,
+    )
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        split_fn = _voting_split_fn(top_k, "data", two_way)
+        rescan_fn = (
+            _voting_rescan_fn(top_k, "data", two_way) if cegb_on else None
         )
 
-    row = P("data")
-    rep = P()
-    uid_spec = P(None, "data") if cegb.has_lazy else rep
-    state_out = ((rep, uid_spec),) if cegb_on else ()
-    fn = shard_map(
-        local,
-        mesh=mesh,
-        in_specs=(P(None, "data"), row, row, row, rep, rep, uid_spec)
-        + (rep,) * len(meta_vals),
-        out_specs=(rep, row) + state_out,
-        check_vma=False,
-    )
+        def local(bins_l, grad_l, hess_l, bag_l, fmask, fu, uid, *meta_flat):
+            meta = dict(zip(meta_keys, meta_flat))
+            return grow_tree(
+                bins_l,
+                grad_l,
+                hess_l,
+                bag_l,
+                fmask,
+                meta,
+                num_leaves=num_leaves,
+                max_depth=max_depth,
+                num_bins=num_bins,
+                params=params,
+                chunk=chunk,
+                hist_dtype=hist_dtype,
+                hist_mode=hist_mode,
+                two_way=two_way,
+                axis_name="data",
+                split_fn=split_fn,
+                psum_hist=False,  # histograms stay local; split_fn psums elected slice
+                forced_splits=forced_splits,
+                num_group_bins=num_group_bins,
+                cegb=cegb,
+                hist_pool_slots=hist_pool_slots,
+                cegb_state=(fu, uid) if cegb_on else None,
+                cegb_rescan=rescan_fn,
+            )
+
+        row = P("data")
+        rep = P()
+        uid_spec = P(None, "data") if cegb.has_lazy else rep
+        state_out = ((rep, uid_spec),) if cegb_on else ()
+        fn = jax.jit(shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(None, "data"), row, row, row, rep, rep, uid_spec)
+            + (rep,) * len(meta_vals),
+            out_specs=(rep, row) + state_out,
+            check_vma=False,
+        ))
+        _FN_CACHE[key] = fn
     if cegb_on:
         fu_in, uid_in = cegb_state
     else:
         fu_in, uid_in = jnp.zeros((1,), bool), jnp.zeros((1, 1), bool)
-    return jax.jit(fn)(
+    return fn(
         bins, grad, hess, bag_mask, feature_mask, fu_in, uid_in, *meta_vals
     )

@@ -77,6 +77,24 @@ def _stopper_key(stopper) -> List:
     return [int(stopper.stopping_rounds), bool(stopper.first_metric_only)]
 
 
+def _mesh_desc(gbdt) -> Optional[Dict]:
+    """Shard layout of a parallel-learner training, or None for serial.
+
+    Recorded in the manifest and ENFORCED on resume: per-shard histogram
+    partials combine with one psum, so the f32 accumulation grouping — and
+    therefore every downstream split decision — depends on the shard
+    layout. Resuming on a different device count would diverge silently;
+    it must be a loud error instead (ISSUE 8)."""
+    kind = gbdt._learner_kind()
+    if kind == "serial":
+        return None
+    mesh = gbdt._mesh()
+    return {
+        "learner": kind,
+        "axes": {str(k): int(v) for k, v in dict(mesh.shape).items()},
+    }
+
+
 def _valid_idents(gbdt) -> List[List]:
     """Per-valid-set identity (row count + label digest): the carry arrays
     are stored positionally, and two same-sized valid sets attached in a
@@ -186,8 +204,11 @@ def save_checkpoint(
         "early_stopping": _stopper_states(cbs_after or []),
         "n_valid": len(getattr(gbdt, "valid_scores", [])),
         "valid_ident": _valid_idents(gbdt),
+        "mesh": _mesh_desc(gbdt),
     }
-    arrays: Dict[str, np.ndarray] = {"scores": np.asarray(gbdt.scores)}
+    # canonical [K, N] carry: any sharded-chunk row padding is dropped so
+    # the artifact bytes do not depend on the mesh that produced them
+    arrays: Dict[str, np.ndarray] = {"scores": gbdt.scores_canonical_np()}
     for i, vs in enumerate(getattr(gbdt, "valid_scores", [])):
         arrays["valid_scores_%d" % i] = np.asarray(vs)
     state = gbdt._feat_rng.get_state()
@@ -321,6 +342,29 @@ def restore(booster, path: str, cbs_after=None) -> Checkpoint:
                 "resume: training parameters differ from the checkpoint's; "
                 "the resumed run will NOT be bit-identical to the original"
             )
+        live_mesh = _mesh_desc(gbdt)
+        if "mesh" not in m:
+            # pre-ISSUE-8 checkpoint: no shard layout was recorded, so a
+            # mismatch cannot be DETECTED — warn rather than reject a
+            # checkpoint that may well be on the identical layout
+            if live_mesh is not None:
+                log.warning(
+                    "resume: checkpoint predates mesh recording; cannot "
+                    "verify the shard layout matches — the resumed run is "
+                    "bit-identical only if the device layout is unchanged"
+                )
+        elif m["mesh"] != live_mesh:
+            # never silently re-shard the carries: per-shard histogram
+            # psums make the f32 accumulation grouping part of the model's
+            # arithmetic, so a different device count diverges from the
+            # original run (docs/DataParallel.md §Checkpoint semantics)
+            raise LightGBMError(
+                "checkpoint was taken on mesh %r but the resumed setup is "
+                "%r — the sharded histogram accumulation depends on the "
+                "device layout, so resuming would NOT replay the original "
+                "run; resume on an identical mesh (same tree_learner, same "
+                "device count / num_machines)" % (m["mesh"], live_mesh)
+            )
         n_valid = len(getattr(gbdt, "valid_scores", []))
         if int(m["n_valid"]) != n_valid:
             raise LightGBMError(
@@ -354,8 +398,12 @@ def restore(booster, path: str, cbs_after=None) -> Checkpoint:
         # position the original run was at
         gbdt.iter_ = int(m["iter"])
         gbdt.num_init_iteration = int(m.get("num_init_iteration", 0))
-        # device carries: exact f32 bits back onto the device
+        # device carries: exact f32 bits back onto the device (canonical
+        # [K, N]; the sharded chunk path re-pads + re-shards on its next
+        # dispatch — padding is zeros there by construction, so the resumed
+        # padded carry is byte-identical to the uninterrupted one)
         gbdt.scores = jnp.asarray(ckpt.arrays["scores"])
+        gbdt._chunk_carries_placed = False
         for i in range(n_valid):
             gbdt.valid_scores[i] = jnp.asarray(ckpt.arrays["valid_scores_%d" % i])
         # host RNG stream position (feature_fraction draws)

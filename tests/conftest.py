@@ -61,7 +61,8 @@ _QUICK_MODULES = {
     "test_hist_modes", "test_metric_alias",
     "test_micro_exact", "test_model_io", "test_model_obs", "test_native",
     "test_obs",
-    "test_ops", "test_param_docs", "test_prof", "test_resil",
+    "test_ops", "test_parallel_chunk", "test_param_docs", "test_prof",
+    "test_resil",
     "test_serve_drift", "test_serve_packed",
     "test_serve_resil", "test_serve_server", "test_snapshot_timers",
     "test_vfile",

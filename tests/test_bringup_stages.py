@@ -27,7 +27,7 @@ def test_stage_table_complete():
     assert set(tb.STAGE_TIMEOUTS) == {
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "bench_early",
         "smoke_pallas", "smoke_xla_radix", "smoke_bf16", "smoke_psplit",
-        "bench_chunk", "bench_predict", "prof", "bench",
+        "bench_chunk", "bench_multichip", "bench_predict", "prof", "bench",
     }
 
 
@@ -62,6 +62,50 @@ def test_bench_chunk_sweeps_and_reports_winner():
                    "host_wall_per_iter_s", "device_gap_per_iter_s",
                    "update_chunk"):
         assert needle in tb.BENCH_CHUNK, needle
+
+
+def test_bench_multichip_stage_and_report_adoption(tmp_path):
+    """The multichip stage's summary record must carry the shape
+    load_bench_records adopts (a "metric" key + the scaling list) so
+    MULTICHIP_r*.json charts in the HTML run report next to BENCH_r*."""
+    import importlib.util
+    import json
+    import os
+
+    assert "bench_multichip" in tb.STAGE_TIMEOUTS
+    # the runner exists and targets the sweep entry point
+    import inspect
+
+    src = inspect.getsource(tb.run_multichip)
+    assert "multichip_bench.py" in src and "--sweep" in src
+    assert "MULTICHIP_r" in src
+
+    # a synthetic record round-trips the adoption rule + the report section
+    rec = {
+        "metric": "higgs_multichip_iters_per_sec", "unit": "iters/s",
+        "value": 3.5, "platform": "cpu", "speedup_vs_1dev": 2.9,
+        "scaling": [
+            {"devices": 1, "iters_per_sec": 1.2},
+            {"devices": 4, "iters_per_sec": 2.8},
+            {"devices": 8, "iters_per_sec": 3.5},
+        ],
+    }
+    p = tmp_path / "MULTICHIP_r99.json"
+    p.write_text(json.dumps({"t": "2026-08-04", **rec}))
+    spec = importlib.util.spec_from_file_location(
+        "lgbtpu_report_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "lightgbm_tpu", "obs", "report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    recs = mod.load_bench_records(str(tmp_path / "MULTICHIP_r*.json"))
+    assert len(recs) == 1
+    html = mod.render(bench_records=recs, title="t")
+    assert "Multichip scaling" in html
+    assert "2.90x" in html
+    # a scaling record must NOT pollute the plain bench series section
+    assert "headline iters/s per round" not in html
 
 
 def test_bench_predict_measures_serving_numbers():

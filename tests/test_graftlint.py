@@ -171,6 +171,39 @@ def test_jx007_axis_index_first_positional(tmp_path):
     assert len(findings) == 1 and "dtaa" in findings[0].message
 
 
+def test_jx007_shard_map_specs_and_splatted_partition_specs():
+    """The ISSUE-8 extension: shard_map in_specs/out_specs string literals
+    and — in parallel/ files — the build-a-spec-then-splat idiom
+    (``spec[i] = "axis"; P(*spec)``) are policed against declared axes.
+    Fixtures live under golden/lint/parallel/ so the dir scope engages."""
+    bad = os.path.join(LINT_DIR, "parallel", "jx007_specs_bad.py")
+    findings = _lint(bad, "JX007")
+    assert sorted(f.detail for f in findings) == ["axis=model", "axis=rows"]
+    good = os.path.join(LINT_DIR, "parallel", "jx007_specs_good.py")
+    assert _lint(good, "JX007") == []
+
+
+def test_jx007_shard_map_specs_no_double_report(tmp_path):
+    """Strings INSIDE P() calls within shard_map spec kwargs are reported
+    once (by the PartitionSpec branch), not twice."""
+    src = (
+        "import numpy as np\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "from jax.experimental.shard_map import shard_map\n\n"
+        "def make_mesh(devices):\n"
+        "    return Mesh(np.array(devices), ('data',))\n\n"
+        "def wrap(f, mesh):\n"
+        "    return shard_map(f, mesh=mesh, in_specs=(P('rows'),),\n"
+        "                     out_specs=P('rows'))\n"
+    )
+    p = tmp_path / "parallel"
+    p.mkdir()
+    f = p / "dup.py"
+    f.write_text(src)
+    findings = run_lint([str(f)], root=str(tmp_path), select=["JX007"])
+    assert len(findings) == 2, [x.format() for x in findings]  # one per P()
+
+
 def test_jx007_needs_a_mesh_declaration(tmp_path):
     """Without any Mesh() in scope the axis check cannot validate and
     stays silent instead of guessing."""

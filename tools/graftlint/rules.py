@@ -375,13 +375,35 @@ def jx007_undeclared_axis(ctx: FileContext, project: ProjectContext) -> Iterator
     match an axis declared on a ``Mesh`` (parallel/mesh.py). A typo'd axis
     fails only at run time — deep inside shard_map, on the hardware — so
     catch it at review time. Skipped when no Mesh declaration is in scope.
+
+    Beyond the direct call forms, two indirect spellings are policed:
+
+      * ``shard_map(..., in_specs=..., out_specs=...)`` — every string
+        literal inside the spec expressions (PartitionSpec members are
+        already covered by the P() branch; bare strings outside a P call
+        are caught here);
+      * in ``parallel/`` files, the build-a-spec-then-splat idiom
+        ``spec[i] = "axis"; P(*spec)`` — the assignment's string is an axis
+        name even though no P() call contains it.
     """
     declared = project.declared_axes
     if not declared:
         return
 
-    def check_strings(node: ast.AST, where: str) -> Iterator[Finding]:
+    def check_strings(node: ast.AST, where: str, skip_p: bool = False) -> Iterator[Finding]:
+        skipped: set = set()
+        if skip_p:
+            # strings inside nested PartitionSpec/P calls are reported by
+            # the dedicated branch below — avoid double findings
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    nm = dotted_name(sub.func)
+                    if nm and nm.rsplit(".", 1)[-1] in ("PartitionSpec", "P"):
+                        for inner in ast.walk(sub):
+                            skipped.add(id(inner))
         for sub in ast.walk(node):
+            if id(sub) in skipped:
+                continue
             if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
                 if sub.value not in declared:
                     yield ctx.finding(
@@ -392,7 +414,36 @@ def jx007_undeclared_axis(ctx: FileContext, project: ProjectContext) -> Iterator
                         detail="axis=%s" % sub.value,
                     )
 
+    # names splatted into PartitionSpec calls (P(*spec)): subscript
+    # assignments of string literals into those names are axis names
+    splatted: set = set()
+    in_parallel_dir = "parallel" in ctx.rel_path.split("/")[:-1]
+    if in_parallel_dir:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            nm = dotted_name(node.func)
+            if not nm or nm.rsplit(".", 1)[-1] not in ("PartitionSpec", "P"):
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Starred) and isinstance(
+                    arg.value, ast.Name
+                ):
+                    splatted.add(arg.value.id)
+
     for node in ast.walk(ctx.tree):
+        if (
+            in_parallel_dir
+            and isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Subscript)
+            and isinstance(node.targets[0].value, ast.Name)
+            and node.targets[0].value.id in splatted
+        ):
+            yield from check_strings(
+                node.value, "a PartitionSpec built via %s[...] = ..."
+                % node.targets[0].value.id,
+            )
         if not isinstance(node, ast.Call):
             continue
         fname = dotted_name(node.func)
@@ -402,6 +453,10 @@ def jx007_undeclared_axis(ctx: FileContext, project: ProjectContext) -> Iterator
         for kw in node.keywords:
             if kw.arg in ("axis_name", "axis_names"):
                 yield from check_strings(kw.value, "%s(%s=...)" % (attr, kw.arg))
+            elif attr == "shard_map" and kw.arg in ("in_specs", "out_specs"):
+                yield from check_strings(
+                    kw.value, "shard_map(%s=...)" % kw.arg, skip_p=True
+                )
         if attr in _COLLECTIVES:
             # axis_index(axis_name) takes the axis first; the reduction
             # collectives take (operand, axis_name)
