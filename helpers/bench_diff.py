@@ -55,6 +55,7 @@ THRESHOLDS = {
     "predict_rows_drop_pct": 10.0,
     "predict_p99_rise_pct": 25.0,
     "segment_share_shift_pts": 10.0,
+    "scaling_eff_drop": 0.10,
 }
 
 PASS, WARN, FAIL, SKIP = "PASS", "WARN", "FAIL", "SKIP"
@@ -208,6 +209,26 @@ def compare(
             "%d alert(s)" % int(drift_alerts), "0 alerts, psi<=0.2", status,
             "max psi %.3f (%s)" % (worst_v, worst_k) if worst_k else "",
         ))
+
+    # multichip scaling efficiency (helpers/multichip_bench.py): a drop
+    # between MULTICHIP rounds means the pod curve bent — same-platform
+    # only, and a WARN rather than a FAIL (device counts, chip generations
+    # and comms fabric vary between capture environments; the
+    # comms_fraction attribution in the record says why)
+    bse = baseline.get("scaling_efficiency")
+    cse = current.get("scaling_efficiency")
+    if bse is not None and cse is not None:
+        if not same_platform:
+            rows.append(_row("scaling_efficiency", bse, cse, "-", SKIP,
+                             plat_note))
+        else:
+            d = float(cse) - float(bse)
+            status = WARN if d < -th["scaling_eff_drop"] else PASS
+            rows.append(_row(
+                "scaling_efficiency", bse, cse,
+                ">-%.2f" % th["scaling_eff_drop"], status,
+                "%+.3f (never a hard FAIL; see comms_fraction)" % d,
+            ))
 
     # growth-segment share drift (profiler breakdown, obs/prof.py)
     bs = baseline.get("growth_segments_s") or {}

@@ -33,6 +33,16 @@
 #                               # sharded-chunk model strings must match
 #                               # bit for bit, one train_chunk compile,
 #                               # serial-learner structural cross-check
+#   helpers/check.sh --dist-obs # lint gate, then the distributed-obs smoke:
+#                               # segmented sharded chunk bitwise-identical
+#                               # to the fused one (model strings + score
+#                               # carries) on 8 forced CPU devices, merged
+#                               # pod registry exposition (counters == the
+#                               # per-process sums), merged Perfetto trace
+#                               # with disjoint pids, MULTICHIP record with
+#                               # comms_fraction + scaling_efficiency, and
+#                               # the HTML Multichip report page — from ONE
+#                               # invocation (docs/Observability.md)
 #   helpers/check.sh --bench-diff [CUR BASE]
 #                               # the bench regression gate: golden-fixture
 #                               # self-test (synthetic regression must FAIL,
@@ -51,9 +61,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -117,6 +127,11 @@ fi
 if [ "$MODE" = "--multichip" ]; then
     echo "== multichip smoke (8 forced CPU devices, sharded-chunk bit-identity) =="
     exec python helpers/multichip_smoke.py
+fi
+
+if [ "$MODE" = "--dist-obs" ]; then
+    echo "== dist-obs smoke (segmented sharded chunk + merged registry/trace/report) =="
+    exec env JAX_PLATFORMS=cpu python helpers/dist_obs_smoke.py
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

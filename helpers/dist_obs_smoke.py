@@ -1,0 +1,239 @@
+"""check.sh --dist-obs: the distributed-observability stack, one invocation.
+
+Composes every ISSUE-10 surface and asserts the acceptance bundle:
+
+  * an 8-forced-CPU-device worker trains the SAME data twice — fused
+    sharded chunks vs `obs/dist.segmented_train_chunk` (every sub-step a
+    fenced shard_map dispatch) — and HARD-FAILS on any model-string or
+    score-carry mismatch; with the dist-obs features off the retrace
+    watchdog must count exactly ONE train_chunk compile (no new traces);
+    `profile_sharded_growth` must report bitwise identity vs the fused
+    grower plus a well-formed comms_fraction/per-device breakdown, and the
+    N=1003-over-8 shard-skew gauges must show the known 7x126+121 split;
+  * a second (2-device) worker plays the other pod rank for the FILE-BASED
+    merge path: both ranks' registry snapshots merge into one Prometheus
+    exposition whose counters equal the per-process sums, and both ranks'
+    Chrome traces merge into one Perfetto timeline with disjoint pids;
+  * a tiny multichip_bench --sweep 1,2 produces a MULTICHIP-shaped record
+    carrying comms_fraction + scaling_efficiency + per-device segment
+    seconds;
+  * obs/report.py renders an HTML report whose Multichip section charts
+    the scaling efficiency, the comms/compute split and the per-device
+    table.
+
+Everything lands under a temp dir; the repo's MULTICHIP_r*.json evidence
+series is never touched.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = textwrap.dedent(
+    """
+    import json, sys
+    sys.path.insert(0, "@REPO@")
+    rank = int(sys.argv[1])
+    devices = int(sys.argv[2])
+    snap_path = sys.argv[3]
+    from lightgbm_tpu.utils.platform import force_cpu_devices
+    jax = force_cpu_devices(devices)
+    import numpy as np
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import dist, registry, retrace as retrace_mod
+
+    N, F, ROUNDS, CHUNK = (1003, 6, 9, 4) if rank == 0 else (512, 4, 5, 2)
+    rng = np.random.RandomState(7 + rank)
+    X = rng.randn(N, F)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+              "tree_learner": "data", "num_machines": devices,
+              "device_chunk_size": CHUNK,
+              "bagging_freq": 2, "bagging_fraction": 0.8}
+
+    before = retrace_mod.counts().get("gbdt.train_chunk", 0)
+    fused = lgb.train(params, lgb.Dataset(X, label=y), ROUNDS)
+    compiles = retrace_mod.counts().get("gbdt.train_chunk", 0) - before
+    # dist-obs features are OFF here: the skew gauges are host math and the
+    # wait fences are env-gated, so the watchdog must see exactly the one
+    # chunk compile the pre-ISSUE-10 path had
+    assert compiles == 1, "expected 1 train_chunk compile, saw %d" % compiles
+
+    out = {"rank": rank, "devices": devices, "compiles": compiles}
+    if rank == 0:
+        seg = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+        seg.update()
+        done = 1
+        while done < ROUNDS:
+            d, stopped = dist.segmented_train_chunk(
+                seg._gbdt, min(CHUNK, ROUNDS - done))
+            done += d
+            if stopped:
+                break
+        m_f = fused.model_to_string().split("parameters:")[0]
+        m_s = seg.model_to_string().split("parameters:")[0]
+        assert m_f == m_s, (
+            "fused-chunk vs SEGMENTED-chunk MODEL STRING MISMATCH")
+        assert np.array_equal(fused._gbdt.scores_canonical_np(),
+                              seg._gbdt.scores_canonical_np()), (
+            "fused vs segmented score carries differ")
+        prof = dist.profile_sharded_growth(fused, iters=1)
+        assert prof["bitwise_identical"], "segmented grower not bitwise"
+        assert 0.0 < prof["comms_fraction"] < 1.0, prof["comms_fraction"]
+        assert set(prof["collective_segments"]) <= set(
+            prof["segments_per_tree_s"])
+        rows = sorted(e["rows"] for e in prof["per_device"])
+        assert rows == [121] + [126] * 7, rows
+        shard_g = registry.REGISTRY.gauge("train_shard_rows").values()
+        assert sum(shard_g.values()) == N, shard_g
+        out.update(model_match=True, comms_fraction=prof["comms_fraction"],
+                   dist_segments=prof["segments_per_tree_s"],
+                   per_device=prof["per_device"])
+    # every rank publishes something distinguishable and snapshots itself
+    registry.REGISTRY.counter("dist_smoke_total").inc(10 * (rank + 1))
+    registry.REGISTRY.gauge("dist_smoke_rank").set(float(rank))
+    snap = dist.snapshot()
+    snap["process"] = rank
+    with open(snap_path, "w") as fh:
+        json.dump(snap, fh)
+    out["counters"] = registry.REGISTRY.counters()
+    print("RESULT " + json.dumps(out), flush=True)
+    """
+).replace("@REPO@", REPO)
+
+
+def _run_worker(rank: int, devices: int, snap_path: str, trace_path: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=%d" % devices
+    ).strip()
+    env["LIGHTGBM_TPU_TRACE"] = trace_path
+    out = subprocess.run(
+        [sys.executable, "-c", WORKER, str(rank), str(devices), snap_path],
+        env=env, capture_output=True, text=True, cwd=REPO, timeout=1500,
+    )
+    sys.stderr.write(out.stderr[-2000:] if out.stderr else "")
+    rec = None
+    for line in (out.stdout or "").splitlines():
+        if line.startswith("RESULT "):
+            rec = json.loads(line[len("RESULT "):])
+    if out.returncode != 0 or rec is None:
+        print("dist_obs_smoke: rank %d worker FAILED (rc=%d)"
+              % (rank, out.returncode))
+        if out.stdout:
+            print(out.stdout[-1500:])
+        return None
+    return rec
+
+
+def main() -> int:
+    sys.path.insert(0, REPO)
+    from lightgbm_tpu.obs import dist, report, trace
+
+    tmp = tempfile.mkdtemp(prefix="dist_obs_smoke_")
+    snaps = [os.path.join(tmp, "reg.rank%d.json" % r) for r in range(2)]
+    traces = [os.path.join(tmp, "trace.rank%d.json" % r) for r in range(2)]
+
+    r0 = _run_worker(0, 8, snaps[0], traces[0])
+    r1 = _run_worker(1, 2, snaps[1], traces[1])
+    if r0 is None or r1 is None:
+        return 1
+    if not r0.get("model_match"):
+        print("dist_obs_smoke: segmented/fused identity not proven")
+        return 1
+
+    # ---- pod-wide registry merge (file-based rank fallback) -------------
+    merged = dist.merge_snapshots(
+        dist.merge_snapshot_files(os.path.join(tmp, "reg.rank*.json"))
+    )
+    expo = merged.prometheus_text()
+    expo_path = os.path.join(tmp, "merged_metrics.prom")
+    with open(expo_path, "w") as fh:
+        fh.write(expo)
+    want = sum(r["counters"].get("dist_smoke_total", 0) for r in (r0, r1))
+    got = merged.counter("dist_smoke_total").value()
+    if int(got) != int(want) or int(want) != 30:
+        print("dist_obs_smoke: merged counter %s != per-process sum %s"
+              % (got, want))
+        return 1
+    iters_want = sum(r["counters"].get("train_iterations", 0)
+                     for r in (r0, r1))
+    if int(merged.counter("train_iterations").value()) != int(iters_want):
+        print("dist_obs_smoke: merged train_iterations mismatch")
+        return 1
+    if ("lgbtpu_dist_smoke_rank" not in expo
+            or 'process="0"' not in expo or 'process="1"' not in expo):
+        print("dist_obs_smoke: gauge lost its process provenance label")
+        return 1
+
+    # ---- pod-wide trace merge ------------------------------------------
+    merged_trace = os.path.join(tmp, "trace_merged.json")
+    stats = trace.merge_traces(merged_trace, traces)
+    if stats["files"] != 2 or stats["pids"] < 2 or stats["events"] <= 0:
+        print("dist_obs_smoke: trace merge malformed: %s" % stats)
+        return 1
+
+    # ---- MULTICHIP record with the new attribution fields ---------------
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("LIGHTGBM_TPU_TRACE", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "helpers", "multichip_bench.py"),
+         "--sweep", "1,2", "--rows", "3000", "--iters", "4", "--chunk", "2",
+         "--leaves", "15"],
+        env=env, capture_output=True, text=True, cwd=REPO, timeout=1500,
+    )
+    summary = None
+    for line in (out.stdout or "").splitlines():
+        if line.strip().startswith("{"):
+            try:
+                summary = json.loads(line)
+            except ValueError:
+                continue
+    if not summary or not summary.get("ok"):
+        print("dist_obs_smoke: multichip sweep failed (rc=%d)\n%s"
+              % (out.returncode, (out.stderr or "")[-1000:]))
+        return 1
+    for key in ("comms_fraction", "scaling_efficiency", "dist_segments",
+                "per_device"):
+        if summary.get(key) is None:
+            print("dist_obs_smoke: MULTICHIP record missing %r" % key)
+            return 1
+    mc_path = os.path.join(tmp, "MULTICHIP_smoke.json")
+    with open(mc_path, "w") as fh:
+        json.dump(summary, fh)
+
+    # ---- HTML report with the Multichip page ----------------------------
+    html = report.render(
+        metrics={"gauges": {}, "counters": {}},
+        bench_records=[("MULTICHIP_smoke.json", summary)],
+        title="dist-obs smoke report",
+    )
+    html_path = os.path.join(tmp, "report.html")
+    with open(html_path, "w") as fh:
+        fh.write(html)
+    for marker in ("Multichip scaling", "scaling efficiency",
+                   "collective vs compute", "per-device shard table"):
+        if marker not in html:
+            print("dist_obs_smoke: report missing %r section" % marker)
+            return 1
+
+    print(
+        "dist_obs_smoke OK: segmented==fused sharded chunk (model strings + "
+        "score carries), 1 train_chunk compile, comms_fraction=%.3f, "
+        "shard rows 7x126+121; merged exposition (%s), merged trace "
+        "(%d events / %d pids -> %s), MULTICHIP record (eff=%.2f) and "
+        "Multichip report page (%s) all emitted"
+        % (r0["comms_fraction"], expo_path, stats["events"], stats["pids"],
+           merged_trace, summary["scaling_efficiency"], html_path)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

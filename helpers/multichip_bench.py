@@ -78,7 +78,7 @@ def measure(devices: int, rows: int, iters: int, chunk: int, leaves: int) -> dic
     run(iters)
     _ = float(np.ravel(np.asarray(bst._gbdt.scores))[0])
     dt = time.time() - t0
-    return {
+    rec = {
         "devices": n_dev,
         "iters_per_sec": round(iters / dt, 4),
         "first_dispatch_s": round(compile_s, 2),
@@ -86,12 +86,40 @@ def measure(devices: int, rows: int, iters: int, chunk: int, leaves: int) -> dic
         "platform": jax.default_backend(),
         "fallback_reason": bst._gbdt.device_chunk_fallback_reason(),
     }
+    if n_dev > 1:
+        # compute-vs-collective attribution (obs/dist.py): the segmented
+        # sharded profile says WHY scaling bends — comms_fraction,
+        # per-segment seconds, per-device rows/waits; its bitwise check
+        # re-proves the fused program was measured, not a lookalike.
+        # Never fatal to the bench measurement itself.
+        try:
+            from lightgbm_tpu.obs import dist as dist_mod
+
+            prof = dist_mod.profile_sharded_growth(bst, iters=1)
+            rec["comms_fraction"] = prof["comms_fraction"]
+            rec["dist_segments"] = prof["segments_per_tree_s"]
+            rec["dist_collective"] = prof["collective_segments"]
+            rec["collective_bytes_per_split"] = prof[
+                "collective_bytes_per_split"
+            ]
+            rec["per_device"] = prof["per_device"]
+            rec["dist_bitwise"] = prof["bitwise_identical"]
+        except Exception as e:
+            rec["dist_prof_error"] = repr(e)[:200]
+    return rec
 
 
 def sweep(counts, rows, iters, chunk, leaves) -> dict:
     points = []
     for d in counts:
         env = dict(os.environ)
+        if env.get("LIGHTGBM_TPU_TRACE"):
+            # per-worker trace files: the sweep's children inherit one env
+            # path and would clobber each other at exit; the driver merges
+            # them back with `python -m lightgbm_tpu.obs.trace merge`
+            env["LIGHTGBM_TPU_TRACE"] = "%s.dev%d" % (
+                env["LIGHTGBM_TPU_TRACE"], d,
+            )
         # a fresh process per device count: the jax device world is fixed
         # at backend init, so the sweep cannot reconfigure in-process
         out = subprocess.run(
@@ -125,6 +153,27 @@ def sweep(counts, rows, iters, chunk, leaves) -> dict:
         summary["speedup_vs_1dev"] = round(
             good[-1]["iters_per_sec"] / base["iters_per_sec"], 3
         )
+        # scaling efficiency vs the sweep's OWN n=1 point: measured
+        # iters/s over the ideal linear D x base — the MULTICHIP series'
+        # regression signal (helpers/bench_diff.py WARNs on drops)
+        eff = [
+            [p["devices"],
+             round(p["iters_per_sec"]
+                   / (p["devices"] * base["iters_per_sec"]), 4)]
+            for p in sorted(good, key=lambda p: p["devices"])
+        ]
+        summary["efficiency_by_devices"] = eff
+        summary["scaling_efficiency"] = eff[-1][1]
+    # adopt the attribution block of the widest profiled point so the
+    # MULTICHIP record itself says why scaling bends (obs/dist.py)
+    profiled = [p for p in good if p.get("comms_fraction") is not None]
+    if profiled:
+        top = profiled[-1]
+        for key in ("comms_fraction", "dist_segments", "dist_collective",
+                    "collective_bytes_per_split", "per_device",
+                    "dist_bitwise"):
+            if key in top:
+                summary[key] = top[key]
     return summary
 
 

@@ -738,6 +738,32 @@ def run_multichip(stage: str = "bench_multichip") -> dict:
         env=env,
     )
     result.setdefault("ok", bool(result.get("scaling")))
+    # fold the sweep workers' per-device-count traces (multichip_bench
+    # appends .dev<D> to the stage's trace path) into ONE Perfetto timeline
+    # with disjoint pids — obs/trace.py's merge, imported by file path so
+    # the driver stays jax-free
+    base_trace = os.environ.get("LIGHTGBM_TPU_TRACE")
+    if base_trace:
+        import glob as glob_mod
+        import importlib.util
+
+        child_traces = sorted(
+            glob_mod.glob("%s.stage_%s.dev*" % (base_trace, stage))
+        )
+        if child_traces:
+            try:
+                spec = importlib.util.spec_from_file_location(
+                    "lgbtpu_obs_trace",
+                    os.path.join(REPO, "lightgbm_tpu", "obs", "trace.py"),
+                )
+                tmod = importlib.util.module_from_spec(spec)
+                spec.loader.exec_module(tmod)
+                merged = "%s.stage_%s.merged.json" % (base_trace, stage)
+                stats = tmod.merge_traces(merged, child_traces)
+                result["merged_trace"] = merged
+                result["merged_trace_pids"] = stats["pids"]
+            except Exception as e:
+                result["merged_trace_error"] = repr(e)[:200]
     if result.get("ok") and "metric" in result:
         import glob
         import re

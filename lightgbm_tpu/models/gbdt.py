@@ -27,6 +27,7 @@ from ..config import Config
 from ..dataset import BinnedDataset
 from ..metric import Metric
 from ..obs import costs as costs_mod
+from ..obs import dist as dist_mod
 from ..obs import memwatch, retrace as retrace_mod
 from ..objective import ObjectiveFunction
 from ..ops import grow_native
@@ -744,6 +745,11 @@ class GBDT:
         # per-chunk peak accounting (allocator stats only — no buffer walk
         # inside the training loop; gated on LIGHTGBM_TPU_MEMWATCH)
         memwatch.auto_snapshot("chunk", light=True)
+        # straggler detection (LIGHTGBM_TPU_DIST_PROF=1 only): fence each
+        # score shard in device order and publish per-device completion
+        # offsets — zero overhead and zero new traces when off
+        if dist_mod.wait_profiling_enabled():
+            dist_mod.note_dispatch_waits(self.scores)
         base = len(self._device_trees)
         for idx, ta in enumerate(trees_out):  # iteration-major, class-minor
             self._device_trees.append((ta, idx % K))
@@ -791,6 +797,12 @@ class GBDT:
             )
             cached = (triples, (self._sharded_bins, valid_s) + row_args)
             self._chunk_shard_cache = cached
+            # shard-skew observability: per-device VALID row counts, once
+            # per training (pure host math on the padding rule — no device
+            # reads, no jit traces; obs/dist.py)
+            dist_mod.publish_shard_rows(
+                mesh, dist_mod.shard_valid_counts(N, int(mesh.shape["data"]))
+            )
         if (
             self.scores.shape[1] != Np
             or not getattr(self, "_chunk_carries_placed", False)

@@ -32,10 +32,14 @@ from __future__ import annotations
 from . import costs, flight, memwatch, modelstats, registry, retrace, trace  # noqa: F401
 from .registry import REGISTRY, MetricsRegistry  # noqa: F401
 
-# NOTE: obs.prof is imported lazily by its callers (it pulls ops/ modules,
-# which import jax-heavy code paths this package promises to avoid at
-# import time). obs.report is the run-report CLI
-# (`python -m lightgbm_tpu.obs.report`) and is imported on use.
+# NOTE: obs.prof and obs.dist (the mesh-aware distributed tier: sharded
+# compute-vs-collective attribution, pod-wide registry/trace merging,
+# shard-skew detection) are imported lazily by their callers (they pull
+# ops/ and parallel/ code paths this package promises to avoid at import
+# time — dist's merge helpers themselves stay jax-lazy). obs.report is
+# the run-report CLI (`python -m lightgbm_tpu.obs.report`) and is
+# imported on use; `python -m lightgbm_tpu.obs.trace merge` folds
+# per-process trace files into one timeline.
 
 # cross-wiring: the default registry's watchdog/memory gauges pull live
 # values at read time, so any exposition (serve /metrics, run_report) is

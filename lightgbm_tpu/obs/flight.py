@@ -193,6 +193,21 @@ def build_manifest(
         backend = jax.default_backend()
     except Exception as e:  # manifest must never fail the run
         log.debug("flight: backend/version probe failed: %r" % (e,))
+    # THE process-identity helper (obs/dist.py) — one rank-determination
+    # rule shared with the pod-wide snapshot merge
+    from . import dist as dist_mod
+
+    process_index, process_count = dist_mod.process_info()
+    # mesh provenance (resil/checkpoint's ONE mesh descriptor): pod ranks'
+    # flight logs are load()-joinable by iteration only if each records
+    # which shard layout produced it
+    mesh = None
+    try:
+        from ..resil.checkpoint import _mesh_desc
+
+        mesh = _mesh_desc(gbdt)
+    except Exception as e:
+        log.debug("flight: mesh probe failed: %r" % (e,))
     man: Dict[str, Any] = {
         "config_digest": config_digest(gbdt.config),
         "objective": gbdt.config.objective,
@@ -207,8 +222,12 @@ def build_manifest(
         "init_iteration": int(init_iteration),
         "backend": backend,
         "versions": versions,
+        "process_index": process_index,
+        "process_count": process_count,
         "started_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if mesh is not None:
+        man["mesh"] = mesh
     if resume_from:
         man["resume_from"] = str(resume_from)
         man["resumed_at_iteration"] = int(gbdt.iter_)
@@ -228,9 +247,21 @@ def note_boundary(
         [str(d), str(m), float(v)]
         for (d, m, v, _b) in (evaluation_result_list or [])
     ]
+    extra: Dict[str, Any] = {}
+    try:
+        # collective seconds the sharded segment profiler measured since
+        # the previous boundary (obs/dist.py; 0.0 — and no field — unless
+        # distributed profiling ran inside this window)
+        from . import dist as dist_mod
+
+        comms = dist_mod.take_boundary_comms()
+        if comms > 0:
+            extra["comms_s"] = round(comms, 6)
+    except Exception as e:  # recording must never fail the boundary
+        log.debug("flight: comms probe failed: %r" % (e,))
     rec.record(
         "iteration", iteration=int(iteration), chunk=int(done),
-        dt_s=round(float(dt_s), 6), evals=evals,
+        dt_s=round(float(dt_s), 6), evals=evals, **extra,
     )
 
 

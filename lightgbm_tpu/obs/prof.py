@@ -654,7 +654,10 @@ def _publish(record: Dict[str, object], registry=None) -> None:
     reg = registry if registry is not None else registry_mod.REGISTRY
     g = reg.gauge("growth_segment_seconds_total")
     for name, secs in SEGMENTS.seconds.items():
-        g.set(secs, segment=name)
+        # the serial profiler's segments are all on-device compute; the
+        # sharded profiler (obs/dist.py) publishes its psum segments into
+        # the same family with collective="true"
+        g.set(secs, segment=name, collective="false")
     reg.gauge("growth_segment_sum_ratio").set(
         float(record.get("segment_sum_ratio") or 0.0)
     )

@@ -277,6 +277,37 @@ class MetricsRegistry:
                 out[name] = int(sum(m.values().values()))
         return out
 
+    def snapshot(self) -> Dict[str, object]:
+        """Label-preserving JSON-able capture of every instrument — the
+        unit the pod-wide merge folds (obs/dist.py merge_snapshots):
+        counters/gauges as ``{name: [[[k, v] label pairs, value], ...]}``,
+        rates as scalars, histograms as their summary snapshot."""
+        counters: Dict[str, list] = {}
+        gauges: Dict[str, list] = {}
+        rates: Dict[str, float] = {}
+        summaries: Dict[str, Dict[str, float]] = {}
+        for name, m in self._items():
+            if isinstance(m, Counter):
+                counters[name] = [
+                    [[list(kv) for kv in labels], v]
+                    for labels, v in sorted(m.values().items())
+                ]
+            elif isinstance(m, Gauge):
+                gauges[name] = [
+                    [[list(kv) for kv in labels], v]
+                    for labels, v in sorted(m.values().items())
+                ]
+            elif isinstance(m, RateMeter):
+                rates[name] = round(m.rate(), 6)
+            elif isinstance(m, Histogram):
+                summaries[name] = Histogram.snapshot(m)
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "rates": rates,
+            "summaries": summaries,
+        }
+
     # -- renderers ---------------------------------------------------------
 
     def prometheus_text(self) -> str:

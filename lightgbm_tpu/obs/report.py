@@ -191,6 +191,64 @@ def svg_bar_chart(
     return "".join(out)
 
 
+def svg_stacked_bars(
+    items: Sequence[Tuple[str, Sequence[Tuple[str, float, str]]]],
+    title: str = "", width: int = 640, unit: str = "",
+) -> str:
+    """Horizontal stacked bars: one row per item, each a list of
+    (segment_name, value, color) parts — the comms-vs-compute split of the
+    Multichip section. A legend is built from the distinct segment names."""
+    items = [(k, [(n, v, c) for n, v, c in parts if v and v > 0])
+             for k, parts in items]
+    items = [(k, parts) for k, parts in items if parts]
+    if not items:
+        return ""
+    vmax = max(sum(v for _, v, _ in parts) for _, parts in items) or 1.0
+    row_h, ml = 22, 170
+    legend: List[Tuple[str, str]] = []
+    for _, parts in items:
+        for n, _, c in parts:
+            if (n, c) not in legend:
+                legend.append((n, c))
+    height = 28 + row_h * len(items) + 18
+    out = ['<svg width="%d" height="%d" role="img">' % (width, height)]
+    if title:
+        out.append(
+            '<text x="6" y="15" font-size="13" font-weight="600">%s</text>'
+            % _esc(title)
+        )
+    for i, (name, parts) in enumerate(items):
+        y = 26 + i * row_h
+        out.append(
+            '<text x="%d" y="%d" font-size="12" text-anchor="end">%s</text>'
+            % (ml - 6, y + 12, _esc(str(name)[:24]))
+        )
+        x = float(ml)
+        total = sum(v for _, v, _ in parts)
+        for _, v, color in parts:
+            w = max((width - ml - 130) * v / vmax, 1.0)
+            out.append(
+                '<rect x="%.1f" y="%d" width="%.1f" height="14" '
+                'fill="%s"/>' % (x, y, w, color)
+            )
+            x += w
+        out.append(
+            '<text class="barlabel" x="%.1f" y="%d">%s%s</text>'
+            % (x + 5, y + 12, _fmt(total), _esc(unit))
+        )
+    ly = 26 + row_h * len(items) + 4
+    lx = ml
+    for name, color in legend:
+        out.append(
+            '<rect x="%d" y="%d" width="10" height="10" fill="%s"/>'
+            '<text x="%d" y="%d" font-size="11">%s</text>'
+            % (lx, ly, color, lx + 14, ly + 9, _esc(name[:18]))
+        )
+        lx += 14 + 7 * min(len(name), 18) + 18
+    out.append("</svg>")
+    return "".join(out)
+
+
 def _table(headers: Sequence[str], rows: Sequence[Sequence[object]]) -> str:
     out = ["<table><tr>"]
     out.extend("<th>%s</th>" % _esc(h) for h in headers)
@@ -353,13 +411,35 @@ def _section_drift(metrics: Dict, drift: Optional[Dict]) -> str:
     )
 
 
+def _multichip_efficiency(rec: Dict) -> List[Point]:
+    """(devices, scaling efficiency) points: measured iters/s at D devices
+    over the ideal D x (the sweep's n=1 measurement). Prefers the record's
+    own ``efficiency_by_devices`` (helpers/multichip_bench.py) and falls
+    back to recomputing from the scaling list."""
+    eff = rec.get("efficiency_by_devices")
+    if eff:
+        return [(float(d), float(e)) for d, e in eff]
+    pts = sorted(
+        (float(p["devices"]), float(p["iters_per_sec"]))
+        for p in rec.get("scaling") or []
+        if p.get("iters_per_sec")
+    )
+    base = next((v for d, v in pts if d == 1), None)
+    if not base:
+        return []
+    return [(d, v / (d * base)) for d, v in pts]
+
+
 def _section_multichip(records: List[Tuple[str, Dict]]) -> str:
-    """Devices-vs-iters/s scaling curves (MULTICHIP_r*.json records carry a
-    ``scaling`` list; helpers/multichip_bench.py) charted next to the
-    BENCH_r* series so one report answers both 'how fast' and 'how does it
-    scale'."""
+    """The Multichip page: devices-vs-iters/s scaling curves, measured-vs-
+    ideal scaling efficiency, the comms/compute split (obs/dist.py
+    attribution), and the latest round's per-device shard table — one
+    report answers 'how fast', 'how does it scale', and 'WHY it bends'."""
     series: List[Tuple[str, List[Point]]] = []
+    eff_series: List[Tuple[str, List[Point]]] = []
+    stacked = []
     rows = []
+    latest_devices = None
     for name, rec in records:
         pts = [
             (float(p["devices"]), float(p["iters_per_sec"]))
@@ -368,12 +448,28 @@ def _section_multichip(records: List[Tuple[str, Dict]]) -> str:
         ]
         if not pts:
             continue
-        series.append((name.replace(".json", ""), sorted(pts)))
+        short = name.replace(".json", "")
+        series.append((short, sorted(pts)))
+        eff = _multichip_efficiency(rec)
+        if eff:
+            eff_series.append((short, eff))
+        cf = rec.get("comms_fraction")
+        if cf is not None:
+            cf = float(cf)
+            stacked.append((short, [
+                ("comms", cf * 100.0, "#dc2626"),
+                ("compute", (1.0 - cf) * 100.0, "#2563eb"),
+            ]))
+        if rec.get("per_device"):
+            latest_devices = (short, rec["per_device"])
         rows.append((
             name, rec.get("platform", "?"),
             " / ".join("%g@%d" % (v, int(d)) for d, v in sorted(pts)),
             "-" if rec.get("speedup_vs_1dev") is None
             else "%.2fx" % rec["speedup_vs_1dev"],
+            "-" if rec.get("scaling_efficiency") is None
+            else "%.0f%%" % (float(rec["scaling_efficiency"]) * 100),
+            "-" if cf is None else "%.1f%%" % (cf * 100),
         ))
     if not series:
         return ""
@@ -382,9 +478,42 @@ def _section_multichip(records: List[Tuple[str, Dict]]) -> str:
         series, title="devices vs iters/s (data-parallel sharded chunk)",
         y_zero=True,
     ))
+    if eff_series:
+        # ideal = 1.0 reference line spanning the measured device range
+        xs = [x for _, pts in eff_series for x, _ in pts]
+        eff_series = eff_series + [
+            ("ideal", [(min(xs), 1.0), (max(xs), 1.0)])
+        ]
+        out.append(svg_line_chart(
+            eff_series,
+            title="scaling efficiency (measured / ideal linear)",
+            y_zero=True,
+        ))
+    if stacked:
+        out.append(svg_stacked_bars(
+            stacked,
+            title="tree-growth time split: collective vs compute "
+                  "(obs/dist.py)",
+            unit="%",
+        ))
     out.append(_table(
-        ("record", "platform", "iters/s @ devices", "speedup vs 1 dev"), rows
+        ("record", "platform", "iters/s @ devices", "speedup vs 1 dev",
+         "scaling eff", "comms"),
+        rows,
     ))
+    if latest_devices:
+        short, per_dev = latest_devices
+        out.append(
+            '<div class="small">per-device shard table (%s)</div>' % short
+        )
+        out.append(_table(
+            ("device", "rows", "wait s"),
+            [
+                (d.get("device", "?"), d.get("rows", "-"),
+                 "-" if d.get("wait_s") is None else "%.4f" % d["wait_s"])
+                for d in per_dev
+            ],
+        ))
     return "".join(out)
 
 

@@ -162,11 +162,37 @@ def start(path: Optional[str] = None) -> Tracer:
             raise ValueError(
                 "trace.start() needs a path (or set %s)" % ENV_TRACE
             )
+        if path is None:
+            # jax.distributed runs: every rank inherits the SAME env var, so
+            # an env-derived default path gets a .rank<N> suffix — two ranks
+            # must never clobber one trace file. Explicit paths are the
+            # caller's responsibility (bringup already appends .stage_*).
+            target = _rank_suffixed(target)
         _TRACER = Tracer(target)
         if not _ATEXIT_ARMED:
             _ATEXIT_ARMED = True
             atexit.register(_atexit_flush)
         return _TRACER
+
+
+def _rank_suffixed(target: str) -> str:
+    """``<target>.rank<N>`` when a multi-process jax.distributed world is
+    initialized (consults only an already-imported jax; never imports it)."""
+    if ".rank" in target:
+        return target
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return target
+    try:
+        if int(jx.process_count()) > 1:
+            return "%s.rank%d" % (target, int(jx.process_index()))
+    except Exception as e:
+        # a half-initialized runtime must not break tracing; the
+        # single-file default stands
+        from ..utils import log
+
+        log.debug("trace: rank probe failed: %r" % (e,))
+    return target
 
 
 def stop() -> Optional[str]:
@@ -257,3 +283,95 @@ def instant(name: str, cat: str = "", **args) -> None:
     tr = active()
     if tr is not None:
         tr.instant(name, cat, args or None)
+
+
+# ---------------------------------------------------------------------------
+# multi-file merge: fold per-process/per-rank traces into ONE timeline
+# ---------------------------------------------------------------------------
+
+def merge_traces(out_path: str, in_paths) -> Dict:
+    """Fold several Chrome-trace files (a bringup's per-stage ``.stage_*``
+    children, a pod's per-rank ``.rank<N>`` files, a sweep's ``.dev<D>``
+    workers) into ONE Perfetto-loadable timeline. Every source (file, pid)
+    pair is remapped to a fresh DISJOINT pid with a ``process_name``
+    metadata row naming its origin, so same-pid events from different
+    processes can never interleave; ``dropped_events`` markers are summed
+    and preserved. Returns {files, events, pids, dropped, path}."""
+    events: List[Dict] = []
+    pid_map: Dict = {}
+    dropped = 0
+    n_events = 0
+    files = 0
+    for i, p in enumerate(in_paths):
+        try:
+            with open(p, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue  # a torn/absent child trace must not kill the merge
+        files += 1
+        dropped += int((doc.get("otherData") or {}).get("dropped_events", 0)
+                       or 0)
+        label = os.path.basename(str(p))
+        for ev in doc.get("traceEvents") or []:
+            old = ev.get("pid", 0)
+            key = (i, old)
+            new = pid_map.get(key)
+            if new is None:
+                new = pid_map[key] = len(pid_map) + 1
+                events.append({
+                    "ph": "M", "name": "process_name", "pid": new, "tid": 0,
+                    "args": {"name": "%s (pid %s)" % (label, old)},
+                })
+            ev2 = dict(ev)
+            ev2["pid"] = new
+            events.append(ev2)
+            n_events += 1
+    payload: Dict = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"producer": "lightgbm_tpu.obs.trace merge"},
+    }
+    if dropped:
+        payload["otherData"]["dropped_events"] = dropped
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh)
+        fh.write("\n")
+    return {
+        "files": files, "events": n_events, "pids": len(pid_map),
+        "dropped": dropped, "path": out_path,
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m lightgbm_tpu.obs.trace merge -o out.json in1 in2 ...``
+    (globs welcome) — the pod-wide timeline merge. Stdlib only."""
+    import argparse
+    import glob as glob_mod
+
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.obs.trace",
+        description="Chrome-trace utilities (obs/trace.py)",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mg = sub.add_parser(
+        "merge", help="fold per-process trace files into one timeline "
+                      "with disjoint pids",
+    )
+    mg.add_argument("inputs", nargs="+",
+                    help="trace files (shell-unexpanded globs accepted)")
+    mg.add_argument("-o", "--out", default="trace_merged.json")
+    args = ap.parse_args(argv)
+    paths: List[str] = []
+    for item in args.inputs:
+        hits = sorted(glob_mod.glob(item))
+        paths.extend(hits if hits else [item])
+    stats = merge_traces(args.out, paths)
+    print(
+        "trace merge: %(files)d file(s) -> %(path)s "
+        "(%(events)d events, %(pids)d pids, %(dropped)d dropped)" % stats
+    )
+    return 0 if stats["files"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

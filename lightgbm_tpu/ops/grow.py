@@ -342,8 +342,12 @@ def make_bucket_kernels(
     """Build the bucketed partition / segment-histogram kernels for one
     dataset layout. ``kb`` is the speculative-batch width the caller will
     trace with (it only widens the flat-partition branch lattice's cap);
-    the sequential profiler passes 0. Bodies are the ones grow_tree always
-    traced — moved, not rewritten."""
+    the profilers pass 0. Bodies are the ones grow_tree always traced —
+    moved, not rewritten. Consumers: the fused while_loop grower here,
+    the sequential segment profiler (obs/prof.py), and the SHARDED
+    segment profiler (obs/dist.py), which traces these same kernels
+    per-shard inside shard_map bodies so its local-compute segments are
+    op-identical to the fused data-parallel program's."""
     N = bins.shape[1]
     B = num_bins
     F = feature_meta["num_bin"].shape[0]

@@ -43,10 +43,29 @@ class HistogramSource:
     mechanism, so every consumer (the ``leaf_histogram`` tail, the grower's
     post-bucket-switch collective, the root sums) spells accumulation the
     same way. Instances are value-hashable so they can ride jit statics.
+
+    ``is_collective`` tells observability (obs/dist.py) whether a combine
+    moves bytes across devices, and :meth:`payload_bytes` is the per-call
+    collective payload estimate — the partial's own size, since psum ships
+    (and receives) one operand-sized buffer per participant.
     """
+
+    #: True when combine() lowers to a cross-device collective (psum)
+    is_collective = False
 
     def combine(self, partial):
         raise NotImplementedError
+
+    @staticmethod
+    def payload_bytes(shape, dtype_itemsize: int = 4) -> int:
+        """Estimated bytes one combine() call moves per participant: the
+        partial's size (0 payload for non-collective sources, whose
+        combine is the identity — callers should gate on is_collective).
+        Cross-checked against live array nbytes in tests."""
+        n = 1
+        for d in shape:
+            n *= int(d)
+        return n * int(dtype_itemsize)
 
 
 class LocalHistogramSource(HistogramSource):
@@ -67,6 +86,8 @@ class MeshHistogramSource(HistogramSource):
     data-parallel learner's ReduceScatter of HistogramBinEntry
     (data_parallel_tree_learner.cpp:161) collapsed into an XLA collective
     over ICI."""
+
+    is_collective = True
 
     def __init__(self, axis_name: str) -> None:
         self.axis_name = axis_name

@@ -1,0 +1,382 @@
+"""Distributed observability (obs/dist.py + trace merge + report Multichip).
+
+Runs on the conftest 8-virtual-CPU-device mesh. Three proof tiers:
+
+ * the SHARDED segment profiler: fenced shard_map sub-steps (local
+   histogram build / _combine psum / root reduction / split scan) must be
+   bitwise-identical to the fused ``grow_tree_data_parallel`` program, and
+   ``segmented_train_chunk`` must reproduce the fused sharded chunk's
+   model strings AND score carries;
+ * pod-wide aggregation: registry snapshot merge (counters == per-process
+   sums, gauges keep ``process=`` provenance), the file-based fallback,
+   and the Chrome-trace merge (disjoint pids, dropped-events marker
+   preserved);
+ * shard-skew surfaces: the N=1003-over-8 padding shape's known 7x126+121
+   row split in ``train_shard_rows{device=}``, dispatch-wait gauges under
+   ``LIGHTGBM_TPU_DIST_PROF=1``, and the report's Multichip section /
+   bench_diff's scaling-efficiency WARN row.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import dist, registry as registry_mod, trace as trace_mod
+from lightgbm_tpu.obs.registry import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _data(n=600, f=5, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    return X, y
+
+
+def _train(params, X, y, rounds):
+    p = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+         "tree_learner": "data", "num_machines": 2, "min_data_in_leaf": 5}
+    p.update(params)
+    return lgb.train(p, lgb.Dataset(X, label=y), rounds)
+
+
+# ---------------------------------------------------------------------------
+# registry snapshot + merge
+# ---------------------------------------------------------------------------
+
+def _two_snaps():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("reqs").inc(3)
+    a.counter("reqs").inc(2, model="m1")
+    a.gauge("depth").set(4.0)
+    a.histogram("lat").record(1.0)
+    b.counter("reqs").inc(7)
+    b.counter("reqs").inc(1, model="m1")
+    b.gauge("depth").set(9.0)
+    sa = dist.snapshot(a)
+    sa["process"] = 0
+    sb = dist.snapshot(b)
+    sb["process"] = 1
+    return sa, sb
+
+
+def test_merge_counters_sum_and_gauge_provenance():
+    sa, sb = _two_snaps()
+    merged = dist.merge_snapshots([sa, sb])
+    # counters: summed over identical (name, labels) across processes
+    assert merged.counter("reqs").value() == 10
+    assert merged.counter("reqs").value(model="m1") == 3
+    # gauges: one entry per process, tagged with the provenance label
+    vals = merged.gauge("depth").values()
+    assert vals[(("process", "0"),)] == 4.0
+    assert vals[(("process", "1"),)] == 9.0
+    expo = merged.prometheus_text()
+    assert 'process="0"' in expo and 'process="1"' in expo
+    assert "lgbtpu_reqs_total 10" in expo
+    # histogram summaries surface as stat-labeled gauges + summed count
+    assert merged.counter("lat_count").value() == 1
+    rep = dist.merged_run_report([sa, sb])
+    assert rep["process_count"] == 2
+    assert rep["counters"]["reqs"] == 10
+
+
+def test_merge_snapshot_files_roundtrip(tmp_path):
+    sa, sb = _two_snaps()
+    for s in (sa, sb):
+        with open(tmp_path / ("reg.rank%d.json" % s["process"]), "w") as fh:
+            json.dump(s, fh)
+    snaps = dist.merge_snapshot_files(str(tmp_path / "reg.rank*.json"))
+    assert [s["process"] for s in snaps] == [0, 1]
+    merged = dist.merge_snapshots(snaps)
+    assert merged.counter("reqs").value() == 10
+
+
+def test_gather_snapshots_single_process_fallback():
+    # one process (the test world): the gather is the local snapshot alone
+    out = dist.gather_snapshots({"process": 0, "counters": {}})
+    assert out == [{"process": 0, "counters": {}}]
+
+
+# ---------------------------------------------------------------------------
+# trace merge + rank suffix
+# ---------------------------------------------------------------------------
+
+def _mini_trace(path, pid, dropped=0):
+    doc = {
+        "traceEvents": [
+            {"ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
+             "args": {"name": "main"}},
+            {"ph": "X", "name": "step", "cat": "t", "pid": pid, "tid": 0,
+             "ts": 1.0, "dur": 5.0},
+        ],
+        "otherData": ({"dropped_events": dropped} if dropped else {}),
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+
+
+def test_trace_merge_disjoint_pids_and_dropped_marker(tmp_path):
+    a = tmp_path / "t.rank0.json"
+    b = tmp_path / "t.rank1.json"
+    _mini_trace(a, pid=42)
+    _mini_trace(b, pid=42, dropped=7)  # SAME pid in both source files
+    out = tmp_path / "merged.json"
+    stats = trace_mod.merge_traces(str(out), [str(a), str(b)])
+    assert stats["files"] == 2 and stats["pids"] == 2
+    assert stats["dropped"] == 7
+    doc = json.load(open(out))
+    pids = {ev["pid"] for ev in doc["traceEvents"]}
+    assert len(pids) == 2, "same-pid events from two files must not collide"
+    assert doc["otherData"]["dropped_events"] == 7
+    names = [ev for ev in doc["traceEvents"]
+             if ev.get("name") == "process_name"]
+    assert len(names) == 2  # one provenance row per source process
+
+
+def test_trace_merge_cli(tmp_path, capsys):
+    a = tmp_path / "x1.json"
+    _mini_trace(a, pid=1)
+    out = tmp_path / "m.json"
+    rc = trace_mod.main(["merge", "-o", str(out), str(tmp_path / "x*.json")])
+    assert rc == 0 and out.exists()
+    assert "1 file(s)" in capsys.readouterr().out
+
+
+def test_trace_rank_suffix_under_distributed(tmp_path, monkeypatch):
+    monkeypatch.setenv(trace_mod.ENV_TRACE, str(tmp_path / "t.json"))
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    tr = trace_mod.start()
+    try:
+        assert tr.path.endswith("t.json.rank1")
+    finally:
+        trace_mod.stop()
+    # explicit caller paths are never rewritten
+    tr = trace_mod.start(str(tmp_path / "explicit.json"))
+    try:
+        assert tr.path.endswith("explicit.json")
+    finally:
+        trace_mod.stop()
+
+
+# ---------------------------------------------------------------------------
+# sharded segment profiler
+# ---------------------------------------------------------------------------
+
+def test_profile_sharded_growth_bitwise_and_structure():
+    X, y = _data()
+    bst = _train({"device_chunk_size": 3, "bagging_freq": 2,
+                  "bagging_fraction": 0.8}, X, y, 4)
+    rec = dist.profile_sharded_growth(bst, iters=1)
+    assert rec["bitwise_identical"] is True
+    segs = rec["segments_per_tree_s"]
+    for name in ("root_init", "hist_build", "hist_combine", "root_reduce",
+                 "partition", "split_scan", "hist_subtract", "finalize"):
+        assert name in segs, name
+    assert set(rec["collective_segments"]) == {"hist_combine", "root_reduce"}
+    assert 0.0 < rec["comms_fraction"] < 1.0
+    assert rec["devices"] == 2
+    # collective payload: [F, B, 3] f32 — the HistogramSource seam's shape
+    # math must agree with the trainer's histogram dimensions
+    F = bst._gbdt.feature_meta["num_bin"].shape[0]
+    B = bst._gbdt.num_bins
+    assert rec["collective_bytes_per_split"] == F * B * 3 * 4
+    # per-tree collective bytes: one hist psum per split + the root's,
+    # plus the 3-scalar root reduction
+    per_tree = rec["segment_counts"]["hist_combine"] / rec["trees"]
+    assert rec["collective_bytes_per_tree"] == int(
+        per_tree * F * B * 3 * 4
+        + rec["segment_counts"]["root_reduce"] / rec["trees"] * 12
+    )
+    # gauges landed with the collective label, and sharded="true" keeps
+    # them disjoint from the serial profiler's same-named segments
+    g = registry_mod.REGISTRY.gauge("growth_segment_seconds_total").values()
+    assert (("collective", "true"), ("segment", "hist_combine"),
+            ("sharded", "true")) in g
+    assert dist.last_record()["comms_fraction"] == rec["comms_fraction"]
+
+
+def test_profile_sharded_growth_refuses_serial():
+    X, y = _data(n=200)
+    p = {"objective": "binary", "num_leaves": 6, "verbosity": -1}
+    bst = lgb.train(p, lgb.Dataset(X, label=y), 2)
+    with pytest.raises(Exception, match="data-parallel"):
+        dist.profile_sharded_growth(bst)
+
+
+def test_segmented_train_chunk_model_and_scores_identical():
+    X, y = _data(n=700, seed=11)
+    params = {"device_chunk_size": 4, "bagging_freq": 2,
+              "bagging_fraction": 0.8}
+    rounds = 9
+    fused = _train(params, X, y, rounds)
+    p = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+         "tree_learner": "data", "num_machines": 2, "min_data_in_leaf": 5}
+    p.update(params)
+    seg = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y))
+    seg.update()  # the sequential first iteration (as train_chunk runs it)
+    done = 1
+    while done < rounds:
+        d, stopped = dist.segmented_train_chunk(
+            seg._gbdt, min(4, rounds - done)
+        )
+        done += d
+        if stopped:
+            break
+    strip = lambda s: s.split("parameters:")[0]  # noqa: E731
+    assert strip(fused.model_to_string()) == strip(seg.model_to_string())
+    assert np.array_equal(
+        fused._gbdt.scores_canonical_np(), seg._gbdt.scores_canonical_np()
+    )
+    # the collective seconds accumulated for the flight boundary hook
+    assert dist.take_boundary_comms() > 0.0
+    assert dist.take_boundary_comms() == 0.0  # drained
+
+
+# ---------------------------------------------------------------------------
+# shard skew + straggler surfaces
+# ---------------------------------------------------------------------------
+
+def test_shard_rows_gauge_reports_1003_over_8_split():
+    X, y = _data(n=1003, seed=5)
+    _train({"num_machines": 8, "device_chunk_size": 2}, X, y, 3)
+    vals = registry_mod.REGISTRY.gauge("train_shard_rows").values()
+    by_dev = {k: v for k, v in vals.items()
+              if any(lk == "device" for lk, _ in k)}
+    assert len(by_dev) >= 8
+    counts = sorted(int(v) for v in by_dev.values())[-8:]
+    assert counts == [121] + [126] * 7
+    assert dist.shard_valid_counts(1003, 8) == [126] * 7 + [121]
+
+
+def test_dispatch_wait_gauges_in_profiling_mode(monkeypatch):
+    monkeypatch.setenv(dist.ENV_DIST_PROF, "1")
+    X, y = _data(n=400, seed=9)
+    _train({"device_chunk_size": 3}, X, y, 4)
+    vals = registry_mod.REGISTRY.gauge("train_shard_wait_seconds").values()
+    devs = {dict(k).get("device") for k in vals}
+    assert len([d for d in devs if d]) >= 2
+
+
+def test_wait_profiling_disabled_by_default(monkeypatch):
+    monkeypatch.delenv(dist.ENV_DIST_PROF, raising=False)
+    assert not dist.wait_profiling_enabled()
+    monkeypatch.setenv(dist.ENV_DIST_PROF, "0")
+    assert not dist.wait_profiling_enabled()
+    monkeypatch.setenv(dist.ENV_DIST_PROF, "1")
+    assert dist.wait_profiling_enabled()
+
+
+# ---------------------------------------------------------------------------
+# flight manifest + report + bench_diff satellites
+# ---------------------------------------------------------------------------
+
+def test_flight_manifest_carries_mesh_and_process(tmp_path):
+    from lightgbm_tpu.obs import flight
+
+    X, y = _data(n=300)
+    log_path = tmp_path / "run.jsonl"
+    p = {"objective": "binary", "num_leaves": 6, "verbosity": -1,
+         "tree_learner": "data", "num_machines": 2,
+         "flight_record": str(log_path)}
+    lgb.train(p, lgb.Dataset(X, label=y), 3)
+    rec = flight.load(str(log_path))
+    man = rec["manifest"]
+    assert man["process_index"] == 0 and man["process_count"] == 1
+    assert man["mesh"] == {"learner": "data", "axes": {"data": 2}}
+
+
+def test_report_multichip_section_renders_new_fields():
+    from lightgbm_tpu.obs import report
+
+    summary = {
+        "metric": "higgs_multichip_iters_per_sec", "unit": "iters/s",
+        "value": 5.0, "platform": "cpu", "ok": True,
+        "scaling": [
+            {"devices": 1, "iters_per_sec": 3.0, "platform": "cpu"},
+            {"devices": 4, "iters_per_sec": 9.0, "platform": "cpu"},
+        ],
+        "speedup_vs_1dev": 3.0,
+        "efficiency_by_devices": [[1, 1.0], [4, 0.75]],
+        "scaling_efficiency": 0.75,
+        "comms_fraction": 0.22,
+        "dist_segments": {"hist_build": 0.01, "hist_combine": 0.002},
+        "per_device": [
+            {"device": "TFRT_CPU_0", "rows": 126, "wait_s": 0.001},
+            {"device": "TFRT_CPU_1", "rows": 121, "wait_s": 0.004},
+        ],
+    }
+    html = report.render(bench_records=[("MULTICHIP_r09.json", summary)])
+    assert "Multichip scaling" in html
+    assert "scaling efficiency" in html
+    assert "collective vs compute" in html
+    assert "per-device shard table" in html
+    assert "TFRT_CPU_1" in html and ">121<" in html
+    # efficiency falls back to recomputation when the field is absent
+    summary2 = dict(summary)
+    summary2.pop("efficiency_by_devices")
+    assert report._multichip_efficiency(summary2) == [(1.0, 1.0), (4.0, 0.75)]
+
+
+def test_bench_diff_scaling_efficiency_warns_never_fails():
+    sys.path.insert(0, os.path.join(REPO, "helpers"))
+    import bench_diff
+
+    base = {"metric": "m", "platform": "cpu", "scaling_efficiency": 0.9}
+    cur = {"metric": "m", "platform": "cpu", "scaling_efficiency": 0.6}
+    rows, failed = bench_diff.compare(cur, base)
+    row = next(r for r in rows if r["metric"] == "scaling_efficiency")
+    assert row["status"] == "WARN"
+    assert not failed, "scaling-efficiency drops must never hard-FAIL"
+    # same drop across platforms: not comparable -> SKIP
+    cur2 = dict(cur, platform="tpu")
+    rows2, _ = bench_diff.compare(cur2, base)
+    row2 = next(r for r in rows2 if r["metric"] == "scaling_efficiency")
+    assert row2["status"] == "SKIP"
+    # small wobble passes
+    cur3 = dict(cur, scaling_efficiency=0.85)
+    rows3, _ = bench_diff.compare(cur3, base)
+    row3 = next(r for r in rows3 if r["metric"] == "scaling_efficiency")
+    assert row3["status"] == "PASS"
+
+
+# ---------------------------------------------------------------------------
+# subprocess: the real two-rank file-based merge path (cheap worker)
+# ---------------------------------------------------------------------------
+
+WORKER = """
+import json, sys
+sys.path.insert(0, %r)
+from lightgbm_tpu.obs import dist, registry
+rank = int(sys.argv[1])
+registry.REGISTRY.counter("mp_file_total").inc(5 * (rank + 1))
+registry.REGISTRY.gauge("mp_file_rank").set(float(rank))
+snap = dist.snapshot()
+snap["process"] = rank
+json.dump(snap, open(sys.argv[2], "w"))
+print("DONE")
+""" % REPO
+
+
+def test_two_rank_file_merge_subprocess(tmp_path):
+    for rank in range(2):
+        out = subprocess.run(
+            [sys.executable, "-c", WORKER, str(rank),
+             str(tmp_path / ("s.rank%d.json" % rank))],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        assert out.returncode == 0, out.stderr[-800:]
+    merged = dist.merge_snapshots(
+        dist.merge_snapshot_files(str(tmp_path / "s.rank*.json"))
+    )
+    assert merged.counter("mp_file_total").value() == 15
+    expo = merged.prometheus_text()
+    assert 'process="0"' in expo and 'process="1"' in expo

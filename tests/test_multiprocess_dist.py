@@ -317,6 +317,100 @@ def test_two_process_load_then_train(tmp_path):
     assert r0["loss_improves"] and r1["loss_improves"]
 
 
+OBS_WORKER = textwrap.dedent(
+    """
+    import os, sys, json
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    rank, world, port, data = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                               num_processes=world, process_id=rank)
+    sys.path.insert(0, "@REPO@")
+    from lightgbm_tpu.obs import dist, registry, trace
+
+    # the rank-suffix fix: an env-derived trace path must never collide
+    os.environ[trace.ENV_TRACE] = data + ".trace"
+    tr = trace.start()
+    trace_ok = tr.path.endswith(".trace.rank%d" % rank)
+    with trace.span("obs.worker", cat="test"):
+        pass
+    trace.stop()
+
+    # distinguishable per-rank instruments, then the pod-wide merge: the
+    # host-side allgather where the backend implements multi-process
+    # computations, else the documented FILE-BASED fallback (obs/dist.py)
+    # — both paths end in one registry whose counters are the rank sums
+    registry.REGISTRY.counter("mp_obs_total").inc(10 * (rank + 1))
+    registry.REGISTRY.counter("mp_obs_total").inc(1, kind="labeled")
+    registry.REGISTRY.gauge("mp_obs_rank").set(float(rank))
+    mine = dist.write_snapshot(data + ".snap")
+    try:
+        snaps = dist.gather_snapshots()
+        mode = "allgather"
+    except Exception:
+        # e.g. "Multiprocess computations aren't implemented on the CPU
+        # backend" (container jaxlib): poll for the sibling's snapshot
+        import time
+        other = data + ".snap.rank%d.json" % (1 - rank)
+        snaps = []
+        for _ in range(600):
+            try:
+                snaps = dist.merge_snapshot_files([mine, other])
+            except Exception:
+                snaps = []
+            if len(snaps) == 2:
+                break
+            time.sleep(0.1)
+        mode = "files"
+    merged = dist.merge_snapshots(snaps)
+    expo = merged.prometheus_text()
+    print("RESULT " + json.dumps({
+        "rank": rank,
+        "mode": mode,
+        "gathered": len(snaps),
+        "processes": sorted(s.get("process") for s in snaps),
+        "merged_total": merged.counter("mp_obs_total").value(),
+        "merged_labeled": merged.counter("mp_obs_total").value(kind="labeled"),
+        "provenance_ok": ('process="0"' in expo and 'process="1"' in expo),
+        "trace_rank_suffix_ok": trace_ok,
+    }), flush=True)
+    """
+).replace("@REPO@", REPO)
+
+
+def test_two_process_registry_gather_merge(tmp_path):
+    """obs/dist.py pod-wide aggregation over a REAL two-process
+    jax.distributed world: both ranks merge their registry snapshots —
+    via the host-side allgather where the backend supports multi-process
+    computations, else via the documented file-based fallback — and the
+    merged counters equal the per-process sums (30 = 10+20, labeled
+    2 = 1+1), gauges keep per-process provenance labels, and the
+    env-derived trace path picks up the .rank<N> suffix so the two ranks
+    never clobber one file (the reference analogue: the per-rank timing
+    logs the Network layer's ranks kept separately)."""
+    results = _launch_world_retrying(
+        OBS_WORKER, tmp_path / "obs", tmp_path, 30, "obs_worker.py"
+    )
+    for r in results:
+        assert r["gathered"] == 2
+        assert r["processes"] == [0, 1]
+        assert r["merged_total"] == 30, "merged != sum of per-process counters"
+        assert r["merged_labeled"] == 2
+        assert r["provenance_ok"], "gauges lost process provenance labels"
+        assert r["trace_rank_suffix_ok"], "trace path missed .rank<N> suffix"
+    # both rank trace files exist side by side and merge into one timeline
+    t0 = str(tmp_path / "obs") + ".trace.rank0"
+    t1 = str(tmp_path / "obs") + ".trace.rank1"
+    assert os.path.exists(t0) and os.path.exists(t1)
+    sys.path.insert(0, REPO)
+    from lightgbm_tpu.obs import trace as trace_mod
+
+    merged = tmp_path / "obs_merged.json"
+    stats = trace_mod.merge_traces(str(merged), [t0, t1])
+    assert stats["files"] == 2 and stats["pids"] >= 2
+
+
 def test_two_process_data_parallel_training(tmp_path):
     """grow_tree_data_parallel across TWO real jax.distributed processes
     forming one global mesh: the tree must be identical on both ranks AND
