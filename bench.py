@@ -470,7 +470,9 @@ def _run() -> None:
         soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
         if hard == resource.RLIM_INFINITY or hard >= 256 * 1024 * 1024:
             resource.setrlimit(resource.RLIMIT_STACK, (256 * 1024 * 1024, hard))
-    except Exception:
+    except (ImportError, OSError, ValueError):
+        # no resource module (non-unix) or a container refusing the raise:
+        # the stack bump is a best-effort crash-avoidance, not a requirement
         pass
     platform = os.environ.get("BENCH_WORKER_PLATFORM", "unknown")
     platforms = os.environ.get("BENCH_FORCE_PLATFORMS")
@@ -934,7 +936,10 @@ def _run() -> None:
                     "headline value above is the CPU fallback"
                 )
                 extra["last_tpu"] = last
-        except Exception:
+        except (OSError, ValueError, KeyError, TypeError, AttributeError):
+            # no BENCH_TPU.json yet, or a torn/foreign one: the labeled
+            # last-on-chip echo is informational — never worth failing the
+            # capture that is about to record fresh numbers
             pass
     if chunk > 1:
         extra["device_chunk_size"] = chunk

@@ -43,6 +43,14 @@
 #                               # comms_fraction + scaling_efficiency, and
 #                               # the HTML Multichip report page — from ONE
 #                               # invocation (docs/Observability.md)
+#   helpers/check.sh --san      # lint gate (JX011-JX013 engaged), then the
+#                               # runtime sanitizer: unit tests (seeded
+#                               # transfer/NaN/lock-inversion violations all
+#                               # caught; off-path provably free) + the
+#                               # concurrency stress smoke (concurrent
+#                               # predict + hot-swap + drain + drift +
+#                               # /metrics scrape under
+#                               # LIGHTGBM_TPU_SAN=transfer,nan,locks)
 #   helpers/check.sh --bench-diff [CUR BASE]
 #                               # the bench regression gate: golden-fixture
 #                               # self-test (synthetic regression must FAIL,
@@ -61,19 +69,19 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san or --bench-diff)" >&2
         exit 2
         ;;
 esac
 fail=0
 
-echo "== graftlint (lightgbm_tpu/ against baseline) =="
-python -m tools.graftlint lightgbm_tpu/ || fail=1
+echo "== graftlint (lightgbm_tpu/ + helpers/ + bench.py against baseline) =="
+python -m tools.graftlint lightgbm_tpu/ helpers/ bench.py || fail=1
 
-echo "== graftlint (tools/ + helpers/, no baseline) =="
-python -m tools.graftlint --no-baseline tools/ helpers/ || fail=1
+echo "== graftlint (tools/, no baseline) =="
+python -m tools.graftlint --no-baseline tools/ || fail=1
 
 if python -m ruff --version >/dev/null 2>&1; then
     echo "== ruff =="
@@ -132,6 +140,14 @@ fi
 if [ "$MODE" = "--dist-obs" ]; then
     echo "== dist-obs smoke (segmented sharded chunk + merged registry/trace/report) =="
     exec env JAX_PLATFORMS=cpu python helpers/dist_obs_smoke.py
+fi
+
+if [ "$MODE" = "--san" ]; then
+    echo "== sanitizer unit tests (seeded violations caught, off-path free) =="
+    env JAX_PLATFORMS=cpu python -m pytest tests/test_sanitize.py -q \
+        -p no:cacheprovider || exit 1
+    echo "== graftsan concurrency stress smoke (predict+swap+drain+drift+scrape) =="
+    exec env JAX_PLATFORMS=cpu python helpers/san_smoke.py
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

@@ -87,6 +87,8 @@ STAGE_TIMEOUTS = {
     "bench_multichip": 3600,  # devices∈{1,4,8} sharded-chunk scaling (ISSUE 8)
     "bench_predict": 1800,  # packed-inference serving bench (ISSUE 3)
     "prof": 1800,   # segment-profiled mini-train (obs/prof.py, ISSUE 6)
+    "san": 1800,    # graftsan stress smoke under full instrumentation
+                    # (obs/sanitize.py, ISSUE 11)
     "bench": 3600,
 }
 
@@ -698,6 +700,18 @@ def run_with_retry(stage: str, fn) -> dict:
     return result
 
 
+def run_san(stage: str = "san") -> dict:
+    """graftsan concurrency stress smoke (helpers/san_smoke.py, ISSUE 11) —
+    executed by FILE path in a child process with the full sanitizer armed
+    (the child sets LIGHTGBM_TPU_SAN itself), so the driver stays jax-free
+    and the instrumented locks/guards live only in the child. On silicon
+    this is the proof the serve stack's lock discipline and explicit-upload
+    contract hold on the real backend, not just the CPU CI box."""
+    return _run_child(
+        stage, [sys.executable, os.path.join(REPO, "helpers", "san_smoke.py")]
+    )
+
+
 def run_bench(stage: str = "bench") -> dict:
     env = dict(os.environ)
     env.pop("BENCH_FORCE_PLATFORMS", None)
@@ -833,11 +847,17 @@ def main() -> int:
                        # kernel-level attribution: segment breakdown +
                        # bitwise proof + cost analysis, on silicon (ISSUE 6)
                        ("prof", PROF),
+                       # runtime sanitizer stress smoke: concurrent
+                       # predict + hot-swap + drain + drift + scrape under
+                       # LIGHTGBM_TPU_SAN=transfer,nan,locks (ISSUE 11)
+                       ("san", "SAN"),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         with _stage_span(stage):
             if src == "MULTICHIP":
                 runner = lambda s=stage: run_multichip(s)  # noqa: E731
+            elif src == "SAN":
+                runner = lambda s=stage: run_san(s)  # noqa: E731
             elif src is None:
                 runner = lambda s=stage: run_bench(s)  # noqa: E731
             else:

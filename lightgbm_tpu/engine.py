@@ -16,6 +16,7 @@ from . import callback as callback_mod
 from .basic import Booster, Dataset
 from .obs import flight as flight_mod
 from .obs import registry as obs_registry
+from .obs import sanitize as sanitize_mod
 from .obs import trace as trace_mod
 from .resil import faults
 from .utils import timer as timer_mod
@@ -355,6 +356,13 @@ def _boost_loop(
                     evaluation_result_list=None,
                 )
             )
+        # the transfer sanitizer's guarded scopes live at the JITTED
+        # dispatch seams this loop drives (gbdt.train_chunk, ops.grow_tree,
+        # gbdt.finish_tree, serve's bucketed dispatch) rather than around
+        # the whole boundary: the sequential path's eager gradient/bagging
+        # math legitimately materializes python/numpy scalar constants,
+        # which jax uploads through the same implicit path the guard
+        # polices (obs/sanitize.py)
         if chunk > 1 and end - i >= chunk:
             with trace_mod.span("train.chunk", cat="train", iteration=i,
                                 chunk=chunk):
@@ -372,6 +380,10 @@ def _boost_loop(
             done = 1
         i += done
         iter_counter.inc(done)
+        if sanitize_mod.NAN:
+            # boundary tripwire: a non-finite score carry fails HERE, named,
+            # instead of surfacing iterations later as a metric collapse
+            sanitize_mod.check_scores(booster._gbdt, i - 1)
 
         evaluation_result_list = []
         if needs_eval:

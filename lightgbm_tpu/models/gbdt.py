@@ -27,6 +27,7 @@ from ..config import Config
 from ..dataset import BinnedDataset
 from ..metric import Metric
 from ..obs import costs as costs_mod
+from ..obs import sanitize as sanitize_mod
 from ..obs import dist as dist_mod
 from ..obs import memwatch, retrace as retrace_mod
 from ..objective import ObjectiveFunction
@@ -84,6 +85,10 @@ class GBDT:
         self.average_output = False
         self._early_stop_best: Dict = {}
         self._es_counter = 0
+        # value-keyed cache of explicitly-uploaded f32 scalars (_f32_dev):
+        # scalar operands in the boosting loop must not be per-iteration
+        # implicit host->device transfers (obs/sanitize.py transfer mode)
+        self._f32_dev_cache: Dict[float, jax.Array] = {}
         self.best_iteration = -1
         self.valid_sets: List[BinnedDataset] = []
         self.valid_metrics: List[List[Metric]] = []
@@ -338,6 +343,23 @@ class GBDT:
         self._valid_bins_t.append(bins_t)
 
     # ------------------------------------------------------------------
+    def _f32_dev(self, x) -> jax.Array:
+        """``np.float32(x)`` as an EXPLICITLY-uploaded device scalar, cached
+        by value. Passing raw numpy scalars into eager score updates or
+        jitted dispatches is an implicit host->device transfer every call —
+        exactly what the runtime sanitizer's transfer mode (obs/sanitize.py)
+        disallows inside the boosting dispatch scope. The aval is identical
+        (f32[]), so every computation stays bitwise-unchanged."""
+        v = float(np.float32(x))
+        a = self._f32_dev_cache.get(v)
+        if a is None:
+            # device_put is jax's one EXPLICIT upload API (jnp.asarray of a
+            # 0-d numpy scalar still routes through the implicit
+            # convert_element_type path and would trip the guard)
+            a = self._f32_dev_cache[v] = jax.device_put(np.float32(x))
+        return a
+
+    # ------------------------------------------------------------------
     def _boost_from_average(self, class_id: int) -> float:
         """gbdt.cpp:308-331."""
         cfg = self.config
@@ -346,12 +368,16 @@ class GBDT:
         if cfg.boost_from_average or self.train_set.num_features == 0:
             init_score = self.objective.boost_from_score(class_id)
             if abs(init_score) > K_EPSILON:
-                self.scores = self.scores.at[class_id].add(np.float32(init_score))
-                if hasattr(self, "valid_scores"):
-                    for i in range(len(self.valid_scores)):
-                        self.valid_scores[i] = self.valid_scores[i].at[class_id].add(
-                            np.float32(init_score)
-                        )
+                # audited eager poke (runs once per class, first iteration):
+                # the python-int index uploads implicitly, which the
+                # transfer sanitizer would otherwise flag (obs/sanitize.py)
+                with sanitize_mod.allow_transfers("boost_from_average"):
+                    self.scores = self.scores.at[class_id].add(self._f32_dev(init_score))
+                    if hasattr(self, "valid_scores"):
+                        for i in range(len(self.valid_scores)):
+                            self.valid_scores[i] = self.valid_scores[i].at[class_id].add(
+                                self._f32_dev(init_score)
+                            )
                 log.info("Start training from score %f" % init_score)
                 return init_score
         elif self.objective.name in ("regression_l1", "quantile", "mape"):
@@ -364,7 +390,12 @@ class GBDT:
     def _compute_gradients(self, init_scores) -> Tuple[jax.Array, jax.Array]:
         """Boosting() (gbdt.cpp:148): objective gradients at the current scores."""
         K = self.num_tree_per_iteration
-        grad, hess = self.objective.get_gradients(self.scores if K > 1 else self.scores[0])
+        # reshape, not scores[0]: eager integer indexing converts-and-uploads
+        # its index scalar EVERY iteration (the transfer sanitizer flags it);
+        # the [1, N] -> [N] reshape is metadata-only and value-identical
+        grad, hess = self.objective.get_gradients(
+            self.scores if K > 1 else self.scores.reshape(-1)
+        )
         if K == 1:
             grad, hess = grad[None, :], hess[None, :]
         return grad, hess
@@ -482,7 +513,7 @@ class GBDT:
                     self._update_valid_scores(tree_arrays, k)
                 if abs(init_scores[k]) > K_EPSILON:
                     tree_arrays = tree_arrays._replace(
-                        leaf_value=tree_arrays.leaf_value + np.float32(init_scores[k])
+                        leaf_value=tree_arrays.leaf_value + self._f32_dev(init_scores[k])
                     )
                 self._device_trees.append((tree_arrays, k))
                 self.models.append(None)  # lazily converted
@@ -506,12 +537,15 @@ class GBDT:
                     self.models.append(t)
                     self._device_trees.append((None, k))
                     if output != 0.0:
-                        self.scores = self.scores.at[k].add(np.float32(output))
-                        if hasattr(self, "valid_scores"):
-                            for i in range(len(self.valid_scores)):
-                                self.valid_scores[i] = (
-                                    self.valid_scores[i].at[k].add(np.float32(output))
-                                )
+                        # audited eager poke: untrained-class constant tree,
+                        # at most K times per run (obs/sanitize.py)
+                        with sanitize_mod.allow_transfers("constant_tree"):
+                            self.scores = self.scores.at[k].add(self._f32_dev(output))
+                            if hasattr(self, "valid_scores"):
+                                for i in range(len(self.valid_scores)):
+                                    self.valid_scores[i] = (
+                                        self.valid_scores[i].at[k].add(self._f32_dev(output))
+                                    )
                 else:
                     # keep models_ aligned per iteration
                     t = Tree(1)
@@ -603,12 +637,15 @@ class GBDT:
             # already added its own output
             for _, k, init in pend:
                 if abs(init) > K_EPSILON:
-                    self.scores = self.scores.at[k].add(np.float32(init))
-                    if hasattr(self, "valid_scores"):
-                        for i in range(len(self.valid_scores)):
-                            self.valid_scores[i] = (
-                                self.valid_scores[i].at[k].add(np.float32(init))
-                            )
+                    # audited eager poke: no-split-stop rollback, runs once
+                    # at the stop boundary (obs/sanitize.py)
+                    with sanitize_mod.allow_transfers("no_split_stop"):
+                        self.scores = self.scores.at[k].add(self._f32_dev(init))
+                        if hasattr(self, "valid_scores"):
+                            for i in range(len(self.valid_scores)):
+                                self.valid_scores[i] = (
+                                    self.valid_scores[i].at[k].add(self._f32_dev(init))
+                                )
         self._stopped = True
         return True
 
@@ -722,17 +759,22 @@ class GBDT:
             )
             fn = self._chunk_fn(n)
             # snapshot avals BEFORE the donating call (obs/costs.py)
+            # iteration counter as an EXPLICIT device scalar: jnp.int32 of
+            # a python int routes through the implicit-transfer path the
+            # sanitizer's guarded dispatch below disallows (obs/sanitize.py)
+            it_dev = jax.device_put(np.int32(self.iter_))
             harvest = None
             if costs_mod.enabled():
                 harvest = costs_mod.sds_args(
-                    (self.scores, self._bag_mask, jnp.int32(self.iter_),
+                    (self.scores, self._bag_mask, it_dev,
                      fmasks, self._finish_scalar(0)) + tuple(extra),
                     {},
                 )
-            self.scores, self._bag_mask, trees_out, nl_dev = fn(
-                self.scores, self._bag_mask, jnp.int32(self.iter_), fmasks,
-                self._finish_scalar(0), *extra,
-            )
+            with sanitize_mod.transfer_scope("gbdt.train_chunk"):
+                self.scores, self._bag_mask, trees_out, nl_dev = fn(
+                    self.scores, self._bag_mask, it_dev, fmasks,
+                    self._finish_scalar(0), *extra,
+                )
             if harvest is not None:
                 costs_mod.COSTS.harvest(
                     "gbdt.train_chunk", fn, harvest[0], harvest[1]
@@ -1082,15 +1124,16 @@ class GBDT:
         if fn is None:
             fn = jax.jit(step, donate_argnums=(0,))
             self._finish_fns[key] = fn
-        out = fn(
-            self.scores,
-            tree_arrays.leaf_value,
-            tree_arrays.internal_value,
-            leaf_id,
-            self._bag_mask,
-            nl_dev,
-            self._finish_scalar(k),
-        )
+        with sanitize_mod.transfer_scope("gbdt.finish_tree"):
+            out = fn(
+                self.scores,
+                tree_arrays.leaf_value,
+                tree_arrays.internal_value,
+                leaf_id,
+                self._bag_mask,
+                nl_dev,
+                self._finish_scalar(k),
+            )
         # the data learner's step carries a 4th output (the materialized
         # add vector — the FMA-contraction pin, see _finish_step); unused
         self.scores, leaf_value, internal_value = out[0], out[1], out[2]
@@ -1144,7 +1187,7 @@ class GBDT:
         return (k, renew is not None, use_bag, pin_adds), step
 
     def _finish_scalar(self, k: int):
-        return np.float32(self.shrinkage_rate)
+        return self._f32_dev(self.shrinkage_rate)
 
     def _train_tree(self, grad_k: jax.Array, hess_k: jax.Array):
         cfg = self.config
@@ -1225,10 +1268,11 @@ class GBDT:
                      self.feature_meta),
                     grow_kwargs,
                 )
-            out = grow_tree(
-                self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
-                self.feature_meta, **grow_kwargs,
-            )
+            with sanitize_mod.transfer_scope("ops.grow_tree"):
+                out = grow_tree(
+                    self.bins_dev, grad_k, hess_k, self._bag_mask, fmask,
+                    self.feature_meta, **grow_kwargs,
+                )
             if harvest is not None:
                 costs_mod.COSTS.harvest(
                     "ops.grow_tree", grow_tree, harvest[0], harvest[1]

@@ -85,7 +85,7 @@ class RandomForest(GBDT):
         return ("rf", k, renew is not None, use_bag), step
 
     def _finish_scalar(self, k):
-        return np.float32(float(self._rf_init()[k]))
+        return self._f32_dev(float(self._rf_init()[k]))
 
     # scores hold the SUM of tree outputs; metrics see the average
     def _train_score_np(self):

@@ -24,6 +24,10 @@ report); the serve-side drift monitor lives in serve/drift.py. One spine:
  * :mod:`~lightgbm_tpu.obs.registry` — the one metrics registry (counters /
    gauges / histograms / rates) behind the serve ``/metrics`` Prometheus
    endpoint, the training callback, and the bench/bringup run reports.
+ * :mod:`~lightgbm_tpu.obs.sanitize` — the graftsan runtime sanitizer
+   (``LIGHTGBM_TPU_SAN=transfer,nan,locks``): transfer guards at the jitted
+   dispatch seams, NaN tripwires on the score carries, lock-order inversion
+   detection (docs/StaticAnalysis.md §Runtime sanitizer).
 
 Importing this package never touches a jax backend.
 """

@@ -43,6 +43,7 @@ from typing import Dict, Optional, Tuple
 
 from ..utils import log
 from . import registry as registry_mod
+from . import sanitize as sanitize_mod
 
 ENV_COSTS = "LIGHTGBM_TPU_COSTS"
 
@@ -59,16 +60,26 @@ def enabled() -> bool:
 #: device_kind family -> peaks. ``peak_flops`` is the f32-accumulation MXU
 #: peak the MFU numbers divide by (histograms accumulate f32 via
 #: preferred_element_type even with bf16 operands); ``peak_flops_bf16`` is
-#: the headline bf16 rate for context; ``peak_bw`` is HBM bytes/s.
+#: the headline bf16 rate for context; ``peak_bw`` is HBM bytes/s;
+#: ``vmem_bytes`` is the per-core VMEM a Pallas kernel's resident blocks
+#: must fit (the Mosaic scoped-allocation ceiling ops/hist_pallas.py
+#: budgets against, and the bound graftlint JX011 statically enforces by
+#: reading THIS table — the smallest vmem_bytes gates every kernel).
 #: Sources: public TPU system specs (v4 275 TF bf16 / 1228 GB/s; v5e 197 TF
 #: bf16 / 819 GB/s; v5p 459 TF bf16 / 2765 GB/s; v6e 918 TF bf16 /
-#: 1640 GB/s); cpu-nominal keeps the pre-existing bench placeholder.
+#: 1640 GB/s); cpu-nominal keeps the pre-existing bench placeholder (and
+#: mirrors the TPU VMEM ceiling so interpret-mode shapes stay portable).
 CHIP_PEAKS: Dict[str, Dict[str, float]] = {
-    "v4": {"peak_flops": 137e12, "peak_flops_bf16": 275e12, "peak_bw": 1228e9},
-    "v5e": {"peak_flops": 99e12, "peak_flops_bf16": 197e12, "peak_bw": 819e9},
-    "v5p": {"peak_flops": 229e12, "peak_flops_bf16": 459e12, "peak_bw": 2765e9},
-    "v6e": {"peak_flops": 459e12, "peak_flops_bf16": 918e12, "peak_bw": 1640e9},
-    "cpu": {"peak_flops": 1e11, "peak_flops_bf16": 1e11, "peak_bw": 2e10},
+    "v4": {"peak_flops": 137e12, "peak_flops_bf16": 275e12,
+           "peak_bw": 1228e9, "vmem_bytes": 16 * 2 ** 20},
+    "v5e": {"peak_flops": 99e12, "peak_flops_bf16": 197e12,
+            "peak_bw": 819e9, "vmem_bytes": 16 * 2 ** 20},
+    "v5p": {"peak_flops": 229e12, "peak_flops_bf16": 459e12,
+            "peak_bw": 2765e9, "vmem_bytes": 16 * 2 ** 20},
+    "v6e": {"peak_flops": 459e12, "peak_flops_bf16": 918e12,
+            "peak_bw": 1640e9, "vmem_bytes": 32 * 2 ** 20},
+    "cpu": {"peak_flops": 1e11, "peak_flops_bf16": 1e11,
+            "peak_bw": 2e10, "vmem_bytes": 16 * 2 ** 20},
 }
 
 #: the chip assumed when a TPU device_kind string matches no family —
@@ -182,7 +193,7 @@ class CostBook:
     def __init__(self) -> None:
         self._records: Dict[str, Dict[str, object]] = {}
         self._seen: set = set()
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.costs")
 
     def harvest(self, name: str, jit_fn, args=(), kwargs=None,
                 registry=None) -> Optional[Dict[str, object]]:

@@ -47,6 +47,8 @@ import json
 import os
 import sys
 import threading
+
+from . import sanitize as sanitize_mod
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -74,7 +76,7 @@ _SECTION_REGISTERED = False
 # comms seconds accumulated since the last flight-recorder boundary
 # (flight.note_boundary drains it via take_boundary_comms)
 _BOUNDARY = {"comms_s": 0.0}
-_BOUNDARY_LOCK = threading.Lock()
+_BOUNDARY_LOCK = sanitize_mod.make_lock("obs.dist.boundary")
 
 _STRAGGLER = {"streak": 0, "calls": 0}
 

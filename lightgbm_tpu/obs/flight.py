@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from ..utils import log
+from . import sanitize as sanitize_mod
 
 ENV_FLIGHT = "LIGHTGBM_TPU_FLIGHT"
 
@@ -63,7 +64,7 @@ class FlightRecorder:
 
     def __init__(self, path: str) -> None:
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.flight")
         self._seq = 0
         self._t0 = time.perf_counter()
         d = os.path.dirname(os.path.abspath(path))

@@ -29,6 +29,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from . import sanitize as sanitize_mod
 import time
 from collections import deque
 from typing import Dict, List, Optional
@@ -38,7 +40,7 @@ from . import registry as registry_mod
 ENV_MEMWATCH = "LIGHTGBM_TPU_MEMWATCH"
 
 _SNAPSHOTS: deque = deque(maxlen=256)
-_LOCK = threading.Lock()
+_LOCK = sanitize_mod.make_lock("obs.memwatch")
 
 F32_BYTES = 4
 

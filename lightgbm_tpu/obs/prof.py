@@ -54,6 +54,7 @@ import numpy as np
 
 from ..utils.log import LightGBMError
 from . import registry as registry_mod
+from . import sanitize as sanitize_mod
 from . import trace as trace_mod
 
 ENV_SEGMENTS = "LIGHTGBM_TPU_PROF_SEGMENTS"
@@ -84,7 +85,7 @@ class SegmentBook:
     def __init__(self) -> None:
         self.seconds: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.prof.segments")
 
     def add(self, name: str, dt: float) -> None:
         with self._lock:

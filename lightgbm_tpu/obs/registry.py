@@ -28,6 +28,8 @@ from __future__ import annotations
 
 import re
 import threading
+
+from . import sanitize as sanitize_mod
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
@@ -71,7 +73,7 @@ class Counter:
         self.name = name
         self.help = help
         self._values: Dict[Tuple, float] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.registry.counter")
 
     def inc(self, by: float = 1, **labels) -> None:
         key = _label_key(labels)
@@ -96,7 +98,7 @@ class Gauge:
         self.help = help
         self._values: Dict[Tuple, float] = {}
         self._fn: Optional[Callable[[], float]] = None
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.registry.gauge")
 
     def set(self, value: float, **labels) -> None:
         with self._lock:
@@ -129,7 +131,7 @@ class Histogram:
         self._buf = np.zeros(size, np.float64)
         self._n = 0  # total ever recorded
         self._sum = 0.0  # all-time sum (Prometheus _sum)
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.registry.histogram")
 
     def record(self, value: float) -> None:
         with self._lock:
@@ -171,7 +173,7 @@ class RateMeter:
     def __init__(self, window_s: float = 60.0) -> None:
         self.window_s = window_s
         self._events: deque = deque()  # (t, weight)
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.registry.rate")
 
     def record(self, weight: float = 1.0, now: Optional[float] = None) -> None:
         t = time.perf_counter() if now is None else now
@@ -203,7 +205,7 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._metrics: Dict[str, object] = {}
         self._sections: Dict[str, Callable[[], object]] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.registry")
 
     def register_report_section(
         self, name: str, fn: Callable[[], object]

@@ -36,6 +36,7 @@ from typing import Dict, Iterable, Optional
 from ..utils import log
 from ..utils.log import LightGBMError
 from . import registry as registry_mod
+from . import sanitize as sanitize_mod
 
 ENV_RETRACE = "LIGHTGBM_TPU_RETRACE"
 
@@ -52,7 +53,7 @@ class RetraceWatchdog:
         self._counts: Dict[str, int] = {}
         self._warm: Dict[str, int] = {}
         self._armed = False
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.retrace")
 
     def note_trace(self, name: str) -> None:
         """Called from inside a traced body — once per real XLA trace."""

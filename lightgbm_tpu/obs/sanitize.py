@@ -1,0 +1,318 @@
+"""graftsan runtime sanitizer: exactness & concurrency teeth, env-gated.
+
+``LIGHTGBM_TPU_SAN=transfer,nan,locks`` (or ``=all`` / ``=1``) arms one or
+more modes; unset, the module is provably free — every hook is a single
+module-boolean check, ``transfer_scope`` hands back one shared nullcontext,
+``make_lock`` returns a plain ``threading.Lock`` (zero wrapper allocation),
+and nothing new traces or compiles (tests/test_sanitize.py pins all three).
+
+Modes
+-----
+``transfer``
+    Scoped ``jax.transfer_guard_host_to_device("disallow")`` around the
+    boosting dispatch (engine._boost_loop) and the serve dispatch
+    (serve/cache.py) — the runtime teeth behind graftlint JX001. Inside a
+    guarded scope every host→device byte must be an EXPLICIT
+    ``jax.device_put``/``jnp.asarray``; an implicit upload (a numpy operand
+    sneaking into a jitted call, a host constant rebuilt per dispatch) is
+    exactly the silent per-iteration transfer the lint rule hunts, and here
+    it raises instead of costing latency quietly. Device→host readbacks
+    are not guarded: boundary evals and result fetches are the loop's job.
+
+``nan``
+    NaN/inf tripwires on the training score carries at chunk boundaries:
+    the first boundary whose carry goes non-finite raises
+    :class:`SanitizerError` naming the iteration — instead of the
+    divergence surfacing dozens of iterations later as an AUC collapse
+    with no provenance.
+
+``locks``
+    :func:`make_lock` returns instrumented locks that record per-thread
+    acquisition order into a process-global order graph and fail on the
+    first lock-order INVERSION (lock B acquired under A somewhere, A under
+    B elsewhere — the deadlock shape review keeps missing). The runtime
+    twin of graftlint JX013; driven in anger by the concurrency stress
+    smoke (helpers/san_smoke.py: concurrent predict + hot-swap + drain +
+    drift + /metrics scrape).
+
+jax is imported lazily (transfer mode only), so the lock/nan machinery —
+and every importer of this module — stays usable in jax-free drivers.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.log import LightGBMError
+
+ENV_SAN = "LIGHTGBM_TPU_SAN"
+
+_ALL_MODES = ("transfer", "nan", "locks")
+
+
+class SanitizerError(LightGBMError):
+    """A sanitizer tripwire fired (never raised when LIGHTGBM_TPU_SAN is
+    unset)."""
+
+
+def _parse_modes(raw: Optional[str]) -> frozenset:
+    if raw is None:
+        return frozenset()
+    raw = raw.strip().lower()
+    if raw in ("", "0", "off", "false"):
+        return frozenset()
+    if raw in ("1", "all", "on", "true"):
+        return frozenset(_ALL_MODES)
+    modes = frozenset(
+        tok for tok in (t.strip() for t in raw.split(",")) if tok
+    )
+    unknown = modes - frozenset(_ALL_MODES)
+    if unknown:
+        raise LightGBMError(
+            "%s: unknown sanitizer mode(s) %s (known: %s)"
+            % (ENV_SAN, ", ".join(sorted(unknown)), ", ".join(_ALL_MODES))
+        )
+    return modes
+
+
+#: armed modes — set once at import; tests re-read with :func:`refresh`
+MODES: frozenset = frozenset()
+TRANSFER: bool = False
+NAN: bool = False
+LOCKS: bool = False
+
+
+def refresh() -> frozenset:
+    """Re-read LIGHTGBM_TPU_SAN (tests and subprocess drivers); returns the
+    armed mode set."""
+    global MODES, TRANSFER, NAN, LOCKS
+    MODES = _parse_modes(os.environ.get(ENV_SAN))
+    TRANSFER = "transfer" in MODES
+    NAN = "nan" in MODES
+    LOCKS = "locks" in MODES
+    return MODES
+
+
+refresh()
+
+
+# --------------------------------------------------------------------------
+# transfer mode
+# --------------------------------------------------------------------------
+#: the ONE nullcontext every un-armed transfer_scope() call returns — the
+#: off path allocates nothing per call
+_NULL = contextlib.nullcontext()
+
+
+class _TransferScope:
+    """``jax.transfer_guard_host_to_device("disallow")`` with the sanitizer
+    nameplate on the error: a tripped guard raises SanitizerError naming
+    the guarded site, chaining jax's own transfer description."""
+
+    __slots__ = ("site", "_cm")
+
+    def __init__(self, site: str) -> None:
+        self.site = site
+        self._cm = None
+
+    def __enter__(self) -> "_TransferScope":
+        import jax
+
+        self._cm = jax.transfer_guard_host_to_device("disallow")
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        cm, self._cm = self._cm, None
+        suppress = bool(cm.__exit__(exc_type, exc, tb)) if cm else False
+        if (
+            not suppress
+            and exc is not None
+            and "Disallowed host-to-device transfer" in str(exc)
+        ):
+            raise SanitizerError(
+                "sanitizer(transfer): implicit host->device transfer inside "
+                "the guarded %r scope — the silent per-dispatch upload "
+                "graftlint JX001 polices statically; make the upload an "
+                "explicit jax.device_put/jnp.asarray outside the hot path "
+                "(original: %s)" % (self.site, str(exc)[:300])
+            ) from exc
+        return suppress
+
+
+def transfer_scope(site: str = "dispatch"):
+    """Context manager for a no-implicit-upload region. The off path returns
+    one shared nullcontext (no allocation, no jax import)."""
+    if not TRANSFER:
+        return _NULL
+    return _TransferScope(site)
+
+
+class _AllowScope:
+    """Re-allow implicit uploads inside a guarded region — the audited-site
+    suppression (kept a named scope so suppressions are grep-able, the
+    in-code analogue of a baseline entry)."""
+
+    __slots__ = ("site", "_cm")
+
+    def __init__(self, site: str) -> None:
+        self.site = site
+        self._cm = None
+
+    def __enter__(self) -> "_AllowScope":
+        import jax
+
+        self._cm = jax.transfer_guard_host_to_device("allow")
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        cm, self._cm = self._cm, None
+        return bool(cm.__exit__(exc_type, exc, tb)) if cm else False
+
+
+def allow_transfers(site: str):
+    """Suppress the transfer guard for an AUDITED eager host poke inside a
+    guarded scope (e.g. the first-iteration init-score `.at[k].add`, whose
+    python-int index uploads implicitly but runs at most K times per run).
+    Off path: the shared nullcontext."""
+    if not TRANSFER:
+        return _NULL
+    return _AllowScope(site)
+
+
+# --------------------------------------------------------------------------
+# nan mode
+# --------------------------------------------------------------------------
+def check_scores(gbdt, iteration: int) -> None:
+    """Boundary tripwire: raise if the training score carry holds any
+    NaN/inf. Callers gate on ``sanitize.NAN`` so the off path is one
+    module-boolean read."""
+    import numpy as np
+
+    scores = np.asarray(gbdt.scores_canonical_np())
+    finite = np.isfinite(scores)
+    if bool(finite.all()):
+        return
+    bad = int(scores.size - int(finite.sum()))
+    first = np.unravel_index(int(np.argmin(finite.reshape(-1))), scores.shape)
+    raise SanitizerError(
+        "sanitizer(nan): training score carry went non-finite at the "
+        "boundary after iteration %d (%d bad value(s); first at index %s "
+        "= %r) — check the objective's gradients, the learning rate, and "
+        "any custom fobj for overflow"
+        % (iteration, bad, tuple(int(i) for i in first),
+           float(scores[first]))
+    )
+
+
+# --------------------------------------------------------------------------
+# locks mode
+# --------------------------------------------------------------------------
+#: process-global lock-order graph: (id(a), id(b)) -> (name_a, name_b, where)
+#: meaning "b was acquired while holding a". Guarded by the meta-lock (a
+#: PLAIN threading.Lock — instrumenting the instrument would recurse).
+_edges: Dict[Tuple[int, int], Tuple[str, str, str]] = {}
+_meta = threading.Lock()
+_tls = threading.local()
+
+
+def _held_stack() -> List["_SanLock"]:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+class _SanLock:
+    """A non-reentrant lock that records per-thread acquisition order and
+    raises on the first lock-order inversion. Duck-types threading.Lock
+    (acquire/release/locked/context manager), so threading.Condition can
+    wrap it."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._lock = threading.Lock()
+
+    def _note_acquired(self) -> None:
+        stack = _held_stack()
+        me = id(self)
+        for held in stack:
+            h = id(held)
+            if h == me:
+                continue
+            with _meta:
+                back = _edges.get((me, h))
+                if back is not None:
+                    raise SanitizerError(
+                        "sanitizer(locks): lock-order inversion — acquiring "
+                        "%r while holding %r, but %r was previously acquired "
+                        "while holding %r (at %s); pick ONE order and "
+                        "declare it (_LOCK_ORDER, graftlint JX013)"
+                        % (self.name, held.name, back[1], back[0], back[2])
+                    )
+                _edges.setdefault(
+                    (h, me),
+                    (held.name, self.name, threading.current_thread().name),
+                )
+        stack.append(self)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            try:
+                self._note_acquired()
+            except SanitizerError:
+                self._lock.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        stack = _held_stack()
+        # remove the most recent entry for this lock (non-LIFO releases are
+        # legal for plain locks; Condition.wait releases out of order)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return "<_SanLock %r %s>" % (
+            self.name, "locked" if self.locked() else "unlocked"
+        )
+
+
+def make_lock(name: str = "lock"):
+    """The lock factory the serve/obs stack builds its locks through: a
+    plain ``threading.Lock`` (zero wrapper allocation) unless the ``locks``
+    sanitizer mode is armed, then an order-recording :class:`_SanLock`."""
+    if not LOCKS:
+        return threading.Lock()
+    return _SanLock(name)
+
+
+def lock_edges() -> List[Tuple[str, str]]:
+    """The recorded acquisition-order edges (outer, inner) — diagnostics for
+    tests and the stress smoke's final report."""
+    with _meta:
+        return sorted(set((a, b) for (a, b, _w) in _edges.values()))
+
+
+def reset_lock_graph() -> None:
+    """Forget recorded orders (tests; each smoke phase starts clean)."""
+    with _meta:
+        _edges.clear()

@@ -35,6 +35,8 @@ import json
 import os
 import sys
 import threading
+
+from . import sanitize as sanitize_mod
 import time
 from typing import Dict, List, Optional
 
@@ -69,7 +71,7 @@ class Tracer:
         self.max_events = max_events
         self.dropped = 0
         self._events: List[Dict] = []
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("obs.trace.buffer")
         self._tids: Dict[int, int] = {}  # thread ident -> small stable tid
 
     def _append(self, ev: Dict) -> None:
@@ -146,7 +148,7 @@ class Tracer:
 
 
 _TRACER: Optional[Tracer] = None
-_LOCK = threading.Lock()
+_LOCK = sanitize_mod.make_lock("obs.trace")
 _ATEXIT_ARMED = False
 
 

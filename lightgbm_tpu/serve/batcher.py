@@ -24,6 +24,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..obs import sanitize as sanitize_mod
 from ..obs import trace as trace_mod
 from ..resil import faults
 from ..utils import log
@@ -97,7 +98,7 @@ class MicroBatcher:
         # orders submits against close(): without it a submitter could pass
         # the _closed check, be descheduled, and enqueue AFTER close() put
         # the sentinel and drained — leaving a future nothing ever resolves
-        self._submit_lock = threading.Lock()
+        self._submit_lock = sanitize_mod.make_lock("serve.batcher.submit")
         self._worker = threading.Thread(
             target=self._loop, name="lgbtpu-serve-batcher", daemon=True
         )
@@ -214,11 +215,11 @@ class MicroBatcher:
             # here must let close() force-fail it WITH the gathered batch —
             # and it stays covered through the next gather until it lands in
             # a batch of its own
-            self._inflight_batch = batch if carry is None else batch + [carry]
+            self._inflight_batch = batch if carry is None else batch + [carry]  # unlocked: single-writer GIL-atomic rebind (only the worker writes; close() only reads)
             try:
                 self._dispatch(batch, rows)
             finally:
-                self._inflight_batch = [] if carry is None else [carry]
+                self._inflight_batch = [] if carry is None else [carry]  # unlocked: single-writer GIL-atomic rebind (only the worker writes; close() only reads)
             if carry is None:
                 return closing
             first = carry

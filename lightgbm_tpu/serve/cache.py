@@ -21,6 +21,7 @@ from typing import Callable, Dict, Optional, Sequence
 import numpy as np
 
 from ..obs import registry as obs_registry
+from ..obs import sanitize as sanitize_mod
 
 DEFAULT_MIN_ROWS = 16
 DEFAULT_MAX_ROWS = 1 << 16
@@ -62,7 +63,7 @@ class BucketedDispatcher:
         self.bucket_counts: Dict[int, int] = {}
         self.retraces = 0  # distinct buckets dispatched == XLA compiles paid
         self.calls = 0
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("serve.cache.stats")
 
     def bucket(self, n: int) -> int:
         return next_bucket(n, self.min_rows)
@@ -102,7 +103,13 @@ class BucketedDispatcher:
                 )
                 for a in arrays
             )
-        out = self.fn(*arrays)
+        # sanitizer transfer scope (obs/sanitize.py; off = one shared
+        # nullcontext): the padded-bucket dispatch converts its operands
+        # explicitly (jnp.asarray in the wrapped fns) — any OTHER
+        # host->device byte inside the dispatch is a per-request upload
+        # that belongs in the packed model, and trips the guard
+        with sanitize_mod.transfer_scope("serve.dispatch"):
+            out = self.fn(*arrays)
         return self._slice(out, n)
 
     def _concat(self, outs):

@@ -52,6 +52,7 @@ import numpy as np
 from ..models.model_text import model_fingerprint
 from ..models.tree import K_ZERO_THRESHOLD
 from ..obs import registry as registry_mod
+from ..obs import sanitize as sanitize_mod
 from ..utils import log
 
 ENV_DRIFT = "LIGHTGBM_TPU_DRIFT"
@@ -159,7 +160,7 @@ class DriftMonitor:
             if not self.is_cat[f] and len(self._drift_edges[f]) > 0
         ]
         self._nbins = [len(self._drift_edges[f]) + 1 for f in range(F)]
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("serve.drift")
         tracked = set(self.tracked)
         self._live = [
             np.zeros(self._nbins[f], np.int64) if f in tracked else None

@@ -51,6 +51,7 @@ import numpy as np
 from ..models.model_text import model_fingerprint, peek_model_header
 from ..obs import registry as obs_registry
 from ..obs import retrace as retrace_mod
+from ..obs import sanitize as sanitize_mod
 from ..obs import trace as trace_mod
 from ..resil import backoff, faults
 from ..utils import log
@@ -243,6 +244,11 @@ class ModelRegistry:
     fail its first requests on the new model's legitimate first compiles.
     """
 
+    # declared acquisition order (graftlint JX013 + the runtime lock
+    # sanitizer, obs/sanitize.py): the load/hot-swap serializer is always
+    # taken before the registry-dict lock, never the reverse
+    _LOCK_ORDER = ("_load_lock", "_lock")
+
     def __init__(
         self,
         min_bucket_rows: int = 16,
@@ -250,12 +256,12 @@ class ModelRegistry:
         drift_opts: Optional[Dict[str, object]] = None,
     ) -> None:
         self._models: Dict[str, ServedModel] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitize_mod.make_lock("serve.registry")
         # serializes whole load/hot-swap builds (rare operator actions):
         # overlapping loads would race on the shared watchdog disarm/arm
         # window below. Separate from _lock so concurrent PREDICTS are
         # never blocked behind a build.
-        self._load_lock = threading.Lock()
+        self._load_lock = sanitize_mod.make_lock("serve.registry.load")
         self.min_bucket_rows = min_bucket_rows
         self.warmup_rows = warmup_rows
         # feature-drift monitoring (serve/drift.py): kwargs for
@@ -407,11 +413,11 @@ class ServeApp:
         # dead-device fallback: models re-packed on CPU, keyed by content
         # hash so a hot-swapped successor never serves a stale rebuild
         self._cpu_models: Dict[str, ServedModel] = {}
-        self._cpu_rebuild_lock = threading.Lock()
+        self._cpu_rebuild_lock = sanitize_mod.make_lock("serve.cpu_rebuild")
         # drain/shed state: _state_lock orders the draining flag against the
         # in-flight count so drain() can never observe a transient zero while
         # a request is between admission and registration
-        self._state_lock = threading.Lock()
+        self._state_lock = sanitize_mod.make_lock("serve.state")
         # marks handler threads whose whole request track_request already
         # counts, so predict()'s own accounting doesn't count them twice
         self._tracked_thread = threading.local()

@@ -63,7 +63,7 @@ _QUICK_MODULES = {
     "test_micro_exact", "test_model_io", "test_model_obs", "test_native",
     "test_obs",
     "test_ops", "test_parallel_chunk", "test_param_docs", "test_prof",
-    "test_resil",
+    "test_resil", "test_sanitize",
     "test_serve_drift", "test_serve_packed",
     "test_serve_resil", "test_serve_server", "test_snapshot_timers",
     "test_vfile",
@@ -78,7 +78,110 @@ def pytest_configure(config):
     )
 
 
+# ---------------------------------------------------------------------------
+# Multi-process CPU collective capability (tests/test_multiprocess_dist.py):
+# the three device-collective tests run REAL 2-process jax.distributed worlds
+# whose cross-process psum needs jaxlib's multi-process CPU computations —
+# some container jaxlibs raise "Multiprocess computations aren't implemented
+# on the CPU backend" (noted at the PR 9 seed). Probe once (two tiny
+# subprocess ranks psumming over a 2-device global mesh) and skip-with-reason
+# instead of failing, so tier-1 reports capability, not availability.
+# ---------------------------------------------------------------------------
+_MP_COLLECTIVE_TESTS = {
+    "test_two_process_mapper_exchange",
+    "test_two_process_load_then_train",
+    "test_two_process_data_parallel_training",
+}
+_MP_PROBE_WORKER = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+rank, port = int(sys.argv[1]), sys.argv[2]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                           num_processes=2, process_id=rank)
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+mesh = Mesh(np.array(jax.devices()), ("data",))
+arr = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), np.ones(1, np.float32))
+out = jax.jit(shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                        in_specs=P("data"), out_specs=P("data")))(arr)
+assert float(out.addressable_shards[0].data[0]) == 2.0
+print("MP-COLLECTIVES-OK")
+"""
+_mp_probe_cache = {}
+
+
+def _mp_collectives_supported():
+    """One cached 2-process psum probe; (supported, reason-if-not)."""
+    if "verdict" in _mp_probe_cache:
+        return _mp_probe_cache["verdict"]
+    import socket
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    verdict = (False, "probe could not run")
+    for _attempt in range(2):  # retry once on a coordinator port race
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        env = dict(__import__("os").environ, JAX_PLATFORMS="cpu")
+        env.pop("XLA_FLAGS", None)  # real 1-device procs, no virtual mesh
+        with tempfile.TemporaryDirectory() as td:
+            worker = td + "/mp_probe.py"
+            with open(worker, "w") as fh:
+                fh.write(_MP_PROBE_WORKER)
+            procs = [
+                subprocess.Popen(
+                    [_sys.executable, worker, str(r), str(port)], env=env,
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+                )
+                for r in range(2)
+            ]
+            outs = []
+            try:
+                for p in procs:
+                    out, err = p.communicate(timeout=240)
+                    outs.append((p.returncode, out, err))
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                verdict = (False, "capability probe timed out")
+                break
+        if all(rc == 0 and "MP-COLLECTIVES-OK" in out for rc, out, _ in outs):
+            verdict = (True, "")
+            break
+        errs = " ".join(e for _, _, e in outs).lower()
+        if "address already in use" in errs or "failed to bind" in errs:
+            continue  # port race: retry on a fresh port
+        tail = next(
+            (e for rc, _, e in outs if rc != 0), outs[0][2]
+        ).strip().splitlines()
+        verdict = (False, tail[-1][:200] if tail else "probe failed")
+        break
+    _mp_probe_cache["verdict"] = verdict
+    return verdict
+
+
 def pytest_collection_modifyitems(config, items):
+    mp_items = [
+        i for i in items
+        if i.module.__name__.rsplit(".", 1)[-1] == "test_multiprocess_dist"
+        and i.name.split("[")[0] in _MP_COLLECTIVE_TESTS
+    ]
+    if mp_items:
+        supported, reason = _mp_collectives_supported()
+        if not supported:
+            marker = pytest.mark.skip(
+                reason="jaxlib lacks multi-process CPU collectives "
+                       "(probed: %s)" % reason
+            )
+            for item in mp_items:
+                item.add_marker(marker)
     for item in items:
         mod = item.module.__name__.rsplit(".", 1)[-1]
         if mod in _QUICK_MODULES:

@@ -1,0 +1,57 @@
+"""JX011 bad fixture: one pallas_call per contract violation."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(x_ref, o_ref):
+    i = pl.program_id(2)  # grid below has rank 2: axis 2 is out of range
+    o_ref[:] = (x_ref[:] * i).astype(jnp.bfloat16)  # out_shape says float32
+
+
+def bad_arities(x):
+    kernel = functools.partial(_kernel)
+    return pl.pallas_call(
+        kernel,
+        grid=(4, 4),
+        in_specs=[
+            # index_map takes 1 argument against a rank-2 grid
+            pl.BlockSpec((8, 128), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        # index_map returns 3 block coordinates for a 2-dim block_shape
+        out_specs=pl.BlockSpec(
+            (8, 128), lambda i, j: (i, 0, j), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+    )(x, x)  # 1 in_spec, 2 operands
+
+
+def bad_vmem_and_rank(x):
+    return pl.pallas_call(
+        lambda x_ref, o_ref: None,
+        grid=(1,),
+        in_specs=[
+            # 4096*4096*4 B = 64 MiB static f32 block: over any VMEM budget
+            pl.BlockSpec((4096, 4096), lambda i: (i, 0)),
+        ],
+        # rank-2 block for a rank-3 out_shape entry
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128, 4), jnp.float32),
+    )(x)
+
+
+def bad_dtype_missing(x):
+    return pl.pallas_call(
+        lambda x_ref, o_ref: None,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            pl.BlockSpec((8, 128), lambda i: (i, 0)),
+        ],
+        # 2 out_specs, 1 out_shape entry — and that entry pins no dtype
+        out_shape=[jax.ShapeDtypeStruct((8, 128))],
+    )(x)

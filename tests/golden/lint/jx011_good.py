@@ -1,0 +1,52 @@
+"""JX011 good fixture: the real kernels' idioms, all contracts satisfied."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+FB = 8
+LO = 8
+
+
+def _kernel(bins_ref, vt_ref, out_ref, *, hi_n, dtype):
+    c = pl.program_id(1)  # grid rank 2: axes 0 and 1 are both legal
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[0] += (bins_ref[:] * vt_ref[:]).astype(jnp.float32)
+
+
+def good_call(bins, vt, n_chunks, C, K, HI):
+    # the partial-resolved kernel, [spec]*N replication, module-const dims
+    kernel = functools.partial(_kernel, hi_n=HI, dtype=jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid=(4, n_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f8, c: (f8, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, C), lambda f8, c: (0, c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (FB, LO, HI), lambda f8, c: (f8, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((32, LO, HI), jnp.float32),
+    )(bins, vt)
+
+
+def good_whole_array(hist, sums):
+    # gridless whole-array kernel: bare VMEM specs, replicated spec lists
+    vm = pltpu.VMEM
+    outf, outi = pl.pallas_call(
+        lambda h_ref, s_ref, of_ref, oi_ref: None,
+        in_specs=[pl.BlockSpec(memory_space=vm)] * 2,
+        out_specs=[pl.BlockSpec(memory_space=vm)] * 2,
+        out_shape=[
+            jax.ShapeDtypeStruct((2, 9), jnp.float32),
+            jax.ShapeDtypeStruct((2, 4), jnp.int32),
+        ],
+    )(hist, sums)
+    return outf, outi

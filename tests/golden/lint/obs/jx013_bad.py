@@ -1,0 +1,30 @@
+"""JX013 bad fixture: unguarded shared-state mutation + undeclared nesting."""
+import threading
+
+
+class Book:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = {}
+        self._n = 0
+
+    def add(self, k, v):
+        self._items[k] = v  # unguarded subscript store
+        self._n += 1  # unguarded augassign
+
+    def reset(self):
+        with self._lock:
+            self._items = {}
+        self._n = 0  # rebind after the lock released
+
+
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._x = 0
+
+    def swap(self):
+        with self._a:
+            with self._b:  # nesting with no _LOCK_ORDER declared
+                self._x = 1

@@ -1,0 +1,36 @@
+"""JX013 good fixture: guarded mutations, declared nesting, documented
+caller-holds helpers, justified lock-free rebinds."""
+import threading
+
+
+class Book:
+    _LOCK_ORDER = ("_outer", "_inner")
+
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+        self._items = {}
+        self._gen = 0
+        self._snapshot = None
+
+    def set(self, k, v):
+        with self._outer:
+            self._items[k] = v
+
+    def bump(self):
+        with self._outer:
+            with self._inner:  # declared by _LOCK_ORDER
+                self._gen += 1
+
+    def publish(self, snap):
+        self._snapshot = snap  # unlocked: single-writer GIL-atomic rebind
+
+    def _advance(self):
+        """Caller holds _outer."""
+        self._gen += 1
+
+
+class NoLocks:
+    # a class with no lock declares no locking discipline to police
+    def set(self, v):
+        self._v = v

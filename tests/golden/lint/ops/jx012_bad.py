@@ -1,0 +1,34 @@
+"""JX012 bad fixture: every float-exactness hazard the rule knows."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_inline_fma(scores, leaf, rate, lid):
+    # inline multiply feeding the add: fusion-dependent FMA contraction
+    scores = scores + leaf[lid] * rate
+    return scores
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def bad_at_add(scores, leaf, rate, lid):
+    scores = scores.at[0].add(leaf[lid] * rate)
+    return scores
+
+
+@jax.jit
+def bad_augassign(score_carry, leaf, rate):
+    score_carry += leaf * rate
+    return score_carry
+
+
+def bad_barrier(x, y):
+    # stripped before fusion; fences nothing (PR 8, measured)
+    return jax.lax.optimization_barrier((x, y))
+
+
+def shard_sum(grad):
+    # grouping of the f32 accumulation depends on the shard count
+    return jax.lax.psum(jnp.sum(grad, axis=0), "data")

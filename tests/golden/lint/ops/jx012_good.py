@@ -1,0 +1,37 @@
+"""JX012 good fixture: the exactness-safe forms of the bad patterns."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def good_materialized(scores, leaf, rate, lid):
+    # the product bound to its own value first: every program shape
+    # performs the identical plain add (and `add` can be made a program
+    # output to pin fusion, the PR 8 fix)
+    shrunk = leaf * rate
+    add = shrunk[lid]
+    scores = scores.at[0].add(add)
+    return scores, add
+
+
+@jax.jit
+def good_non_score_names(a, b, c):
+    # multiply-add off the score/carry path is not an exactness contract
+    total = a + b * c
+    return total
+
+
+def good_host_side(self_scores, pred, factor):
+    # eager (non-jit) host arithmetic dispatches one kernel per op — there
+    # is no fusion pass to contract across (the dart rescale path)
+    return self_scores.at[0].add(pred * factor)
+
+
+def good_psum_of_name(hist):
+    # the collective consumes an already-materialized shard-local value
+    return jax.lax.psum(hist, "data")
+
+
+@jax.jit
+def good_local_sum(grad):
+    return jnp.sum(grad, axis=0)

@@ -27,7 +27,8 @@ def test_stage_table_complete():
     assert set(tb.STAGE_TIMEOUTS) == {
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "bench_early",
         "smoke_pallas", "smoke_xla_radix", "smoke_bf16", "smoke_psplit",
-        "bench_chunk", "bench_multichip", "bench_predict", "prof", "bench",
+        "bench_chunk", "bench_multichip", "bench_predict", "prof", "san",
+        "bench",
     }
 
 
@@ -191,3 +192,22 @@ def test_rehearsal_mode_is_isolated():
     src = open(tb.__file__).read()
     assert 'TPU_BRINGUP_REHEARSAL.json' in src
     assert 'BENCH_FORCE_PLATFORMS"] = "cpu"' in src
+
+
+def test_run_san_invokes_smoke_by_file_path(monkeypatch):
+    """The san stage (ISSUE 11) must execute helpers/san_smoke.py by FILE
+    path in a child — the driver never imports the package (stays jax-free)
+    and the child arms LIGHTGBM_TPU_SAN itself."""
+    import os as _os
+
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["stage"] = stage
+        seen["argv"] = argv
+        return {"ok": True}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    r = tb.run_san()
+    assert r["ok"] and seen["stage"] == "san"
+    assert seen["argv"][-1].endswith(_os.path.join("helpers", "san_smoke.py"))

@@ -28,7 +28,13 @@ from tools.graftlint.engine import compare_to_baseline  # noqa: E402
 
 LINT_DIR = os.path.join(REPO, "tests", "golden", "lint")
 ALL_RULES = ("JX001", "JX002", "JX003", "JX004",
-             "JX005", "JX006", "JX007", "JX008", "JX009", "JX010")
+             "JX005", "JX006", "JX007", "JX008", "JX009", "JX010",
+             "JX011", "JX012", "JX013")
+
+#: the default scan scope the check.sh gate and the baseline test share —
+#: lightgbm_tpu/ plus the orchestration surface (helpers/, bench.py) whose
+#: bugs burn bringup rounds just as surely (ISSUE 11 satellite)
+SCAN_SCOPE = ("lightgbm_tpu", "helpers", "bench.py")
 
 
 def _fixture(rule_id, kind):
@@ -36,7 +42,7 @@ def _fixture(rule_id, kind):
     their fixtures under golden/lint/<scope-dir>/ so the scope gate sees the
     required path segment; everything else lives flat in golden/lint/."""
     name = "%s_%s.py" % (rule_id.lower(), kind)
-    for scope in ("ops", "lightgbm_tpu"):
+    for scope in ("ops", "obs", "lightgbm_tpu"):
         scoped = os.path.join(LINT_DIR, scope, name)
         if os.path.exists(scoped):
             return scoped
@@ -292,6 +298,194 @@ def test_static_argnames_are_not_traced():
 
 
 # ---------------------------------------------------------------------------
+# JX011/JX012/JX013 (the graftsan wave, ISSUE 11)
+# ---------------------------------------------------------------------------
+def test_jx011_counts_and_kinds():
+    """Every contract violation in the bad fixture is reported exactly once,
+    with a content-stable detail naming the violated contract."""
+    findings = _lint(_fixture("JX011", "bad"), "JX011")
+    details = sorted(f.detail for f in findings)
+    assert details == sorted([
+        "_kernel:program_id=2",       # axis 2 against a rank-2 grid
+        "_kernel:store_dtype",        # .astype(bfloat16) into a f32 out ref
+        "in_specs_count",             # 1 spec, 2 operands
+        "in_specs[0]:index_map_arity",  # 1-arg lambda, rank-2 grid
+        "out_specs[0]:index_map_rank",  # 3 coords, 2-dim block
+        "in_specs[0]:vmem",           # 64 MiB static block
+        "out[0]:block_rank",          # rank-2 block, rank-3 out_shape
+        "out_specs_count",            # 2 out_specs, 1 out_shape
+        "out[0]:dtype_missing",       # ShapeDtypeStruct without dtype
+    ]), [f.format() for f in findings]
+
+
+def test_jx011_vmem_budget_from_chip_peaks(tmp_path):
+    """The VMEM bound reads the smallest ``vmem_bytes`` from a CHIP_PEAKS
+    table in the scanned set (obs/costs.py's chip-detection table) instead
+    of hardcoding a chip: the same 1 MiB block passes under the default
+    16 MiB budget and fails when a table declares a tighter chip."""
+    kernel_src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n\n"
+        "def run(x):\n"
+        "    return pl.pallas_call(\n"
+        "        lambda x_ref, o_ref: None,\n"
+        "        grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((512, 512), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((512, 512), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((2048, 512), jnp.float32),\n"
+        "    )(x)\n"
+    )
+    k = tmp_path / "kern.py"
+    k.write_text(kernel_src)
+    assert run_lint([str(k)], root=str(tmp_path), select=["JX011"]) == []
+    (tmp_path / "peaks.py").write_text(
+        "CHIP_PEAKS = {\n"
+        '    "tiny": {"peak_flops": 1e12, "vmem_bytes": 512 * 1024},\n'
+        '    "big": {"peak_flops": 9e12, "vmem_bytes": 64 * 2 ** 20},\n'
+        "}\n"
+    )
+    findings = run_lint([str(tmp_path)], root=str(tmp_path), select=["JX011"])
+    assert len(findings) == 2, [f.format() for f in findings]  # in + out spec
+    assert all("524288-byte" in f.message for f in findings)
+
+
+def test_jx011_helper_built_specs_are_unknown_not_one(tmp_path):
+    """``in_specs=build_specs(3)`` is a helper returning an unknown number
+    of specs — the count check must SKIP, not assume a single BlockSpec and
+    flag correct code."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n\n"
+        "def build_specs(n):\n"
+        "    return [pl.BlockSpec((8, 128), lambda i: (i, 0))] * n\n\n"
+        "def run(x, y, z):\n"
+        "    return pl.pallas_call(\n"
+        "        lambda a_ref, b_ref, c_ref, o_ref: None,\n"
+        "        grid=(4,),\n"
+        "        in_specs=build_specs(3),\n"
+        "        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),\n"
+        "    )(x, y, z)\n"
+    )
+    p = tmp_path / "helper_specs.py"
+    p.write_text(src)
+    assert run_lint([str(p)], root=str(tmp_path), select=["JX011"]) == []
+
+
+def test_jx011_scratch_refs_not_mistaken_for_out_refs(tmp_path):
+    """scratch_shapes refs trail the out refs in a pallas kernel signature;
+    a correct bf16 store into the SCRATCH ref must not be flagged against
+    the f32 out_shape dtype."""
+    src = (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "from jax.experimental import pallas as pl\n"
+        "from jax.experimental.pallas import tpu as pltpu\n\n"
+        "def _kernel(x_ref, o_ref, acc_ref):\n"
+        "    acc_ref[:] = x_ref[:].astype(jnp.bfloat16)\n"
+        "    o_ref[:] = acc_ref[:].astype(jnp.float32)\n\n"
+        "def run(x):\n"
+        "    return pl.pallas_call(\n"
+        "        _kernel,\n"
+        "        grid=(4,),\n"
+        "        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],\n"
+        "        out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),\n"
+        "        out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),\n"
+        "        scratch_shapes=[pltpu.VMEM((8, 128), jnp.bfloat16)],\n"
+        "    )(x)\n"
+    )
+    p = tmp_path / "scratch.py"
+    p.write_text(src)
+    assert run_lint([str(p)], root=str(tmp_path), select=["JX011"]) == []
+
+
+def test_jx011_real_pallas_seams_clean():
+    """The shipped kernels must satisfy their own hygiene rule — the Pallas
+    PR grows from these seams under JX011's gate."""
+    for mod in ("hist_pallas.py", "split_pallas.py"):
+        path = os.path.join(REPO, "lightgbm_tpu", "ops", mod)
+        assert _lint(path, "JX011") == [], mod
+
+
+def test_jx012_counts_and_scope(tmp_path):
+    """Five hazards in the bad fixture; the identical file is CLEAN outside
+    ops//models/ (serve and helpers code has no bitwise-identity contract),
+    and every message cites the PR 8 FMA find."""
+    findings = _lint(_fixture("JX012", "bad"), "JX012")
+    assert len(findings) == 5, [f.format() for f in findings]
+    fma = [f for f in findings if "FMA" in f.message]
+    assert len(fma) >= 4  # 3 inline-mult-adds + the barrier message
+    assert sum("PR 8" in f.message for f in findings) >= 4
+    src = open(_fixture("JX012", "bad")).read()
+    outside = tmp_path / "helpers"
+    outside.mkdir()
+    (outside / "jx012_bad.py").write_text(src)
+    assert run_lint([str(outside / "jx012_bad.py")], root=str(tmp_path),
+                    select=["JX012"]) == []
+
+
+def test_jx013_counts_and_scope(tmp_path):
+    """Four findings in the bad fixture (3 unguarded mutations + 1
+    undeclared nesting); the identical file is CLEAN outside serve//obs/."""
+    findings = _lint(_fixture("JX013", "bad"), "JX013")
+    assert sorted(f.detail for f in findings) == [
+        "attr=_items", "attr=_n", "attr=_n", "nest=_a>_b",
+    ], [f.format() for f in findings]
+    src = open(_fixture("JX013", "bad")).read()
+    outside = tmp_path / "models"
+    outside.mkdir()
+    (outside / "jx013_bad.py").write_text(src)
+    assert run_lint([str(outside / "jx013_bad.py")], root=str(tmp_path),
+                    select=["JX013"]) == []
+
+
+def test_jx013_pragma_needs_a_reason(tmp_path):
+    """A bare ``# unlocked:`` with no justification must NOT suppress — the
+    pragma is an in-place baseline entry and carries the same obligation."""
+    src = (
+        "import threading\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._v = 0\n\n"
+        "    def set_empty(self, v):\n"
+        "        self._v = v  # unlocked:\n\n"
+        "    def set_reason(self, v):\n"
+        "        self._v = v  # unlocked: single-writer rebind\n"
+    )
+    d = tmp_path / "obs"
+    d.mkdir()
+    (d / "c.py").write_text(src)
+    findings = run_lint([str(d / "c.py")], root=str(tmp_path),
+                        select=["JX013"])
+    assert len(findings) == 1 and findings[0].line == 9, [
+        f.format() for f in findings
+    ]
+
+
+def test_jx013_sanitize_make_lock_counts_as_lock(tmp_path):
+    """A class building its lock through obs/sanitize.py's make_lock factory
+    owns a lock exactly like a raw threading.Lock one."""
+    src = (
+        "from ..obs import sanitize\n\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = sanitize.make_lock('c')\n"
+        "        self._v = 0\n\n"
+        "    def bump(self):\n"
+        "        self._v += 1\n"
+    )
+    d = tmp_path / "serve"
+    d.mkdir()
+    (d / "c.py").write_text(src)
+    findings = run_lint([str(d / "c.py")], root=str(tmp_path),
+                        select=["JX013"])
+    assert len(findings) == 1 and findings[0].detail == "attr=_v"
+
+
+# ---------------------------------------------------------------------------
 # registry + docs
 # ---------------------------------------------------------------------------
 def test_rule_registry_complete():
@@ -311,7 +505,9 @@ def test_rules_documented_in_docs():
 # the shipped baseline is exact: no new findings, no stale suppressions
 # ---------------------------------------------------------------------------
 def test_baseline_matches_current_findings_exactly():
-    findings = run_lint([os.path.join(REPO, "lightgbm_tpu")], root=REPO)
+    findings = run_lint(
+        [os.path.join(REPO, p) for p in SCAN_SCOPE], root=REPO
+    )
     baseline, notes = load_baseline(DEFAULT_BASELINE)
     new, stale = compare_to_baseline(findings, baseline)
     assert not new, (
@@ -338,7 +534,9 @@ def test_baseline_entries_are_justified():
 # CLI
 # ---------------------------------------------------------------------------
 def test_cli_in_process_clean(capsys):
-    rc = cli_main([os.path.join(REPO, "lightgbm_tpu"), "--root", REPO])
+    rc = cli_main(
+        [os.path.join(REPO, p) for p in SCAN_SCOPE] + ["--root", REPO]
+    )
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "clean" in out
@@ -356,7 +554,7 @@ def test_cli_reports_findings(capsys):
 
 def test_cli_subprocess_entrypoint():
     proc = subprocess.run(
-        [sys.executable, "-m", "tools.graftlint", "lightgbm_tpu/"],
+        [sys.executable, "-m", "tools.graftlint"] + list(SCAN_SCOPE),
         cwd=REPO, capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr

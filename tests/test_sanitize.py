@@ -1,0 +1,321 @@
+"""Runtime sanitizer (obs/sanitize.py): every seeded violation class must be
+caught with the mode armed, and the off path must be provably free — zero
+new jit traces, zero lock-wrapper allocation, one shared nullcontext.
+
+The env gate is re-read with sanitize.refresh(); every armed test restores
+the off state so module-global booleans never leak across tests.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.obs import sanitize  # noqa: E402
+from lightgbm_tpu.utils.log import LightGBMError  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _san_off_after(monkeypatch):
+    """Whatever a test armed, the next test starts with the sanitizer off."""
+    yield
+    os.environ.pop(sanitize.ENV_SAN, None)
+    sanitize.refresh()
+    sanitize.reset_lock_graph()
+
+
+def _arm(monkeypatch, modes: str):
+    monkeypatch.setenv(sanitize.ENV_SAN, modes)
+    assert sanitize.refresh() == frozenset(modes.split(","))
+
+
+def _train(X, y, **extra):
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    params.update(extra)
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+
+@pytest.fixture
+def data(rng):
+    X = rng.randn(300, 6)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(float)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# mode parsing
+# ---------------------------------------------------------------------------
+def test_mode_parsing(monkeypatch):
+    monkeypatch.setenv(sanitize.ENV_SAN, "transfer")
+    assert sanitize.refresh() == frozenset(["transfer"])
+    assert sanitize.TRANSFER and not sanitize.NAN and not sanitize.LOCKS
+    monkeypatch.setenv(sanitize.ENV_SAN, "all")
+    assert sanitize.refresh() == frozenset(["transfer", "nan", "locks"])
+    monkeypatch.setenv(sanitize.ENV_SAN, "0")
+    assert sanitize.refresh() == frozenset()
+    monkeypatch.setenv(sanitize.ENV_SAN, "nan, locks")
+    assert sanitize.refresh() == frozenset(["nan", "locks"])
+
+
+def test_unknown_mode_is_loud(monkeypatch):
+    monkeypatch.setenv(sanitize.ENV_SAN, "transfer,typo")
+    with pytest.raises(LightGBMError, match="typo"):
+        sanitize.refresh()
+
+
+# ---------------------------------------------------------------------------
+# off path: provably zero-cost
+# ---------------------------------------------------------------------------
+def test_off_shared_nullcontext_and_plain_locks():
+    os.environ.pop(sanitize.ENV_SAN, None)
+    sanitize.refresh()
+    # one shared nullcontext object — no per-call allocation
+    assert sanitize.transfer_scope("a") is sanitize.transfer_scope("b")
+    assert sanitize.allow_transfers("a") is sanitize.transfer_scope("b")
+    # zero lock-wrapper allocation: the factory hands back the raw primitive
+    lk = sanitize.make_lock("x")
+    assert type(lk) is type(threading.Lock())
+
+
+def test_off_serve_stack_uses_plain_locks():
+    os.environ.pop(sanitize.ENV_SAN, None)
+    sanitize.refresh()
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    from lightgbm_tpu.serve.cache import BucketedDispatcher
+
+    plain = type(threading.Lock())
+    disp = BucketedDispatcher(lambda a: a)
+    assert type(disp._lock) is plain
+    mb = MicroBatcher(lambda key, X: X)
+    try:
+        assert type(mb._submit_lock) is plain
+    finally:
+        mb.close()
+
+
+def test_zero_new_traces_off_and_armed(tmp_path):
+    """Watchdog-verified: the sanitizer wiring adds ZERO jit traces — the
+    exact per-name compile counts of an identical chunked train are equal
+    with LIGHTGBM_TPU_SAN unset and with transfer+nan armed (and the chunk
+    program still compiles exactly once). Fresh subprocesses, so the jit
+    caches make the comparison non-vacuous."""
+    code = (
+        "import json\n"
+        "import numpy as np\n"
+        "import lightgbm_tpu as lgb\n"
+        "from lightgbm_tpu.obs import retrace\n"
+        "rng = np.random.RandomState(3)\n"
+        "X = rng.randn(300, 6); y = (X[:, 0] > 0).astype(float)\n"
+        "p = {'objective': 'binary', 'num_leaves': 7, 'verbose': -1,\n"
+        "     'device_chunk_size': 4}\n"
+        "lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=8)\n"
+        "print('COUNTS ' + json.dumps(dict(retrace.WATCHDOG.counts())))\n"
+    )
+    counts = {}
+    for san in (None, "transfer,nan"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop(sanitize.ENV_SAN, None)
+        if san:
+            env[sanitize.ENV_SAN] = san
+        r = subprocess.run(
+            [sys.executable, "-c", code], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=420,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        line = next(
+            ln for ln in r.stdout.splitlines() if ln.startswith("COUNTS ")
+        )
+        counts[san or "off"] = json.loads(line[len("COUNTS "):])
+    assert counts["off"].get("gbdt.train_chunk") == 1, counts
+    assert counts["off"] == counts["transfer,nan"], counts
+
+
+# ---------------------------------------------------------------------------
+# transfer mode
+# ---------------------------------------------------------------------------
+def test_transfer_catches_injected_implicit_upload(monkeypatch):
+    _arm(monkeypatch, "transfer")
+    import jax
+
+    f = jax.jit(lambda a: a * 2)
+    with pytest.raises(sanitize.SanitizerError, match="implicit host->device"):
+        with sanitize.transfer_scope("test.site"):
+            f(np.ones(4, np.float32))  # numpy operand: implicit upload
+
+
+def test_transfer_allow_scope_suppresses(monkeypatch):
+    _arm(monkeypatch, "transfer")
+    import jax
+
+    f = jax.jit(lambda a: a * 2)
+    with sanitize.transfer_scope("test.site"):
+        with sanitize.allow_transfers("audited"):
+            out = f(np.ones(4, np.float32))
+    assert np.array_equal(np.asarray(out), np.full(4, 2.0, np.float32))
+
+
+def test_transfer_training_clean_and_bitwise(monkeypatch, data):
+    """The real training loop passes under the guard, producing the
+    bit-identical model (the sanitizer must observe, never perturb)."""
+    X, y = data
+    base = _train(X, y, device_chunk_size=4).model_to_string()
+    _arm(monkeypatch, "transfer,nan")
+    armed = _train(X, y, device_chunk_size=4).model_to_string()
+    assert armed == base
+    # per-iteration path too
+    os.environ.pop(sanitize.ENV_SAN, None)
+    sanitize.refresh()
+    base1 = _train(X, y).model_to_string()
+    _arm(monkeypatch, "transfer")
+    assert _train(X, y).model_to_string() == base1
+
+
+# ---------------------------------------------------------------------------
+# nan mode
+# ---------------------------------------------------------------------------
+def test_nan_tripwire_catches_poisoned_carry(monkeypatch, data):
+    """The injected-NaN-carry seeding: a poisoned init_score folds straight
+    into the device score carry, and the FIRST boundary names it (NaN
+    gradients alone would not — a splitless tree contributes exact zeros,
+    leaving the carry finite)."""
+    X, y = data
+    _arm(monkeypatch, "nan")
+    init = np.zeros(len(y))
+    init[7] = np.nan
+    with pytest.raises(sanitize.SanitizerError, match="non-finite at the"):
+        lgb.train(
+            {"objective": "binary", "num_leaves": 7, "verbose": -1},
+            lgb.Dataset(X, label=y, init_score=init), num_boost_round=3,
+        )
+
+
+def test_nan_tripwire_silent_on_healthy_run(monkeypatch, data):
+    X, y = data
+    _arm(monkeypatch, "nan")
+    b = _train(X, y)
+    assert b.num_trees() == 5
+
+
+# ---------------------------------------------------------------------------
+# locks mode
+# ---------------------------------------------------------------------------
+def test_locks_inversion_detected(monkeypatch):
+    _arm(monkeypatch, "locks")
+    sanitize.reset_lock_graph()
+    a = sanitize.make_lock("A")
+    b = sanitize.make_lock("B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(sanitize.SanitizerError, match="inversion"):
+        with b:
+            with a:
+                pass
+    # the failed acquire must not leave A held
+    assert not a.locked()
+
+
+def test_locks_consistent_order_clean(monkeypatch):
+    _arm(monkeypatch, "locks")
+    sanitize.reset_lock_graph()
+    a = sanitize.make_lock("A")
+    b = sanitize.make_lock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert ("A", "B") in sanitize.lock_edges()
+
+
+def test_locks_cross_thread_inversion(monkeypatch):
+    """The order graph is process-global: thread 1 teaches A->B, thread 2's
+    B->A nesting must trip even though neither thread saw both orders."""
+    _arm(monkeypatch, "locks")
+    sanitize.reset_lock_graph()
+    a = sanitize.make_lock("A")
+    b = sanitize.make_lock("B")
+    box = {}
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        try:
+            with b:
+                with a:
+                    pass
+            box["err"] = None
+        except sanitize.SanitizerError as e:
+            box["err"] = e
+
+    th1 = threading.Thread(target=t1)
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start()
+    th2.join()
+    assert box["err"] is not None, "cross-thread inversion not detected"
+
+
+def test_locks_condition_wrapping(monkeypatch):
+    """threading.Condition must work over an instrumented lock (the serve
+    drain's _idle condition wraps _state_lock)."""
+    _arm(monkeypatch, "locks")
+    sanitize.reset_lock_graph()
+    lk = sanitize.make_lock("state")
+    cond = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=10)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+
+    for _ in range(200):
+        time.sleep(0.01)
+        with cond:
+            cond.notify_all()
+        if hits:
+            break
+    t.join(timeout=10)
+    assert hits, "Condition over _SanLock never woke its waiter"
+
+
+def test_locks_nonlifo_release(monkeypatch):
+    _arm(monkeypatch, "locks")
+    sanitize.reset_lock_graph()
+    a = sanitize.make_lock("A")
+    b = sanitize.make_lock("B")
+    a.acquire()
+    b.acquire()
+    a.release()  # out of order — legal for plain locks
+    b.release()
+    assert not a.locked() and not b.locked()
+
+
+# ---------------------------------------------------------------------------
+# f32 scalar cache (the explicit-upload seam the transfer mode leans on)
+# ---------------------------------------------------------------------------
+def test_f32_dev_cache_reuses_device_scalar(data):
+    X, y = data
+    b = _train(X, y)
+    g = b._gbdt
+    s1 = g._f32_dev(0.1)
+    s2 = g._f32_dev(0.1)
+    assert s1 is s2
+    assert s1.dtype == np.float32 and s1.shape == ()
+    assert float(g._f32_dev(np.float64(0.25))) == 0.25

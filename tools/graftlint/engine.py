@@ -28,11 +28,13 @@ from collections import Counter
 from typing import Callable, Dict, FrozenSet, Iterator, List, Optional, Sequence, Tuple
 
 __all__ = [
+    "DEFAULT_VMEM_BYTES",
     "Finding",
     "FileContext",
     "JitInfo",
     "ProjectContext",
     "RULES",
+    "const_int",
     "load_baseline",
     "compare_to_baseline",
     "rule",
@@ -173,6 +175,39 @@ def _int_elems(node: ast.AST) -> List[int]:
             if isinstance(el, ast.Constant) and isinstance(el.value, int)
         ]
     return []
+
+
+def const_int(node: ast.AST, env: Optional[Dict[str, int]] = None) -> Optional[int]:
+    """Evaluate a compile-time integer expression: int literals, +/-/*///**
+    arithmetic, unary +/-, and names bound to module-level int constants
+    (``env``). Returns None for anything dynamic — rules must then skip the
+    check rather than guess."""
+    env = env or {}
+    if isinstance(node, ast.Constant):
+        return node.value if type(node.value) is int else None
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, (ast.USub, ast.UAdd)):
+        v = const_int(node.operand, env)
+        if v is None:
+            return None
+        return -v if isinstance(node.op, ast.USub) else v
+    if isinstance(node, ast.BinOp):
+        a = const_int(node.left, env)
+        b = const_int(node.right, env)
+        if a is None or b is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return a + b
+        if isinstance(node.op, ast.Sub):
+            return a - b
+        if isinstance(node.op, ast.Mult):
+            return a * b
+        if isinstance(node.op, ast.FloorDiv) and b != 0:
+            return a // b
+        if isinstance(node.op, ast.Pow) and 0 <= b < 64:
+            return a ** b
+    return None
 
 
 def _jit_kwargs(call: ast.Call) -> Dict[str, object]:
@@ -330,13 +365,84 @@ class FileContext:
             message=message,
         )
 
+    def pragma(self, node: ast.AST, key: str) -> Optional[str]:
+        """The non-empty payload of a trailing ``# <key>: <reason>`` comment
+        on the node's first line, else None. The in-code analogue of a
+        baseline entry — the justification lives next to the code it
+        excuses (used by JX013's ``# unlocked:`` convention)."""
+        lineno = getattr(node, "lineno", 0)
+        if not (1 <= lineno <= len(self.lines)):
+            return None
+        line = self.lines[lineno - 1]
+        marker = "# %s:" % key
+        idx = line.find(marker)
+        if idx < 0:
+            return None
+        reason = line[idx + len(marker):].strip()
+        return reason or None
+
+    @property
+    def module_int_consts(self) -> Dict[str, int]:
+        """Module-level ``NAME = <int expr>`` bindings (FB = 8, LO = 8, ...)
+        so shape checks can resolve symbolic-but-constant dimensions."""
+        cached = getattr(self, "_module_int_consts", None)
+        if cached is not None:
+            return cached
+        out: Dict[str, int] = {}
+        for node in self.tree.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                v = const_int(node.value, out)
+                if v is not None:
+                    out[node.targets[0].id] = v
+        self._module_int_consts = out
+        return out
+
+
+#: fallback per-core VMEM budget when no CHIP_PEAKS table is in the scanned
+#: set — the Mosaic scoped-allocation ceiling every shipped TPU shares
+DEFAULT_VMEM_BYTES = 16 * 2 ** 20
+
 
 class ProjectContext:
-    """Cross-file facts: declared mesh axis names, the file set."""
+    """Cross-file facts: declared mesh axis names, VMEM budget, the file set."""
 
     def __init__(self, files: Sequence[FileContext]) -> None:
         self.files = list(files)
         self.declared_axes: FrozenSet[str] = self._collect_axes()
+        self.vmem_budget: int = self._collect_vmem_budget()
+
+    def _collect_vmem_budget(self) -> int:
+        """Smallest ``vmem_bytes`` declared in a ``CHIP_PEAKS`` table literal
+        (obs/costs.py's chip-detection table) anywhere in the scanned set —
+        a static kernel block must fit the tightest chip the project claims
+        to support. Falls back to :data:`DEFAULT_VMEM_BYTES`."""
+        budgets: List[int] = []
+        for ctx in self.files:
+            for node in ast.walk(ctx.tree):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "CHIP_PEAKS"
+                    and isinstance(node.value, ast.Dict)
+                ):
+                    continue
+                for chip_val in node.value.values:
+                    if not isinstance(chip_val, ast.Dict):
+                        continue
+                    for k, v in zip(chip_val.keys, chip_val.values):
+                        if (
+                            isinstance(k, ast.Constant)
+                            and k.value == "vmem_bytes"
+                        ):
+                            n = const_int(v, ctx.module_int_consts)
+                            if n is not None and n > 0:
+                                budgets.append(n)
+        return min(budgets) if budgets else DEFAULT_VMEM_BYTES
 
     def _collect_axes(self) -> FrozenSet[str]:
         axes: set = set()

@@ -15,6 +15,7 @@ from .engine import (
     FileContext,
     Finding,
     ProjectContext,
+    const_int,
     dotted_name,
     rule,
 )
@@ -650,3 +651,696 @@ def jx008_silent_swallow(ctx: FileContext, project: ProjectContext) -> Iterator[
                 % type_txt,
                 detail="except=%s" % type_txt,
             )
+
+
+# --------------------------------------------------------------------------
+# JX011 helpers: static model of a pl.pallas_call site
+# --------------------------------------------------------------------------
+def _last_attr(name: str) -> str:
+    return name.rsplit(".", 1)[-1] if name else ""
+
+
+def _is_blockspec(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _last_attr(
+        dotted_name(node.func)
+    ) == "BlockSpec"
+
+
+def _spec_list(node: Optional[ast.AST], is_leaf=None):
+    """BlockSpec expressions of an in_specs/out_specs kwarg: a literal
+    list/tuple, a single spec, or the ``[spec] * N`` replication idiom.
+    Returns None when the count cannot be known statically — including a
+    bare Call that is NOT itself a spec (``in_specs=build_specs(3)`` is a
+    helper returning an unknown number of specs, not one spec)."""
+    if node is None:
+        return None
+    if is_leaf is None:
+        is_leaf = _is_blockspec
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return list(node.elts)
+    if (
+        isinstance(node, ast.BinOp)
+        and isinstance(node.op, ast.Mult)
+        and isinstance(node.left, (ast.List, ast.Tuple))
+        and isinstance(node.right, ast.Constant)
+        and type(node.right.value) is int
+    ):
+        return list(node.left.elts) * node.right.value
+    if isinstance(node, ast.Call) and is_leaf(node):
+        return [node]  # a single bare BlockSpec(...) / ShapeDtypeStruct(...)
+    return None
+
+
+def _blockspec_parts(spec: ast.Call):
+    """(block_shape tuple node or None, index_map lambda node or None)."""
+    shape = spec.args[0] if spec.args else None
+    index_map = spec.args[1] if len(spec.args) > 1 else None
+    for kw in spec.keywords:
+        if kw.arg == "block_shape":
+            shape = kw.value
+        elif kw.arg == "index_map":
+            index_map = kw.value
+    if not isinstance(shape, (ast.Tuple, ast.List)):
+        shape = None
+    if not isinstance(index_map, ast.Lambda):
+        index_map = None
+    return shape, index_map
+
+
+def _is_sds(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and _last_attr(
+        dotted_name(node.func)
+    ) == "ShapeDtypeStruct"
+
+
+def _sds_list(node: Optional[ast.AST]):
+    """ShapeDtypeStruct expressions of an out_shape kwarg (same shapes of
+    spelling as _spec_list)."""
+    return _spec_list(node, is_leaf=_is_sds)
+
+
+def _sds_parts(sds: ast.Call):
+    """(shape tuple node or None, dtype expr or None) of a ShapeDtypeStruct."""
+    shape = sds.args[0] if sds.args else None
+    dtype = sds.args[1] if len(sds.args) > 1 else None
+    for kw in sds.keywords:
+        if kw.arg == "shape":
+            shape = kw.value
+        elif kw.arg == "dtype":
+            dtype = kw.value
+    if not isinstance(shape, (ast.Tuple, ast.List)):
+        shape = None
+    return shape, dtype
+
+
+def _resolve_kernel(ctx: FileContext, call: ast.Call):
+    """FunctionDef of the kernel a pallas_call dispatches, resolved through
+    the ``kernel = functools.partial(_body, ...)`` idiom. Innermost binding
+    in the call's enclosing-function chain wins."""
+    if not call.args:
+        return None
+
+    def fn_name_of(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+            if _last_attr(name) == "partial" and expr.args:
+                inner = dotted_name(expr.args[0])
+                return _last_attr(inner) if inner else None
+            return None
+        name = dotted_name(expr)
+        return _last_attr(name) if name else None
+
+    target = fn_name_of(call.args[0])
+    if target is None and isinstance(call.args[0], ast.Name):
+        target = call.args[0].id
+    if target is None:
+        return None
+    # follow one level of local rebinding: kernel = partial(_body, ...)
+    scopes = ctx.enclosing_functions(call) + [ctx.tree]
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == target
+            ):
+                resolved = fn_name_of(node.value)
+                if resolved is not None and resolved != target:
+                    target = resolved
+                break
+        else:
+            continue
+        break
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == target:
+            return node
+    return None
+
+
+@rule("JX011", "pallas kernel violates its grid/BlockSpec/VMEM contract")
+def jx011_pallas_hygiene(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """Static contract checks on every ``pl.pallas_call`` site — the
+    mistakes that otherwise surface as Mosaic lowering errors (or silent
+    garbage) on real TPU silicon only:
+
+      * an ``index_map`` lambda whose arity differs from the grid rank;
+      * an ``index_map`` returning a different number of block coordinates
+        than the BlockSpec's block_shape has dimensions;
+      * ``in_specs`` count != the number of operands the wrapped call is
+        invoked with;
+      * ``out_specs`` count != ``out_shape`` count, or an out BlockSpec
+        whose block rank differs from its ShapeDtypeStruct's rank;
+      * ``pl.program_id(axis)`` / ``pl.num_programs(axis)`` with a literal
+        axis outside the grid's rank (resolved through the
+        ``kernel = functools.partial(_body, ...)`` idiom);
+      * a ShapeDtypeStruct without an explicit dtype, or a kernel that
+        stores ``.astype(<dtype>)`` into an out ref whose declared
+        out_shape dtype differs;
+      * a fully-static block whose byte footprint (4 B/elem assumed when
+        the dtype is dynamic) exceeds the per-chip VMEM budget — the
+        smallest ``vmem_bytes`` in obs/costs.py's CHIP_PEAKS table, so the
+        tightest supported chip gates every kernel.
+
+    Dynamic shapes/specs are skipped, never guessed at.
+    """
+    budget = project.vmem_budget
+    consts = ctx.module_int_consts
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and _last_attr(dotted_name(node.func)) == "pallas_call"
+        ):
+            continue
+        kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+        # -- grid rank ----------------------------------------------------
+        grid_node = kwargs.get("grid")
+        grid_rank: Optional[int] = None
+        if grid_node is not None:
+            if isinstance(grid_node, (ast.Tuple, ast.List)):
+                grid_rank = len(grid_node.elts)
+            elif const_int(grid_node, consts) is not None:
+                grid_rank = 1
+
+        in_specs = _spec_list(kwargs.get("in_specs"))
+        out_specs = _spec_list(kwargs.get("out_specs"))
+        out_shape = _sds_list(kwargs.get("out_shape"))
+
+        # -- per-spec index_map/shape consistency -------------------------
+        for where, specs in (("in_specs", in_specs), ("out_specs", out_specs)):
+            for i, spec in enumerate(specs or ()):
+                if not _is_blockspec(spec):
+                    continue
+                shape, index_map = _blockspec_parts(spec)
+                if index_map is not None and grid_rank is not None:
+                    arity = len(index_map.args.args)
+                    if arity != grid_rank:
+                        yield ctx.finding(
+                            "JX011", spec,
+                            "%s[%d] index_map takes %d argument(s) but the "
+                            "grid has rank %d — every grid axis indexes "
+                            "every block" % (where, i, arity, grid_rank),
+                            detail="%s[%d]:index_map_arity" % (where, i),
+                        )
+                if (
+                    index_map is not None
+                    and shape is not None
+                    and isinstance(index_map.body, (ast.Tuple, ast.List))
+                    and len(index_map.body.elts) != len(shape.elts)
+                ):
+                    yield ctx.finding(
+                        "JX011", spec,
+                        "%s[%d] index_map returns %d block coordinate(s) for "
+                        "a %d-dimensional block_shape"
+                        % (where, i, len(index_map.body.elts), len(shape.elts)),
+                        detail="%s[%d]:index_map_rank" % (where, i),
+                    )
+                # -- VMEM budget on fully-static blocks -------------------
+                if shape is not None:
+                    dims = [const_int(d, consts) for d in shape.elts]
+                    if all(d is not None for d in dims):
+                        nbytes = 4  # f32 unless the spec says otherwise
+                        for d in dims:
+                            nbytes *= d
+                        if nbytes > budget:
+                            yield ctx.finding(
+                                "JX011", spec,
+                                "%s[%d] static block is %d bytes (f32), over "
+                                "the %d-byte per-core VMEM budget (smallest "
+                                "vmem_bytes in CHIP_PEAKS); tile the block "
+                                "or shrink the chunk" % (where, i, nbytes, budget),
+                                detail="%s[%d]:vmem" % (where, i),
+                            )
+
+        # -- in_specs count vs the immediate invocation -------------------
+        parent = ctx.parent(node)
+        if (
+            in_specs is not None
+            and isinstance(parent, ast.Call)
+            and parent.func is node
+            and not any(isinstance(a, ast.Starred) for a in parent.args)
+        ):
+            if len(parent.args) != len(in_specs):
+                yield ctx.finding(
+                    "JX011", node,
+                    "pallas_call declares %d in_specs but is invoked with "
+                    "%d operand(s)" % (len(in_specs), len(parent.args)),
+                    detail="in_specs_count",
+                )
+
+        # -- out_specs vs out_shape ---------------------------------------
+        if out_specs is not None and out_shape is not None:
+            if len(out_specs) != len(out_shape):
+                yield ctx.finding(
+                    "JX011", node,
+                    "pallas_call declares %d out_specs for %d out_shape "
+                    "entr%s" % (
+                        len(out_specs), len(out_shape),
+                        "y" if len(out_shape) == 1 else "ies",
+                    ),
+                    detail="out_specs_count",
+                )
+            else:
+                for i, (spec, sds) in enumerate(zip(out_specs, out_shape)):
+                    if not (_is_blockspec(spec) and _is_sds(sds)):
+                        continue
+                    bshape, _ = _blockspec_parts(spec)
+                    sshape, _ = _sds_parts(sds)
+                    if (
+                        bshape is not None
+                        and sshape is not None
+                        and len(bshape.elts) != len(sshape.elts)
+                    ):
+                        yield ctx.finding(
+                            "JX011", spec,
+                            "out_specs[%d] block has rank %d but its "
+                            "out_shape entry has rank %d"
+                            % (i, len(bshape.elts), len(sshape.elts)),
+                            detail="out[%d]:block_rank" % i,
+                        )
+
+        # -- out_shape dtype discipline -----------------------------------
+        out_dtypes: List[Optional[str]] = []
+        for i, sds in enumerate(out_shape or ()):
+            if not _is_sds(sds):
+                out_dtypes.append(None)
+                continue
+            _, dtype = _sds_parts(sds)
+            if dtype is None:
+                yield ctx.finding(
+                    "JX011", sds,
+                    "out_shape[%d] ShapeDtypeStruct has no explicit dtype; "
+                    "the accumulator dtype must be pinned, not inferred" % i,
+                    detail="out[%d]:dtype_missing" % i,
+                )
+                out_dtypes.append(None)
+            else:
+                name = dotted_name(dtype)
+                out_dtypes.append(_last_attr(name) if name else None)
+
+        # -- kernel-side checks: program_id range + stored dtype ----------
+        kernel = _resolve_kernel(ctx, node)
+        if kernel is None:
+            continue
+        if grid_node is None:
+            grid_rank = 0
+        a = kernel.args
+        params = [p.arg for p in a.posonlyargs + a.args]
+        n_out = len(out_shape) if out_shape is not None else None
+        # scratch refs trail the out refs in a pallas kernel signature:
+        # kernel(in..., out..., scratch...). A non-literal scratch_shapes
+        # makes the out-ref positions unknowable — skip the dtype check.
+        scratch_node = kwargs.get("scratch_shapes")
+        n_scratch: Optional[int] = 0
+        if scratch_node is not None:
+            if isinstance(scratch_node, (ast.List, ast.Tuple)):
+                n_scratch = len(scratch_node.elts)
+            else:
+                n_scratch = None
+        out_params = set()
+        if n_out and n_scratch is not None:
+            end = len(params) - n_scratch
+            out_params = set(params[end - n_out:end])
+        for sub in ast.walk(kernel):
+            if not isinstance(sub, ast.Call):
+                continue
+            attr = _last_attr(dotted_name(sub.func))
+            if (
+                attr in ("program_id", "num_programs")
+                and sub.args
+                and grid_rank is not None
+            ):
+                axis = const_int(sub.args[0], consts)
+                if axis is not None and not (0 <= axis < max(grid_rank, 0)):
+                    yield ctx.finding(
+                        "JX011", sub,
+                        "%s(%d) in kernel %r but the pallas_call grid has "
+                        "rank %d" % (attr, axis, kernel.name, grid_rank),
+                        detail="%s:program_id=%d" % (kernel.name, axis),
+                    )
+        if n_out == 1 and out_dtypes and out_dtypes[0] is not None:
+            declared = out_dtypes[0]
+            (out_param,) = out_params or (None,)
+            for sub in ast.walk(kernel):
+                if not (
+                    isinstance(sub, (ast.Assign, ast.AugAssign))
+                    and isinstance(
+                        tgt := (
+                            sub.targets[0]
+                            if isinstance(sub, ast.Assign) and sub.targets
+                            else getattr(sub, "target", None)
+                        ),
+                        ast.Subscript,
+                    )
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == out_param
+                ):
+                    continue
+                v = sub.value
+                if (
+                    isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr == "astype"
+                    and v.args
+                ):
+                    stored = _last_attr(dotted_name(v.args[0]))
+                    if stored and stored != declared:
+                        yield ctx.finding(
+                            "JX011", sub,
+                            "kernel %r stores .astype(%s) into out ref %r "
+                            "declared %s in out_shape — the write will be "
+                            "recast" % (kernel.name, stored, out_param, declared),
+                            detail="%s:store_dtype" % kernel.name,
+                        )
+
+
+# --------------------------------------------------------------------------
+# JX012: float-exactness hazards on score/carry paths
+# --------------------------------------------------------------------------
+_SCORE_RE = re.compile(r"(^|_)(scores?\w*|carry|carries)($|_)")
+
+_PR8_CITE = (
+    "(PR 8: XLA CPU loop fusion FMA-contracted the shrink-multiply into the "
+    "score add in one program but not the other — a 1-ulp drift found only "
+    "by hand)"
+)
+
+_LOCAL_REDUCERS = {"sum", "mean", "dot", "matmul", "einsum", "tensordot"}
+
+
+def _names_in(node: ast.AST) -> Iterator[str]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr
+
+
+def _has_inline_mult_add(node: ast.AST) -> Optional[ast.AST]:
+    """The first Add BinOp one of whose direct operands is a Mult — the
+    shape LLVM contracts into an FMA when XLA fuses the two."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Add):
+            for side in (sub.left, sub.right):
+                if isinstance(side, ast.BinOp) and isinstance(side.op, ast.Mult):
+                    return sub
+    return None
+
+
+def _subscript_base_name(node: ast.AST) -> Optional[str]:
+    """'scores' for scores[...], scores.at[...], self.scores.at[...]."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        if isinstance(node, ast.Attribute):
+            if node.attr not in ("at",):
+                return node.attr
+            node = node.value
+        else:
+            node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+@rule("JX012", "float-exactness hazard on a score/carry path")
+def jx012_float_exactness(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """Three hazards that break the bitwise-identity contracts the chunked /
+    sharded / segmented trainers are proven against, scoped to ``ops/`` and
+    ``models/`` jit code:
+
+      * an inline multiply feeding an add on a score/carry assignment
+        (``scores = scores + leaf * rate``, ``scores.at[k].add(v * rate)``)
+        — whether XLA's fusion hands LLVM the contractible pattern depends
+        on the surrounding program, so two program shapes computing the
+        same math can drift by 1 ulp (the PR 8 find); materialize the
+        product as its own value (or a program output) first;
+      * ``jax.lax.optimization_barrier`` used as a fusion fence — it is
+        stripped before XLA's fusion pass (measured, PR 8) and guarantees
+        nothing about contraction; pin exactness by materializing the value
+        as a program output instead;
+      * a local f32 reduction nested directly inside a cross-shard
+        collective (``psum(x.sum(...), axis)``) — the accumulation grouping
+        then depends on the shard count, so results vary across mesh sizes;
+        reduce into a shard-invariant layout first or document the
+        tolerance at the call site.
+    """
+    if not any(
+        seg in ("ops", "models") for seg in ctx.rel_path.split("/")[:-1]
+    ):
+        return
+    for node in ast.walk(ctx.tree):
+        # (b) optimization_barrier anywhere in these packages
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            attr = _last_attr(fname)
+            if attr == "optimization_barrier":
+                yield ctx.finding(
+                    "JX012", node,
+                    "optimization_barrier is stripped before XLA fusion and "
+                    "does NOT prevent FMA contraction %s; materialize the "
+                    "value as a program output instead" % _PR8_CITE,
+                    detail="optimization_barrier",
+                )
+                continue
+            # (c) psum/pmean of a directly-nested local reduction
+            if attr in ("psum", "pmean") and node.args:
+                operand = node.args[0]
+                if (
+                    isinstance(operand, ast.Call)
+                    and _last_attr(dotted_name(operand.func)) in _LOCAL_REDUCERS
+                ):
+                    yield ctx.finding(
+                        "JX012", node,
+                        "%s of an inline %s: the f32 accumulation grouping "
+                        "(local partials, then the collective tree) changes "
+                        "with the shard count, so results differ across "
+                        "mesh sizes; hoist the local reduction and prove "
+                        "(or document) shard-invariance at the call site"
+                        % (attr, _last_attr(dotted_name(operand.func))),
+                        detail="%s_of_reduction" % attr,
+                    )
+                continue
+        # (a) inline multiply-add on a score/carry assignment, jit code only
+        if not isinstance(node, (ast.Assign, ast.AugAssign)):
+            continue
+        if ctx.enclosing_jit(node) is None:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        names: List[str] = []
+        for t in targets:
+            names.extend(_names_in(t))
+        if not any(_SCORE_RE.search(n) for n in names):
+            continue
+        hit = None
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add):
+            v = node.value
+            if isinstance(v, ast.BinOp) and isinstance(v.op, ast.Mult):
+                hit = v
+        if hit is None:
+            hit = _has_inline_mult_add(node.value)
+        if hit is None and isinstance(node.value, ast.Call):
+            f = node.value.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr == "add"
+                and node.value.args
+            ):
+                arg = node.value.args[0]
+                if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Mult):
+                    base = _subscript_base_name(f.value)
+                    if base is not None and _SCORE_RE.search(base):
+                        hit = arg
+        if hit is not None:
+            yield ctx.finding(
+                "JX012", node,
+                "inline multiply feeding the add on a score/carry path: "
+                "whether LLVM contracts this into an FMA depends on how XLA "
+                "fuses the surrounding program %s; bind the product to its "
+                "own value (or materialize it as a program output) so every "
+                "program shape performs the identical plain add" % _PR8_CITE,
+                detail=ctx.detail_for(hit),
+            )
+
+
+# --------------------------------------------------------------------------
+# JX013: lock discipline in the threaded serve/obs stack
+# --------------------------------------------------------------------------
+_LOCK_FACTORIES = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore", "make_lock",
+}
+_THREADED_DIRS = ("serve", "obs")
+_HOLDS_RE = re.compile(
+    r"caller[s]? .{0,40}hold|holds? (the )?_?\w*lock|lock (is )?held", re.I
+)
+
+
+def _lock_attrs_of(cls: ast.ClassDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Attribute)
+            and isinstance(node.targets[0].value, ast.Name)
+            and node.targets[0].value.id == "self"
+            and isinstance(node.value, ast.Call)
+        ):
+            continue
+        if _last_attr(dotted_name(node.value.func)) in _LOCK_FACTORIES:
+            out.add(node.targets[0].attr)
+    return out
+
+
+def _lock_order_of(ctx: FileContext, cls: ast.ClassDef) -> List[str]:
+    """Declared acquisition order: a ``_LOCK_ORDER = ("_a", "_b")`` tuple at
+    class or module level (outermost first)."""
+    for scope in (cls, ctx.tree):
+        for node in scope.body:
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_LOCK_ORDER"
+            ):
+                from .engine import _str_elems
+
+                return _str_elems(node.value)
+    return []
+
+
+def _self_lock_attr(expr: ast.AST, lock_attrs: Set[str]) -> Optional[str]:
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+        and expr.attr in lock_attrs
+    ):
+        return expr.attr
+    return None
+
+
+@rule("JX013", "shared state mutated outside the owning lock")
+def jx013_lock_discipline(ctx: FileContext, project: ProjectContext) -> Iterator[Finding]:
+    """In the multi-threaded ``serve/`` and ``obs/`` packages, a class that
+    owns a lock (``self._lock = threading.Lock()`` — or obs/sanitize.py's
+    ``make_lock``) declares that its ``self._*`` attributes are shared
+    state. Two violations:
+
+      * rebinding / item-assigning such an attribute outside a
+        ``with self._<lock>:`` block — a hot-swap, scrape or drain racing
+        the mutation sees torn state. Methods documented "caller holds
+        _lock" are exempt, and a deliberately lock-free site carries a
+        trailing ``# unlocked: <why>`` comment (single-writer GIL-atomic
+        rebinds, init-once);
+      * acquiring a second ``self`` lock while holding another without a
+        ``_LOCK_ORDER = ("_outer", "_inner")`` declaration at class/module
+        level — undeclared nesting is how lock-order inversions (and the
+        deadlocks the runtime sanitizer's lock mode hunts) get written.
+    """
+    if not any(seg in _THREADED_DIRS for seg in ctx.rel_path.split("/")[:-1]):
+        return
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        lock_attrs = _lock_attrs_of(cls)
+        if not lock_attrs:
+            continue
+        order = _lock_order_of(ctx, cls)
+
+        def enclosing_locks(node: ast.AST) -> List[str]:
+            """Lock attrs held at ``node``, outermost first."""
+            chain: List[str] = []
+            cur = ctx.parent(node)
+            while cur is not None and cur is not cls:
+                if isinstance(cur, ast.With):
+                    for item in cur.items:
+                        attr = _self_lock_attr(item.context_expr, lock_attrs)
+                        if attr is not None:
+                            chain.append(attr)
+                cur = ctx.parent(cur)
+            chain.reverse()
+            return chain
+
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name in ("__init__", "__del__", "__new__"):
+                continue
+            doc = ast.get_docstring(method) or ""
+            if _HOLDS_RE.search(doc):
+                continue
+            for node in ast.walk(method):
+                # -- nested acquisition without a declared order ----------
+                if isinstance(node, ast.With):
+                    for item in node.items:
+                        inner = _self_lock_attr(item.context_expr, lock_attrs)
+                        if inner is None:
+                            continue
+                        held = [a for a in enclosing_locks(node) if a != inner]
+                        for outer in held:
+                            ok = (
+                                outer in order
+                                and inner in order
+                                and order.index(outer) < order.index(inner)
+                            )
+                            if not ok and ctx.pragma(node, "unlocked") is None:
+                                yield ctx.finding(
+                                    "JX013", node,
+                                    "acquires self.%s while holding self.%s "
+                                    "with no _LOCK_ORDER declaring that "
+                                    "nesting; an undeclared order is how "
+                                    "inversion deadlocks get written — "
+                                    "declare _LOCK_ORDER = (%r, %r) (and "
+                                    "keep every site consistent) or drop "
+                                    "the nesting" % (inner, outer, outer, inner),
+                                    detail="nest=%s>%s" % (outer, inner),
+                                )
+                    continue
+                # -- unguarded mutation of self._* ------------------------
+                attr: Optional[str] = None
+                if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    if isinstance(node, ast.AnnAssign) and node.value is None:
+                        continue
+                    for t in targets:
+                        if isinstance(t, ast.Subscript):
+                            t = t.value
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr.startswith("_")
+                            and t.attr not in lock_attrs
+                        ):
+                            attr = t.attr
+                            break
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        if isinstance(t, ast.Subscript):
+                            t = t.value
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                            and t.attr.startswith("_")
+                        ):
+                            attr = t.attr
+                            break
+                if attr is None:
+                    continue
+                if enclosing_locks(node):
+                    continue
+                if ctx.pragma(node, "unlocked") is not None:
+                    continue
+                yield ctx.finding(
+                    "JX013", node,
+                    "mutates shared attribute self.%s outside any "
+                    "`with self.<lock>:` block in a lock-owning class; "
+                    "guard it, document the method \"caller holds _lock\", "
+                    "or justify in place with a trailing "
+                    "`# unlocked: <why>`" % attr,
+                    detail="attr=%s" % attr,
+                )
