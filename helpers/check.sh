@@ -51,6 +51,18 @@
 #                               # predict + hot-swap + drain + drift +
 #                               # /metrics scrape under
 #                               # LIGHTGBM_TPU_SAN=transfer,nan,locks)
+#   helpers/check.sh --loop     # lint gate, then the continuous-training
+#                               # smoke: real serve stack — drift-shifted
+#                               # traffic raises a PSI alert, the loop
+#                               # controller observes it over HTTP,
+#                               # retrains warm-started from the live
+#                               # model, gates on AUC, publishes through
+#                               # resil/atomic and hot-swaps the replica
+#                               # (new version answers /predict with
+#                               # lineage, drift sidecar refreshed), plus
+#                               # one seeded mid-publish SIGKILL recovered
+#                               # from the journal — under the full
+#                               # runtime sanitizer
 #   helpers/check.sh --bench-diff [CUR BASE]
 #                               # the bench regression gate: golden-fixture
 #                               # self-test (synthetic regression must FAIL,
@@ -69,9 +81,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -148,6 +160,11 @@ if [ "$MODE" = "--san" ]; then
         -p no:cacheprovider || exit 1
     echo "== graftsan concurrency stress smoke (predict+swap+drain+drift+scrape) =="
     exec env JAX_PLATFORMS=cpu python helpers/san_smoke.py
+fi
+
+if [ "$MODE" = "--loop" ]; then
+    echo "== loop smoke (drift -> retrain -> validate -> publish -> swap + SIGKILL recovery) =="
+    exec env JAX_PLATFORMS=cpu python helpers/loop_smoke.py
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

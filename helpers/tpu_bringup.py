@@ -89,6 +89,9 @@ STAGE_TIMEOUTS = {
     "prof": 1800,   # segment-profiled mini-train (obs/prof.py, ISSUE 6)
     "san": 1800,    # graftsan stress smoke under full instrumentation
                     # (obs/sanitize.py, ISSUE 11)
+    "loop": 1800,   # continuous-training loop smoke: drift -> retrain ->
+                    # validate -> publish -> swap + mid-publish SIGKILL
+                    # recovery on the real serve stack (loop/, ISSUE 12)
     "bench": 3600,
 }
 
@@ -712,6 +715,18 @@ def run_san(stage: str = "san") -> dict:
     )
 
 
+def run_loop(stage: str = "loop") -> dict:
+    """Continuous-training closed-loop smoke (helpers/loop_smoke.py,
+    ISSUE 12) — executed by FILE path in a child process (the child arms
+    its own sanitizer env), so the driver stays jax-free. On silicon this
+    proves the drift -> warm-start retrain -> gate -> atomic publish ->
+    hot-swap cycle, and its mid-publish SIGKILL recovery, hold on the real
+    backend, not just the CPU CI box."""
+    return _run_child(
+        stage, [sys.executable, os.path.join(REPO, "helpers", "loop_smoke.py")]
+    )
+
+
 def run_bench(stage: str = "bench") -> dict:
     env = dict(os.environ)
     env.pop("BENCH_FORCE_PLATFORMS", None)
@@ -851,6 +866,10 @@ def main() -> int:
                        # predict + hot-swap + drain + drift + scrape under
                        # LIGHTGBM_TPU_SAN=transfer,nan,locks (ISSUE 11)
                        ("san", "SAN"),
+                       # closed-loop continuous training: drift-triggered
+                       # warm-start retrain -> gate -> publish -> swap with
+                       # SIGKILL recovery on the real stack (ISSUE 12)
+                       ("loop", "LOOP"),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         with _stage_span(stage):
@@ -858,6 +877,8 @@ def main() -> int:
                 runner = lambda s=stage: run_multichip(s)  # noqa: E731
             elif src == "SAN":
                 runner = lambda s=stage: run_san(s)  # noqa: E731
+            elif src == "LOOP":
+                runner = lambda s=stage: run_loop(s)  # noqa: E731
             elif src is None:
                 runner = lambda s=stage: run_bench(s)  # noqa: E731
             else:

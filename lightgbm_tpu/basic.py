@@ -278,8 +278,16 @@ class Dataset:
         ):
             if X.shape[1] < num_features:
                 X = np.pad(X, ((0, 0), (0, num_features - X.shape[1])))
-            raw = self._predictor.predict_raw(X)
-            parts.append(raw.T if raw.ndim == 2 else raw)
+            # per-row accumulation is row-independent, so the chunked f32
+            # replay concatenates to exactly the whole-matrix replay
+            ws = self._predictor.warmstart_scores(X)
+            if ws is not None:
+                parts.append(
+                    (ws if ws.shape[0] > 1 else ws[0]).astype(np.float64)
+                )
+            else:
+                raw = self._predictor.predict_raw(X)
+                parts.append(raw.T if raw.ndim == 2 else raw)
         scores = np.concatenate(parts, axis=-1)
         if scores.ndim == 2:
             return scores.reshape(-1)  # class-major flatten
@@ -288,6 +296,14 @@ class Dataset:
     def _predictor_raw_scores(self, data: np.ndarray) -> np.ndarray:
         if hasattr(data, "toarray"):  # continued training on sparse input
             data = data.toarray()
+        ws = self._predictor.warmstart_scores(data)
+        if ws is not None:
+            # per-tree f32 replay (models/gbdt.py warmstart_scores): these
+            # f64 values are EXACT f32s, so the trainer's f32 init-score
+            # cast recovers the parent run's score carry bit for bit — the
+            # warm-start bedrock continued training rests on
+            K = ws.shape[0]
+            return (ws.reshape(-1) if K > 1 else ws[0]).astype(np.float64)
         raw = self._predictor.predict_raw(data)
         if raw.ndim == 2:
             return raw.T.reshape(-1)  # class-major flatten

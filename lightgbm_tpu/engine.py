@@ -222,11 +222,30 @@ def train(
     # path, nested run) silently leaves recording off.
     flight_rec = None
     if flight_path:
+        parent_fp = None
+        if predictor is not None:
+            # lineage edge for the manifest: the warm-start parent's
+            # fingerprint — the FILE's bytes when init_model was a path
+            # (matching the serve registry's file_sha), else the live
+            # booster's bare model-text fingerprint
+            from .models.model_text import model_fingerprint
+
+            try:
+                if isinstance(init_model, str):
+                    from .utils.vfile import vopen
+
+                    with vopen(init_model) as fh:
+                        parent_fp = model_fingerprint(fh.read())
+                else:
+                    parent_fp = model_fingerprint(predictor.model_to_string())
+            except Exception as e:  # lineage must never fail the run
+                log.debug("flight: parent fingerprint failed: %r" % (e,))
         flight_rec = flight_mod.start(
             flight_path,
             flight_mod.build_manifest(
                 booster, num_boost_round, init_iteration,
                 resume_from=resume_from, checkpoint_path=checkpoint_path,
+                parent_fingerprint=parent_fp,
             ),
         )
 

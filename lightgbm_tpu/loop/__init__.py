@@ -1,0 +1,33 @@
+"""Closed-loop continuous training (docs/ContinuousTraining.md).
+
+One controller closes the production loop the rest of the package provides
+the pieces for: the serve drift monitor detects distribution shift, boosting
+warm-starts bit-exactly from the live published model, a holdout gate
+compares candidate vs serving, resil/atomic publishes, the serve registry
+hot-swaps every replica (drift sidecar refreshed per load), and a settle
+watch rolls back to the previous published version on regression — with a
+journaled state machine (loop/state.py) that survives SIGKILL at any point.
+
+    python -m lightgbm_tpu.loop --model live.txt --workdir loopdir \\
+        --data train.tsv --holdout holdout.tsv --params params.json \\
+        --rounds 30 --replica http://127.0.0.1:8080 \\
+        --drift-url http://127.0.0.1:8080
+"""
+from .controller import (  # noqa: F401
+    AppDriftSource,
+    AppReplica,
+    HttpDriftSource,
+    HttpReplica,
+    LINEAGE_SUFFIX,
+    LoopConfig,
+    LoopController,
+    gate_metric,
+    lineage_path,
+    load_lineage,
+)
+from .state import (  # noqa: F401
+    LoopJournal,
+    LoopStateError,
+    OUTCOMES,
+    STATES,
+)

@@ -1,0 +1,137 @@
+"""``python -m lightgbm_tpu.loop`` — run the continuous-training controller.
+
+    python -m lightgbm_tpu.loop --model live.txt --workdir loopdir \\
+        --data train.tsv --holdout holdout.tsv \\
+        --params params.json --rounds 30 \\
+        --replica http://127.0.0.1:8080 --drift-url http://127.0.0.1:8080
+
+``--data`` / ``--holdout`` are whitespace-separated numeric text files with
+the label in column 0, RE-READ at every cycle — the operator (or a feed
+job) replaces them as fresh data arrives. The controller journals every
+state transition to ``<workdir>/loop_journal.json``; re-running the same
+command after ANY crash resumes the loop at the journaled step
+(docs/ContinuousTraining.md). ``--once --force`` runs exactly one
+operator-initiated cycle without waiting for a drift trigger.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+from ..utils import log
+from .controller import (
+    HttpDriftSource,
+    HttpReplica,
+    LoopConfig,
+    LoopController,
+)
+
+
+class FileDataProvider:
+    """Label-in-column-0 text files, re-read per cycle. Deterministic for a
+    GIVEN file content — the operator contract is that the files only
+    change BETWEEN cycles (the journal's retrain checkpoint makes a
+    mid-cycle swap a loud config-digest warning, not silent drift)."""
+
+    def __init__(self, data_path: str, holdout_path: str):
+        self.data_path = data_path
+        self.holdout_path = holdout_path
+
+    def __call__(self, cycle: int):
+        tr = np.loadtxt(self.data_path, dtype=np.float64, ndmin=2)
+        ho = np.loadtxt(self.holdout_path, dtype=np.float64, ndmin=2)
+        return tr[:, 1:], tr[:, 0], ho[:, 1:], ho[:, 0]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.loop",
+        description="drift-triggered retrain -> validate -> publish -> "
+                    "hot-swap controller (preemption-safe)",
+    )
+    p.add_argument("--model", required=True,
+                   help="the LIVE published model file (created on first "
+                        "run when missing)")
+    p.add_argument("--workdir", required=True,
+                   help="journal + per-cycle artifacts directory")
+    p.add_argument("--data", required=True,
+                   help="training data file (label in column 0), re-read "
+                        "per cycle")
+    p.add_argument("--holdout", required=True,
+                   help="validation-gate holdout file (label in column 0)")
+    p.add_argument("--params", required=True,
+                   help="JSON file (or inline JSON object) of training "
+                        "params")
+    p.add_argument("--rounds", type=int, default=50,
+                   help="boosting iterations per retrain")
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="URL", help="serve replica base URL (repeat)")
+    p.add_argument("--drift-url", default=None,
+                   help="serve base URL whose /drift endpoint triggers "
+                        "retrains; omitted = every cycle is unconditional")
+    p.add_argument("--margin", type=float, default=0.0,
+                   help="validation gate margin (candidate may be at most "
+                        "this much worse than serving)")
+    p.add_argument("--rollback-margin", type=float, default=0.0,
+                   help="settle regression margin before rollback")
+    p.add_argument("--poll-s", type=float, default=30.0,
+                   help="drift poll cadence (seeded-jitterable)")
+    p.add_argument("--observe-budget-s", type=float, default=3600.0,
+                   help="max wait per observe pass before returning idle")
+    p.add_argument("--jitter-seed", type=int, default=None,
+                   help="seed for the poll jitter (reproducible schedules)")
+    p.add_argument("--once", action="store_true",
+                   help="run one cycle (or one observe pass) and exit")
+    p.add_argument("--max-cycles", type=int, default=None,
+                   help="exit after this many completed cycles")
+    p.add_argument("--force", action="store_true",
+                   help="skip the drift wait (operator-initiated retrain)")
+    p.add_argument("--no-warm-start", action="store_true",
+                   help="retrain from scratch instead of init_model "
+                        "continuation")
+    return p
+
+
+def _load_params(spec: str) -> dict:
+    s = spec.strip()
+    if s.startswith("{"):
+        return json.loads(s)
+    with open(spec, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    cfg = LoopConfig(
+        model_path=args.model,
+        workdir=args.workdir,
+        params=_load_params(args.params),
+        num_boost_round=args.rounds,
+        data_provider=FileDataProvider(args.data, args.holdout),
+        replicas=[HttpReplica(u) for u in args.replica],
+        drift_source=(
+            HttpDriftSource(args.drift_url) if args.drift_url else None
+        ),
+        validation_margin=args.margin,
+        rollback_margin=args.rollback_margin,
+        poll_interval_s=args.poll_s,
+        observe_budget_s=args.observe_budget_s,
+        jitter_seed=args.jitter_seed,
+        warm_start=not args.no_warm_start,
+    )
+    ctl = LoopController(cfg)
+    if ctl.ensure_bootstrap() and cfg.replicas:
+        ctl._swap_all(ctl._file_sha(cfg.model_path))
+    if args.once:
+        out = ctl.run_cycle(force=args.force)
+        log.info("loop: cycle outcome: %s" % out)
+        return 0
+    ctl.run_forever(max_cycles=args.max_cycles)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

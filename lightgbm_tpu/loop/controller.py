@@ -1,0 +1,807 @@
+"""The closed-loop continuous-training controller.
+
+Wires the repo's islands into one production loop (ROADMAP item 5): the
+serve stack's drift signal triggers a retrain that WARM-STARTS from the live
+published model (``engine.train(init_model=...)`` — bit-exact continuation,
+tests/test_warmstart.py), the candidate is gated against the serving model
+on a holdout, published through resil/atomic, hot-swapped into every serve
+replica through the registry's existing swap path (each load rebuilds the
+drift monitor against the new model's lattice + sidecar — the drift-sidecar
+refresh), then watched through a settle window with an automatic rollback to
+the previous published version on regression.
+
+Preemption safety: every step entry is journaled atomically
+(loop/state.py), every step is IDEMPOTENT given its journaled inputs, and
+every arrow carries a resil/faults.py site (``loop.observe`` /
+``loop.retrain`` / ``loop.validate`` / ``loop.publish`` / ``loop.swap``), so
+the kill-anywhere suite SIGKILLs a real controller at each one and proves
+the restarted loop converges: the live model file is always either the old
+or the fully-validated new version (atomic publish), and the rollback
+pointer is durable before the live file is ever touched.
+
+Library use::
+
+    cfg = LoopConfig(model_path=..., workdir=..., params={...},
+                     num_boost_round=30, data_provider=my_provider,
+                     replicas=[HttpReplica("http://127.0.0.1:8080")],
+                     drift_source=HttpDriftSource("http://127.0.0.1:8080"))
+    LoopController(cfg).run_cycle(force=True)
+
+``python -m lightgbm_tpu.loop`` wraps this for file-fed operation
+(docs/ContinuousTraining.md).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.model_text import model_fingerprint
+from ..obs import flight as flight_mod
+from ..obs import registry as obs_registry
+from ..obs import trace as trace_mod
+from ..resil import backoff, faults
+from ..resil.atomic import atomic_write_text
+from ..utils import log
+from ..utils.log import LightGBMError
+from .state import LoopJournal
+
+#: suffix of the lineage sidecar published next to every live model file —
+#: parent fingerprint + flight-manifest digest, fingerprint-checked by the
+#: serve registry like the drift sidecar (serve/server.py)
+LINEAGE_SUFFIX = ".lineage.json"
+LINEAGE_VERSION = 1
+#: retained previous-version copy (the rollback target) next to the live file
+PREV_SUFFIX = ".prev"
+
+FAULT_OBSERVE = "loop.observe"
+FAULT_RETRAIN = "loop.retrain"
+FAULT_VALIDATE = "loop.validate"
+FAULT_PUBLISH = "loop.publish"
+FAULT_SWAP = "loop.swap"
+
+
+def lineage_path(model_path: str) -> str:
+    return model_path + LINEAGE_SUFFIX
+
+
+def load_lineage(model_path: str, file_sha: str) -> Optional[Dict]:
+    """Read + fingerprint-check the lineage sidecar next to ``model_path``;
+    None when absent or written for different bytes (a stale sidecar must
+    not attribute one model's lineage to another)."""
+    try:
+        with open(lineage_path(model_path), encoding="utf-8") as fh:
+            body = json.load(fh)
+    except OSError:
+        return None
+    except ValueError:
+        log.warning("loop: lineage sidecar for %r is not valid JSON; ignored"
+                    % model_path)
+        return None
+    if body.get("fingerprint") != file_sha:
+        log.warning(
+            "loop: lineage sidecar for %r was written for different model "
+            "bytes (fingerprint mismatch); ignored" % model_path
+        )
+        return None
+    return body
+
+
+# ---------------------------------------------------------------------------
+# drift sources
+# ---------------------------------------------------------------------------
+
+class HttpDriftSource:
+    """Polls a serve replica's ``/drift`` endpoint (serve/drift.py). The
+    trigger is any feature in alert state on any model."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def poll(self) -> Tuple[bool, Dict]:
+        with urllib.request.urlopen(
+            self.base_url + "/drift", timeout=self.timeout_s
+        ) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+        alerts: List[Dict] = []
+        for model, snap in (body.get("models") or {}).items():
+            for feat in snap.get("alerts") or []:
+                alerts.append({"model": model, "feature": feat})
+        return bool(alerts), {"source": self.base_url + "/drift",
+                              "alerts": alerts}
+
+
+class AppDriftSource:
+    """In-process twin of :class:`HttpDriftSource` over a live ServeApp
+    (tests, single-process deployments)."""
+
+    def __init__(self, app):
+        self.app = app
+
+    def poll(self) -> Tuple[bool, Dict]:
+        body = self.app.drift_snapshot()
+        alerts: List[Dict] = []
+        for model, snap in (body.get("models") or {}).items():
+            for feat in snap.get("alerts") or []:
+                alerts.append({"model": model, "feature": feat})
+        return bool(alerts), {"source": "in-process", "alerts": alerts}
+
+
+# ---------------------------------------------------------------------------
+# swap targets (replicas)
+# ---------------------------------------------------------------------------
+
+class HttpReplica:
+    """One serve process reached over HTTP: hot-swap via the existing
+    ``POST /models`` path, verify via ``GET /models``."""
+
+    def __init__(self, base_url: str, timeout_s: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def __repr__(self) -> str:
+        return "HttpReplica(%s)" % self.base_url
+
+    def swap(self, name: str, path: str) -> Dict:
+        req = urllib.request.Request(
+            self.base_url + "/models",
+            data=json.dumps({"name": name, "path": path}).encode("utf-8"),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))["loaded"]
+
+    def served_fingerprint(self, name: str) -> Optional[str]:
+        with urllib.request.urlopen(
+            self.base_url + "/models", timeout=self.timeout_s
+        ) as resp:
+            body = json.loads(resp.read().decode("utf-8"))
+        for info in body.get("models", []):
+            if info.get("name") == name:
+                return str(info.get("file_sha"))
+        return None
+
+
+class AppReplica:
+    """In-process twin of :class:`HttpReplica` over a ModelRegistry (or a
+    ServeApp, whose registry is used)."""
+
+    def __init__(self, app_or_registry):
+        self.registry = getattr(app_or_registry, "registry", app_or_registry)
+
+    def __repr__(self) -> str:
+        return "AppReplica(%s)" % type(self.registry).__name__
+
+    def swap(self, name: str, path: str) -> Dict:
+        return self.registry.load(name, path).info()
+
+    def served_fingerprint(self, name: str) -> Optional[str]:
+        for info in self.registry.list():
+            if info.get("name") == name:
+                return str(info.get("file_sha"))
+        return None
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+class LoopConfig:
+    """Everything one controller needs. ``data_provider(cycle)`` returns
+    ``(X, y, X_holdout, y_holdout)`` — it MUST be deterministic per cycle
+    (same cycle number -> same arrays), because a controller killed mid-
+    retrain re-runs the cycle's training from its checkpoint or from
+    scratch and both must see the data the first attempt saw."""
+
+    def __init__(
+        self,
+        model_path: str,
+        workdir: str,
+        params: Dict,
+        num_boost_round: int,
+        data_provider: Callable[[int], Tuple],
+        replicas: Sequence = (),
+        drift_source=None,
+        model_name: Optional[str] = None,
+        validation_margin: float = 0.0,
+        rollback_margin: float = 0.0,
+        settle_fn: Optional[Callable[["LoopController", Dict], bool]] = None,
+        poll_interval_s: float = 5.0,
+        observe_budget_s: float = 300.0,
+        jitter_seed: Optional[int] = None,
+        checkpoint_rounds: int = 0,
+        warm_start: bool = True,
+        keep_cycles: int = 3,
+    ):
+        self.model_path = str(model_path)
+        self.workdir = str(workdir)
+        self.params = dict(params)
+        self.num_boost_round = int(num_boost_round)
+        self.data_provider = data_provider
+        self.replicas = list(replicas)
+        self.drift_source = drift_source
+        self.model_name = model_name or (
+            os.path.splitext(os.path.basename(model_path))[0] or "model"
+        )
+        self.validation_margin = float(validation_margin)
+        self.rollback_margin = float(rollback_margin)
+        self.settle_fn = settle_fn
+        self.poll_interval_s = float(poll_interval_s)
+        self.observe_budget_s = float(observe_budget_s)
+        self.jitter_seed = jitter_seed
+        self.checkpoint_rounds = int(checkpoint_rounds)
+        self.warm_start = bool(warm_start)
+        self.keep_cycles = int(keep_cycles)
+        self.journal_path = os.path.join(workdir, "loop_journal.json")
+
+
+# ---------------------------------------------------------------------------
+# validation metrics (host-side numpy; bigger_is_better flagged)
+# ---------------------------------------------------------------------------
+
+def _auc(y: np.ndarray, score: np.ndarray) -> float:
+    """Rank AUC (ties averaged) — the binary gate metric. O(N log N):
+    tied ranks are averaged per run of equal sorted scores, not by
+    scanning a mask per unique value (continuous GBDT scores make that
+    effectively quadratic on a real holdout)."""
+    y = np.asarray(y, np.float64).reshape(-1)
+    s = np.asarray(score, np.float64).reshape(-1)
+    n = len(s)
+    order = np.argsort(s, kind="mergesort")
+    ss = s[order]
+    starts = np.flatnonzero(np.r_[True, ss[1:] != ss[:-1]])
+    ends = np.r_[starts[1:], n]
+    # mean of ranks (starts+1 .. ends), repeated over each tie run
+    ranks = np.empty(n, np.float64)
+    ranks[order] = np.repeat((starts + 1 + ends) / 2.0, ends - starts)
+    pos = y > 0
+    np_, nn = int(pos.sum()), int((~pos).sum())
+    if np_ == 0 or nn == 0:
+        return 1.0
+    return float((ranks[pos].sum() - np_ * (np_ + 1) / 2.0) / (np_ * nn))
+
+
+def _logloss(y: np.ndarray, prob: np.ndarray) -> float:
+    y = np.asarray(y, np.int64).reshape(-1)
+    p = np.asarray(prob, np.float64)
+    eps = 1e-15
+    if p.ndim == 1:  # binary
+        p = np.clip(p, eps, 1 - eps)
+        return float(-np.mean(np.where(y > 0, np.log(p), np.log(1 - p))))
+    p = np.clip(p[np.arange(len(y)), y], eps, 1.0)
+    return float(-np.mean(np.log(p)))
+
+
+def _l2(y: np.ndarray, pred: np.ndarray) -> float:
+    d = np.asarray(y, np.float64).reshape(-1) - np.asarray(
+        pred, np.float64
+    ).reshape(-1)
+    return float(np.mean(d * d))
+
+
+def gate_metric(objective: str):
+    """(name, fn(y, prediction) -> value, bigger_is_better) for the
+    validation gate, by objective family."""
+    obj = str(objective or "").split(" ")[0]
+    if obj == "binary":
+        return "auc", _auc, True
+    if obj.startswith("multiclass") or obj in ("softmax", "multiclassova"):
+        return "multi_logloss", _logloss, False
+    return "l2", _l2, False
+
+
+# ---------------------------------------------------------------------------
+# the controller
+# ---------------------------------------------------------------------------
+
+class LoopController:
+    """Drives one journaled loop over one live model file. Single-threaded
+    (the loop is a control plane, not a data plane); every device-touching
+    phase is the existing train/serve machinery."""
+
+    def __init__(self, cfg: LoopConfig):
+        self.cfg = cfg
+        os.makedirs(cfg.workdir, exist_ok=True)
+        self.journal = LoopJournal.load(cfg.journal_path)
+
+    # -- small helpers -----------------------------------------------------
+
+    def _read(self, path: str) -> str:
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+
+    def _file_sha(self, path: str) -> Optional[str]:
+        try:
+            return model_fingerprint(self._read(path))
+        except OSError:
+            return None
+
+    def _cycle_file(self, stem: str, cycle: Optional[int] = None) -> str:
+        c = self.journal.cycle if cycle is None else cycle
+        return os.path.join(self.cfg.workdir, "%s_c%05d" % (stem, c))
+
+    def _copy_published_set(self, src: str, dst: str) -> None:
+        """Copy a model file AND its sidecars (drift + lineage) atomically,
+        skipping sidecars the source does not have."""
+        atomic_write_text(dst, self._read(src))
+        for suffix in (".drift.json", LINEAGE_SUFFIX):
+            try:
+                body = self._read(src + suffix)
+            except OSError:
+                continue
+            atomic_write_text(dst + suffix, body)
+
+    def _gc_workdir(self) -> None:
+        """Drop per-cycle artifacts older than ``keep_cycles`` cycles (the
+        journal itself and the live/prev files are never touched)."""
+        floor = self.journal.cycle - self.cfg.keep_cycles
+        if floor <= 0:
+            return
+        import re
+
+        pat = re.compile(r"_c(\d{5})(\.|$)")
+        for name in os.listdir(self.cfg.workdir):
+            m = pat.search(name)
+            if m and int(m.group(1)) < floor:
+                try:
+                    os.unlink(os.path.join(self.cfg.workdir, name))
+                except OSError:
+                    pass
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def ensure_bootstrap(self) -> bool:
+        """Train + publish the INITIAL model when no live file exists yet
+        (cycle 0's data, no parent). Returns True when it published. Not a
+        journaled cycle — a kill mid-bootstrap simply re-runs it; the
+        atomic publish keeps the file never-torn either way."""
+        if os.path.exists(self.cfg.model_path):
+            return False
+        log.info("loop: no live model at %r; bootstrapping"
+                 % self.cfg.model_path)
+        bst, digest, flight_path = self._train(cycle=0, parent=None)
+        self._publish_files(
+            bst.model_to_string(), booster=bst, parent_fp=None,
+            manifest_digest=digest, flight_path=flight_path, cycle=0,
+        )
+        return True
+
+    # -- the state steps ---------------------------------------------------
+
+    def run_cycle(self, force: bool = False,
+                  max_wait_s: Optional[float] = None) -> Optional[str]:
+        """Drive the loop from wherever the journal says it is to the next
+        terminal arrow. Returns the cycle outcome ("promoted" / "rejected" /
+        "rolled_back"), or None when observe saw no trigger within its
+        budget. ``force=True`` skips the drift wait (operator-initiated
+        retrain; also what the smoke's kill children use so restarts are
+        deterministic)."""
+        j = self.journal
+        if j.state == "observe":
+            if not self._observe(force, max_wait_s):
+                return None
+        # re-entry: each step advances the journal to the next state; a
+        # freshly restarted controller falls into the right arm
+        while True:
+            state = j.state
+            if state == "retrain":
+                self._retrain()
+            elif state == "validate":
+                if not self._validate():
+                    self._finish("rejected")
+                    return "rejected"
+            elif state == "publish":
+                self._publish()
+            elif state == "swap":
+                self._swap()
+            elif state == "settle":
+                if self._settle():
+                    self._finish("promoted")
+                    return "promoted"
+            elif state == "rollback":
+                self._rollback()
+                self._finish("rolled_back")
+                return "rolled_back"
+            else:  # observe is only re-entered via _finish, which returns
+                raise LightGBMError(
+                    "loop: unexpected state %r inside run_cycle" % state
+                )
+
+    def run_forever(self, max_cycles: Optional[int] = None) -> int:
+        """Observe/retrain until ``max_cycles`` outcomes (None = forever).
+        Returns the number of completed cycles."""
+        done = 0
+        while max_cycles is None or done < max_cycles:
+            out = self.run_cycle()
+            if out is not None:
+                done += 1
+        return done
+
+    def _finish(self, outcome: str) -> None:
+        self.journal.finish_cycle(outcome)
+        obs_registry.REGISTRY.counter(
+            "loop_cycles",
+            "continuous-training cycles by terminal outcome",
+        ).inc(outcome=outcome)
+        log.info("loop: cycle %d finished: %s"
+                 % (self.journal.cycle, outcome))
+        self._gc_workdir()
+
+    def _observe(self, force: bool, max_wait_s: Optional[float]) -> bool:
+        """Watch the drift signal until it triggers (or the budget runs
+        out). The poll cadence rides backoff.delays with seeded jitter so a
+        fleet of controllers never thunders in phase, and the total wait is
+        budget-bounded."""
+        faults.maybe_fire(FAULT_OBSERVE)
+        with trace_mod.span("loop.observe", cat="loop"):
+            if force or self.cfg.drift_source is None:
+                trig = {"forced": True} if force else {"unconditional": True}
+                self.journal.transition("retrain", trigger=trig)
+                return True
+            budget = (self.cfg.observe_budget_s
+                      if max_wait_s is None else float(max_wait_s))
+            # first poll immediately, then jittered fixed-cadence waits
+            # until the budget is spent
+            sleeps = backoff.delays(
+                attempts=10_000_000,
+                base_s=self.cfg.poll_interval_s,
+                factor=1.0,
+                max_s=self.cfg.poll_interval_s * 2,
+                jitter=0.1,
+                seed=self.cfg.jitter_seed,
+                max_elapsed_s=budget,
+            )
+            while True:
+                try:
+                    triggered, info = self.cfg.drift_source.poll()
+                except Exception as e:
+                    # a replica restarting or one dropped connection must
+                    # not kill the long-running controller: treat the poll
+                    # as quiet and keep the (budget-bounded) cadence
+                    log.warn_once(
+                        "loop-observe-poll",
+                        "loop: drift poll failed (%s: %s); retrying on the "
+                        "observe cadence" % (type(e).__name__, str(e)[:200]),
+                    )
+                    triggered, info = False, {}
+                if triggered:
+                    log.info("loop: drift trigger: %s"
+                             % json.dumps(info)[:400])
+                    self.journal.transition("retrain", trigger=info)
+                    return True
+                d = next(sleeps, None)
+                if d is None:
+                    return False
+                time.sleep(d)
+
+    def _train(self, cycle: int, parent: Optional[str]):
+        """One (re)training run: warm-started from ``parent`` when given,
+        checkpointed so a killed retrain resumes instead of restarting,
+        flight-recorded so the published model carries its manifest digest.
+        Returns (booster, manifest_digest, flight_path)."""
+        from .. import Dataset  # deferred: keep module import light
+        from .. import engine
+
+        X, y, _, _ = self.cfg.data_provider(cycle)
+        ckpt = self._cycle_file("retrain", cycle) + ".ckpt"
+        flight_path = self._cycle_file("flight", cycle) + ".jsonl"
+        rounds = self.cfg.num_boost_round
+        ck_rounds = self.cfg.checkpoint_rounds or max(1, rounds // 4)
+        kwargs = dict(
+            verbose_eval=False,
+            checkpoint_path=ckpt,
+            checkpoint_rounds=ck_rounds,
+        )
+        params = dict(self.cfg.params)
+        params["flight_record"] = flight_path
+        if os.path.exists(ckpt):
+            # a killed retrain left its checkpoint: resume it (the
+            # checkpoint carries the warm-start trees and the exact score
+            # carries). A checkpoint that does not match this cycle's data
+            # or config is refused loudly by restore — fall back to fresh.
+            try:
+                bst = engine.train(
+                    params, Dataset(X, label=y), rounds,
+                    resume_from=ckpt, **kwargs,
+                )
+                return bst, self._flight_digest(flight_path), flight_path
+            except LightGBMError as e:
+                log.warning(
+                    "loop: retrain checkpoint %r unusable (%s); retraining "
+                    "from scratch" % (ckpt, str(e)[:200])
+                )
+                try:
+                    os.unlink(ckpt)
+                except OSError:
+                    pass
+        init = (
+            self.cfg.model_path
+            if parent is not None and self.cfg.warm_start
+            else None
+        )
+        bst = engine.train(
+            params, Dataset(X, label=y), rounds, init_model=init, **kwargs,
+        )
+        return bst, self._flight_digest(flight_path), flight_path
+
+    def _flight_digest(self, flight_path: str) -> str:
+        try:
+            manifest = flight_mod.load(flight_path)["manifest"]
+            return flight_mod.manifest_digest(manifest) if manifest else ""
+        except OSError:
+            return ""
+
+    def _retrain(self) -> None:
+        faults.maybe_fire(FAULT_RETRAIN)
+        j = self.journal
+        with trace_mod.span("loop.retrain", cat="loop", cycle=j.cycle):
+            parent_fp = self._file_sha(self.cfg.model_path)
+            bst, digest, flight_path = self._train(j.cycle, parent_fp)
+            candidate = self._cycle_file("candidate") + ".txt"
+            bst.save_model(candidate)
+            # drift reference for the candidate NOW, while its training set
+            # is live — published next to the live file at the publish step
+            # (the drift-sidecar refresh every hot swap then picks up)
+            try:
+                bst.save_drift_reference(candidate)
+            except Exception as e:  # sidecar is best-effort observability
+                log.warning("loop: drift sidecar failed: %r" % (e,))
+            j.transition(
+                "validate",
+                candidate_path=candidate,
+                candidate_fingerprint=self._file_sha(candidate),
+                candidate_manifest_digest=digest,
+                candidate_flight=flight_path,
+                parent_fingerprint=parent_fp,
+            )
+
+    def _predict_on(self, model_text_path: str, X: np.ndarray) -> np.ndarray:
+        from ..basic import Booster
+
+        return Booster(model_file=model_text_path).predict(X)
+
+    def _validate(self) -> bool:
+        """Gate the candidate against the SERVING model on the holdout.
+        Returns False (-> rejected) when the candidate regresses past the
+        margin. Idempotent: recomputes from the journaled candidate; a
+        missing/foreign candidate file re-enters retrain instead."""
+        faults.maybe_fire(FAULT_VALIDATE)
+        j = self.journal
+        cand = j.get("candidate_path")
+        if not cand or self._file_sha(cand) != j.get("candidate_fingerprint"):
+            # killed between training and journaling, or artifacts swept:
+            # the candidate cannot be trusted — rebuild it
+            log.warning("loop: candidate missing/mismatched; re-entering "
+                        "retrain (cycle %d)" % j.cycle)
+            j.transition("retrain")
+            self._retrain()
+            return self._validate()
+        with trace_mod.span("loop.validate", cat="loop", cycle=j.cycle):
+            _, _, Xh, yh = self.cfg.data_provider(j.cycle)
+            name, fn, bigger = gate_metric(self.cfg.params.get("objective"))
+            cand_m = fn(yh, self._predict_on(cand, Xh))
+            serv_m = (
+                fn(yh, self._predict_on(self.cfg.model_path, Xh))
+                if os.path.exists(self.cfg.model_path)
+                else (-np.inf if bigger else np.inf)
+            )
+            margin = self.cfg.validation_margin
+            passed = (
+                cand_m >= serv_m - margin if bigger
+                else cand_m <= serv_m + margin
+            )
+            verdict = dict(
+                metric=name, bigger_is_better=bigger, margin=margin,
+                candidate=float(cand_m), serving=float(serv_m),
+                passed=bool(passed),
+            )
+            log.info("loop: validate cycle %d: %s" % (j.cycle, verdict))
+            if not passed:
+                j.update(validation=verdict)
+                return False
+            # the rollback pointer rides the SAME atomic write that makes
+            # publish reachable: after this instant the previous version's
+            # identity can never be lost, no matter where a kill lands
+            j.transition(
+                "publish",
+                validation=verdict,
+                previous_path=(
+                    self.cfg.model_path + PREV_SUFFIX
+                    if os.path.exists(self.cfg.model_path) else None
+                ),
+                previous_fingerprint=self._file_sha(self.cfg.model_path),
+            )
+            return True
+
+    def _lineage_body(self, file_sha: str, parent_fp: Optional[str],
+                      manifest_digest: str, flight_path: Optional[str],
+                      cycle: int) -> str:
+        return json.dumps({
+            "version": LINEAGE_VERSION,
+            "fingerprint": file_sha,
+            "parent_fingerprint": parent_fp,
+            "manifest_digest": manifest_digest,
+            "flight_path": flight_path,
+            "cycle": cycle,
+            "published_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        }, indent=1)
+
+    def _publish_files(self, text: str, booster=None,
+                       parent_fp: Optional[str] = None,
+                       manifest_digest: str = "",
+                       flight_path: Optional[str] = None,
+                       cycle: int = 0,
+                       drift_sidecar_src: Optional[str] = None) -> str:
+        """Write the live model file (atomic, fault site INSIDE the rename
+        window) + its drift and lineage sidecars. Returns the file sha."""
+        live = self.cfg.model_path
+        atomic_write_text(live, text, fault_site=FAULT_PUBLISH)
+        sha = model_fingerprint(text)
+        if drift_sidecar_src is not None:
+            try:
+                atomic_write_text(
+                    live + ".drift.json", self._read(drift_sidecar_src)
+                )
+            except OSError:
+                pass  # candidate had no sidecar (e.g. EFB-bundled train set)
+        elif booster is not None:
+            try:
+                booster.save_drift_reference(live)
+            except Exception as e:
+                log.warning("loop: drift sidecar failed: %r" % (e,))
+        atomic_write_text(
+            lineage_path(live),
+            self._lineage_body(sha, parent_fp, manifest_digest,
+                               flight_path, cycle),
+        )
+        return sha
+
+    def _publish(self) -> None:
+        """Retain the previous version, then atomically replace the live
+        file with the journaled candidate. Every sub-step is idempotent:
+        a restart mid-publish re-runs only what is not already true."""
+        faults.maybe_fire(FAULT_PUBLISH)
+        j = self.journal
+        cand = j.get("candidate_path")
+        cand_sha = j.get("candidate_fingerprint")
+        if not (j.get("validation") or {}).get("passed"):
+            raise LightGBMError(
+                "loop: publish state without a passed validation verdict "
+                "(cycle %d) — journal corrupted by hand?" % j.cycle
+            )
+        if not cand or self._file_sha(cand) != cand_sha:
+            raise LightGBMError(
+                "loop: journaled candidate %r is missing or altered at "
+                "publish (cycle %d) — refusing to publish unvalidated "
+                "bytes; remove the journal to restart the cycle"
+                % (cand, j.cycle)
+            )
+        with trace_mod.span("loop.publish", cat="loop", cycle=j.cycle):
+            live_sha = self._file_sha(self.cfg.model_path)
+            prev = j.get("previous_path")
+            if prev and live_sha is not None and live_sha != cand_sha:
+                # live still holds the previous version: retain it (model +
+                # sidecars) for the rollback. If live already == candidate
+                # (killed after the rename), the retained copy from the
+                # first attempt is intact — do NOT clobber it.
+                if self._file_sha(prev) != j.get("previous_fingerprint"):
+                    self._copy_published_set(self.cfg.model_path, prev)
+            # idempotent re-entry: when live already holds the candidate
+            # (killed after the rename), this rewrites only the sidecars
+            self._publish_files(
+                self._read(cand),
+                parent_fp=j.get("parent_fingerprint"),
+                manifest_digest=j.get("candidate_manifest_digest") or "",
+                flight_path=j.get("candidate_flight"),
+                cycle=j.cycle,
+                drift_sidecar_src=cand + ".drift.json",
+            )
+            j.transition("swap", published_fingerprint=cand_sha)
+
+    def _swap_all(self, expected_sha: str) -> None:
+        """Hot-swap every replica to the live file and verify each one
+        serves exactly those bytes. Per-replica fault site."""
+        for replica in self.cfg.replicas:
+            faults.maybe_fire(FAULT_SWAP)
+            info = replica.swap(self.cfg.model_name, self.cfg.model_path)
+            got = str(info.get("file_sha"))
+            if got != expected_sha:
+                raise LightGBMError(
+                    "loop: replica %r serves %s after swap, expected %s"
+                    % (replica, got[:12], expected_sha[:12])
+                )
+            log.info("loop: swapped %r -> v%s on %r"
+                     % (self.cfg.model_name, info.get("version"), replica))
+
+    def _swap(self) -> None:
+        j = self.journal
+        with trace_mod.span("loop.swap", cat="loop", cycle=j.cycle):
+            self._swap_all(str(j.get("published_fingerprint")))
+            j.transition("settle")
+
+    def _settle(self) -> bool:
+        """Post-swap watch. Default check: the published model must not
+        regress past ``rollback_margin`` against the journaled serving
+        metric on the holdout. ``settle_fn`` (called with this controller
+        and the journaled validation verdict) replaces the decision —
+        production deployments point it at live traffic metrics; the tests
+        use it to force the rollback path deterministically."""
+        j = self.journal
+        with trace_mod.span("loop.settle", cat="loop", cycle=j.cycle):
+            verdict = j.get("validation") or {}
+            if self.cfg.settle_fn is not None:
+                ok = bool(self.cfg.settle_fn(self, verdict))
+            else:
+                _, _, Xh, yh = self.cfg.data_provider(j.cycle)
+                name, fn, bigger = gate_metric(
+                    self.cfg.params.get("objective")
+                )
+                live_m = fn(yh, self._predict_on(self.cfg.model_path, Xh))
+                base = verdict.get("serving")
+                if base is None or not np.isfinite(base):
+                    ok = True
+                elif bigger:
+                    ok = live_m >= base - self.cfg.rollback_margin
+                else:
+                    ok = live_m <= base + self.cfg.rollback_margin
+                log.info("loop: settle cycle %d: %s=%s vs serving %s -> %s"
+                         % (j.cycle, name, live_m, base,
+                            "ok" if ok else "REGRESSION"))
+            if ok:
+                return True
+            if not j.get("previous_fingerprint"):
+                log.warning(
+                    "loop: settle regression but no previous version to "
+                    "roll back to (first publish); keeping the candidate"
+                )
+                return True
+            j.transition("rollback")
+            return False
+
+    def _rollback(self) -> None:
+        """Republish the retained previous version and re-swap every
+        replica to it. Idempotent; the republish rides the same atomic
+        writer (and fires the loop.publish site inside its rename window),
+        the re-swaps fire loop.swap — so kills DURING a rollback are part
+        of the kill-anywhere proof."""
+        j = self.journal
+        prev = j.get("previous_path")
+        prev_sha = j.get("previous_fingerprint")
+        if not prev or self._file_sha(prev) != prev_sha:
+            raise LightGBMError(
+                "loop: rollback target %r missing or altered (expected %s) "
+                "— the retained previous version must be restored by the "
+                "operator" % (prev, str(prev_sha)[:12])
+            )
+        with trace_mod.span("loop.rollback", cat="loop", cycle=j.cycle):
+            if self._file_sha(self.cfg.model_path) != prev_sha:
+                atomic_write_text(
+                    self.cfg.model_path, self._read(prev),
+                    fault_site=FAULT_PUBLISH,
+                )
+            # restore the previous version's sidecars next to the live file
+            for suffix in (".drift.json", LINEAGE_SUFFIX):
+                try:
+                    atomic_write_text(
+                        self.cfg.model_path + suffix,
+                        self._read(prev + suffix),
+                    )
+                except OSError:
+                    # the previous version had none: drop the stale one so
+                    # a replica never pairs old bytes with new sidecars
+                    try:
+                        os.unlink(self.cfg.model_path + suffix)
+                    except OSError:
+                        pass
+            self._swap_all(str(prev_sha))
+            log.warning(
+                "loop: cycle %d rolled back to %s"
+                % (j.cycle, str(prev_sha)[:12])
+            )

@@ -1,0 +1,230 @@
+"""The continuous-training loop's journaled state machine.
+
+One cycle of the closed loop walks
+
+    OBSERVE -> RETRAIN -> VALIDATE -> PUBLISH -> SWAP -> SETTLE
+                                 \\-> (rejected)            \\-> ROLLBACK
+
+and every transition is ONE atomic journal write (resil/atomic.py: temp +
+fsync + rename), so a controller SIGKILLed at any instant re-enters at the
+step the journal last recorded — it never re-publishes a half-validated
+candidate (PUBLISH is only reachable through a journaled ``validation`` with
+``passed=true``) and never loses the rollback pointer (``previous_*`` is
+recorded IN the same atomic write that enters PUBLISH, before the live file
+is touched). The journal is a single JSON object, not an event log: the
+controller's whole persistent state is the one file, and the atomic writer
+guarantees a reader sees either the old record or the new one, never a torn
+mix (docs/ContinuousTraining.md documents the format field by field).
+
+States:
+
+  ``observe``   watching the drift signal; the only state a cycle starts or
+                ends in. ``last_outcome`` carries the previous cycle's
+                terminal result.
+  ``retrain``   warm-started training of the candidate is (re)running.
+  ``validate``  the candidate file exists and is being gated against the
+                serving model on the holdout.
+  ``publish``   the candidate passed the gate; the live model file is being
+                replaced through resil/atomic. ``previous_*`` (the rollback
+                pointer) is already durable.
+  ``swap``      every replica is being hot-swapped to the published file.
+  ``settle``    the post-swap watch; a regression here enters rollback.
+  ``rollback``  the previous version is being republished and re-swapped.
+
+Cycle outcomes: ``promoted`` / ``rejected`` / ``rolled_back`` (the
+``loop_cycles_total{outcome=}`` counter labels).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from ..resil.atomic import atomic_write_text
+from ..utils.log import LightGBMError
+
+JOURNAL_VERSION = 1
+
+STATES = (
+    "observe", "retrain", "validate", "publish", "swap", "settle", "rollback",
+)
+OUTCOMES = ("promoted", "rejected", "rolled_back")
+
+#: legal transitions (from -> allowed next states). ``observe`` is reachable
+#: from every terminal arrow via finish_cycle.
+_EDGES = {
+    "observe": ("retrain",),
+    "retrain": ("validate",),
+    # validate -> retrain: a restarted controller whose journaled candidate
+    # file is missing/altered rebuilds it instead of gating stale bytes
+    "validate": ("publish", "observe", "retrain"),
+    "publish": ("swap",),
+    "swap": ("settle",),
+    "settle": ("rollback", "observe"),
+    "rollback": ("observe",),
+}
+
+
+class LoopStateError(LightGBMError):
+    """An illegal transition or a structurally unusable journal — a
+    controller bug or operator error, never a crash artifact (crash
+    artifacts are impossible by the atomic-write construction)."""
+
+
+def _fresh_record() -> Dict[str, Any]:
+    return {
+        "version": JOURNAL_VERSION,
+        "seq": 0,
+        "cycle": 0,
+        "state": "observe",
+        "updated_at": "",
+        # per-cycle fields (reset when a new cycle leaves observe)
+        "trigger": None,
+        "candidate_path": None,
+        "candidate_fingerprint": None,
+        "candidate_manifest_digest": None,
+        "candidate_flight": None,
+        "parent_fingerprint": None,
+        "validation": None,
+        # rollback pointer: durable BEFORE the live file is touched
+        "previous_path": None,
+        "previous_fingerprint": None,
+        "published_fingerprint": None,
+        # history
+        "last_outcome": None,
+        "outcomes": {k: 0 for k in OUTCOMES},
+    }
+
+
+#: the per-cycle fields a new cycle clears on its observe -> retrain edge
+_CYCLE_FIELDS = (
+    "trigger", "candidate_path", "candidate_fingerprint",
+    "candidate_manifest_digest", "candidate_flight", "parent_fingerprint",
+    "validation", "published_fingerprint",
+)
+
+
+class LoopJournal:
+    """The one durable record of where the loop is; every mutation is an
+    atomic file replace. Not thread-safe by design — one controller owns
+    one journal (two controllers on one journal is an operator error the
+    seq counter makes visible, not a supported deployment)."""
+
+    def __init__(self, path: str, record: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.rec = record if record is not None else _fresh_record()
+
+    # -- IO ----------------------------------------------------------------
+
+    @classmethod
+    def load(cls, path: str) -> "LoopJournal":
+        """Read the journal back, or start fresh when none exists. A file
+        that exists but does not parse is NOT silently reset: the atomic
+        writer cannot produce one, so it means operator damage — refusing
+        loudly beats re-entering the loop at the wrong step."""
+        try:
+            with open(path, encoding="utf-8") as fh:
+                body = json.load(fh)
+        except OSError:
+            return cls(path)
+        except ValueError as e:
+            raise LoopStateError(
+                "loop journal %r is not valid JSON (%s); the atomic writer "
+                "cannot have produced this — refusing to guess the loop "
+                "state. Repair or remove the file explicitly." % (path, e)
+            )
+        if not isinstance(body, dict) or body.get("version") != JOURNAL_VERSION:
+            raise LoopStateError(
+                "loop journal %r has version %r (supported: %d)"
+                % (path, body.get("version") if isinstance(body, dict)
+                   else None, JOURNAL_VERSION)
+            )
+        if body.get("state") not in STATES:
+            raise LoopStateError(
+                "loop journal %r records unknown state %r"
+                % (path, body.get("state"))
+            )
+        rec = _fresh_record()
+        rec.update(body)
+        return cls(path, rec)
+
+    def _write(self) -> None:
+        self.rec["seq"] = int(self.rec["seq"]) + 1
+        self.rec["updated_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
+        d = os.path.dirname(os.path.abspath(self.path))
+        if d:
+            os.makedirs(d, exist_ok=True)
+        atomic_write_text(self.path, json.dumps(self.rec, indent=1))
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return str(self.rec["state"])
+
+    @property
+    def cycle(self) -> int:
+        return int(self.rec["cycle"])
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.rec.get(key, default)
+
+    # -- transitions -------------------------------------------------------
+
+    def transition(self, state: str, **fields: Any) -> None:
+        """Move to ``state``, folding ``fields`` into the record, in ONE
+        atomic write. Illegal edges raise (a controller bug must not
+        journal itself into an unreachable position). Re-entering the
+        CURRENT state is always legal — that is exactly what a restarted
+        controller does."""
+        if state not in STATES:
+            raise LoopStateError("unknown loop state %r" % (state,))
+        cur = self.state
+        if state != cur and state not in _EDGES[cur]:
+            raise LoopStateError(
+                "illegal loop transition %s -> %s (cycle %d)"
+                % (cur, state, self.cycle)
+            )
+        if cur == "observe" and state == "retrain":
+            # a new cycle begins: bump the counter and clear the previous
+            # cycle's candidate bookkeeping (previous_* survives — it keeps
+            # naming the last published-and-kept version until the next
+            # publish overwrites it)
+            self.rec["cycle"] = self.cycle + 1
+            for k in _CYCLE_FIELDS:
+                self.rec[k] = None
+        self.rec["state"] = state
+        self.rec.update(fields)
+        self._write()
+
+    def update(self, **fields: Any) -> None:
+        """Fold fields into the record without changing state (one atomic
+        write) — e.g. the retrain step journaling its candidate before the
+        validate edge."""
+        self.rec.update(fields)
+        self._write()
+
+    def finish_cycle(self, outcome: str) -> None:
+        """Terminal arrow of a cycle: record the outcome, return to
+        observe. Reachable from validate (rejected), settle (promoted) and
+        rollback (rolled_back)."""
+        if outcome not in OUTCOMES:
+            raise LoopStateError("unknown cycle outcome %r" % (outcome,))
+        cur = self.state
+        if cur == "observe":
+            raise LoopStateError("finish_cycle from observe (no cycle open)")
+        if "observe" not in _EDGES[cur] and cur != "observe":
+            # promote/reject/rollback all end on states with an observe
+            # edge; anything else is a controller bug
+            raise LoopStateError(
+                "cycle cannot finish from state %r" % (cur,)
+            )
+        self.rec["state"] = "observe"
+        self.rec["last_outcome"] = outcome
+        outcomes = dict(self.rec.get("outcomes") or {})
+        outcomes[outcome] = int(outcomes.get(outcome, 0)) + 1
+        self.rec["outcomes"] = outcomes
+        self._write()

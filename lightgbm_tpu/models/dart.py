@@ -19,6 +19,11 @@ from .gbdt import GBDT
 
 
 class DART(GBDT):
+    # the carry is NOT a plain sum of the stored trees (Normalize rescales
+    # dropped trees every iteration) — the bit-exact warm-start replay
+    # (GBDT.warmstart_scores) must decline and fall back to the f64 path
+    _carry_is_tree_sum = False
+
     def _setup_train(self, train_set):
         super()._setup_train(train_set)
         self._drop_rng = np.random.RandomState(self.config.drop_seed & 0x7FFFFFFF)

@@ -61,6 +61,13 @@ def _leaf_output_np(sum_grad, sum_hess, l1: float, l2: float, max_delta_step: fl
 class GBDT:
     """Gradient Boosting Decision Tree trainer/model (gbdt.h:37-501)."""
 
+    #: whether the training score carry is a plain ordered f32 sum of the
+    #: stored trees — the precondition for the bit-exact warm-start replay
+    #: (warmstart_scores). DART sets this False: it re-drops and rescales
+    #: PAST trees per iteration, so no per-tree replay can reproduce its
+    #: carry. RF is excluded via ``average_output`` instead.
+    _carry_is_tree_sum = True
+
     def __init__(
         self,
         config: Config,
@@ -186,6 +193,9 @@ class GBDT:
         # to run the no-split stop check synchronously (see train_one_iter)
         self._defer_stop_check = type(self)._after_train_iter is GBDT._after_train_iter
         self._fmask_all = jnp.ones((self.train_set.num_features or 1,), bool)
+        # all-true per-row operand for the chunk scan's FMA pin (the select
+        # in _finish_step); cached so chunks never re-upload it
+        self._pin_all = jnp.ones((self.num_data,), bool)
         self.class_need_train = [
             self.objective.class_need_train(k) if self.objective is not None else True
             for k in range(K)
@@ -324,9 +334,18 @@ class GBDT:
                         "validation set's raw data (pass the unbinned rows, "
                         "or add eval sets before continued training)"
                     )
-                raw = self.predict_raw(np.asarray(raw_data, np.float64))
-                raw = raw.T if raw.ndim == 2 else raw[None, :]
-                score = score + jnp.asarray(raw, jnp.float32)
+                raw_np = np.asarray(raw_data, np.float64)
+                ws = self.warmstart_scores(raw_np)
+                if ws is not None:
+                    # per-tree f32 replay: the valid carry gets the exact
+                    # bits a run that attached this set from iteration 0
+                    # would hold, so eval values — and early-stopping
+                    # decisions — stay bit-identical across a warm start
+                    score = score + jnp.asarray(ws)
+                else:
+                    raw = self.predict_raw(raw_np)
+                    raw = raw.T if raw.ndim == 2 else raw[None, :]
+                    score = score + jnp.asarray(raw, jnp.float32)
             else:
                 for mi, (ta, cid) in enumerate(self._device_trees):
                     if ta is not None:
@@ -755,7 +774,8 @@ class GBDT:
             extra = (
                 self._sharded_chunk_args()
                 if self._learner_kind() == "data"
-                else ()
+                # serial scan: the all-true pin operand (see _finish_step)
+                else (self._pin_all,)
             )
             fn = self._chunk_fn(n)
             # snapshot avals BEFORE the donating call (obs/costs.py)
@@ -945,9 +965,10 @@ class GBDT:
 
         n_shards = int(mesh.shape["data"]) if sharded else 1
 
-        def make_body(bins, valid, meta, rate):
+        def make_body(bins, valid, meta, rate, pin=None):
             """The n-iteration scan body over ONE shard's rows (the whole
-            row space when not sharded: bins [F, N], valid None)."""
+            row space when not sharded: bins [F, N], valid None, pin the
+            all-true FMA-pin operand; sharded: valid set, pin None)."""
 
             def body(carry, xs):
                 scores, bag, stopped = carry
@@ -1000,12 +1021,12 @@ class GBDT:
                     nl_eff = jnp.where(stopped, jnp.int32(1), ta.num_leaves)
                     out = steps[k](
                         scores, ta.leaf_value, ta.internal_value, leaf_id,
-                        bag, nl_eff, rate, valid,
+                        bag, nl_eff, rate, valid, pin,
                     )
-                    # the data learner's step returns a 4th (pin) output;
-                    # inside the scan it is dead and DCE'd — the scan body
-                    # performs the plain add on its own (measured; the
-                    # quick-tier bit-identity suite re-proves it every run)
+                    # the step's 4th (pin) output is dead inside the scan
+                    # and DCE'd — here the plain add is pinned by the
+                    # valid/pin per-row select instead (measured; the
+                    # quick-tier bit-identity suites re-prove it every run)
                     scores, leaf_value, internal_value = out[0], out[1], out[2]
                     trees.append(
                         ta._replace(
@@ -1035,11 +1056,11 @@ class GBDT:
         if not sharded:
             bins = self.bins_dev
 
-            def chunk_fn(scores, bag_mask, it0, fmasks, rate):
+            def chunk_fn(scores, bag_mask, it0, fmasks, rate, pin):
                 retrace_mod.note_trace("gbdt.train_chunk")  # per XLA trace
                 its = it0 + jnp.arange(n, dtype=jnp.int32)
                 (scores, bag_mask, _), stacked = jax.lax.scan(
-                    make_body(bins, None, feature_meta, rate),
+                    make_body(bins, None, feature_meta, rate, pin),
                     (scores, bag_mask, jnp.bool_(False)), (its, fmasks),
                 )
                 return scores, bag_mask, unstack(stacked), stacked.num_leaves
@@ -1134,8 +1155,8 @@ class GBDT:
                 nl_dev,
                 self._finish_scalar(k),
             )
-        # the data learner's step carries a 4th output (the materialized
-        # add vector — the FMA-contraction pin, see _finish_step); unused
+        # the step carries a 4th output (the materialized add vector — the
+        # per-iteration FMA-contraction pin, see _finish_step); unused here
         self.scores, leaf_value, internal_value = out[0], out[1], out[2]
         return tree_arrays._replace(
             leaf_value=leaf_value, internal_value=internal_value
@@ -1151,22 +1172,21 @@ class GBDT:
         )
         use_bag = self._bagging_active
         M = self.config.num_leaves
-        # Data-parallel learner: pin the score update to PLAIN f32 adds of
-        # the shrunk leaf values by making the gathered add vector a
-        # PROGRAM OUTPUT. Without the materialization, XLA's CPU loop
-        # fusion recomputes the shrink-multiply inside the score-add kernel
-        # and LLVM contracts it into an FMA (jax.lax.optimization_barrier
-        # is stripped before fusion, measured) — but only in the
-        # per-iteration program, not in the shard_map chunk scan, so the
-        # chunk=1 vs chunk=K bit-identity contract would silently become
-        # fusion-dependent (observed as a 1-ulp score drift). With `add`
-        # materialized both programs perform the identical plain add
-        # (tests/test_parallel_chunk.py re-proves this every run). The
-        # serial learner keeps its historical 3-output arithmetic.
-        pin_adds = self._learner_kind() == "data"
+        # EVERY learner pins the score update to PLAIN f32 adds of the
+        # shrunk leaf values — an FMA-contracted carry cannot be reproduced
+        # from the saved model text (the text stores the rounded product),
+        # which would break the warm-start replay contract
+        # (warmstart_scores, docs/ContinuousTraining.md). In a standalone
+        # per-iteration program the pin is the materialized `add` OUTPUT:
+        # without it, XLA's CPU loop fusion recomputes the shrink-multiply
+        # inside the score-add kernel and LLVM contracts it into an FMA
+        # (jax.lax.optimization_barrier is stripped before fusion,
+        # measured — PR 8 first hit this on the data learner). Inside a
+        # scan that output is DCE'd, so the chunk path's pin is the
+        # per-row select on `valid`/`pin` below.
 
         def step(scores, leaf_value, internal_value, lid, bag, nl, rate,
-                 valid=None):
+                 valid=None, pin=None):
             if renew is not None:
                 leaf_value = renew(
                     scores[k], lid, bag if use_bag else None, M, leaf_value
@@ -1179,12 +1199,25 @@ class GBDT:
                 # forever — real rows pass through the select untouched, so
                 # the masked add equals the unmasked one bitwise on [0, N)
                 add = jnp.where(valid, add, jnp.float32(0.0))
+            elif pin is not None:
+                # all-true [N] runtime operand: value-identical, but the
+                # per-row select between the gather and the score add is
+                # what keeps XLA CPU fusion from recomputing the shrink-
+                # multiply inside the add kernel and FMA-contracting it.
+                # Inside a scan the materialized-output pin below is DCE'd,
+                # a scalar-predicate select is contracted through, and
+                # optimization_barrier is stripped before fusion (all
+                # measured) — this is the one form that pins the serial
+                # scan to the plain f32 adds the per-iteration program and
+                # the warm-start replay (warmstart_scores) perform; the
+                # chunk=1-vs-K suites re-prove it every run.
+                add = jnp.where(pin, add, jnp.float32(0.0))
             scores = scores.at[k].add(add)
-            if pin_adds:
-                return scores, leaf_value, internal_value, add
-            return scores, leaf_value, internal_value
+            # `add` as a program output IS the per-iteration FMA pin (see
+            # the block comment above); scan bodies drop it (DCE)
+            return scores, leaf_value, internal_value, add
 
-        return (k, renew is not None, use_bag, pin_adds), step
+        return (k, renew is not None, use_bag), step
 
     def _finish_scalar(self, k: int):
         return self._f32_dev(self.shrinkage_rate)
@@ -1465,6 +1498,33 @@ class GBDT:
         self._materialize()
         return self.models
 
+    def warmstart_scores(self, X: np.ndarray) -> Optional[np.ndarray]:
+        """Raw scores ``[K, N]`` float32, accumulated ONE TREE AT A TIME in
+        f32 in boosting order — the same add sequence (and therefore the
+        same IEEE roundings) the training score carry performed, so
+        continued training seeded from this array reproduces the parent
+        run's carry bit for bit (the init_model warm-start bedrock,
+        docs/ContinuousTraining.md). ``predict_raw``'s f64 accumulation
+        rounds once at the end instead and lands 1 ulp away on a fraction
+        of rows — enough to flip a gradient's histogram bin and fork every
+        later tree of the continued run. Returns None when the carry is
+        not a plain ordered sum of the stored trees (random forest
+        averages; DART re-drops and rescales past trees mid-run), in which
+        case callers fall back to the f64 path."""
+        if self.average_output or not self._carry_is_tree_sum:
+            return None
+        self._materialize()
+        X = np.asarray(X, np.float64)
+        K = max(self.num_tree_per_iteration, 1)
+        out = np.zeros((K, X.shape[0]), np.float32)
+        for i, t in enumerate(self.models):
+            if t is None:
+                continue
+            # %.*g(20) model text round-trips the device f32 leaf values
+            # exactly, so this cast recovers the very bits training added
+            out[i % K] += t.predict_fast(X).astype(np.float32)
+        return out
+
     def predict_raw(
         self, X: np.ndarray, num_iteration: int = -1, early_stop=None
     ) -> np.ndarray:
@@ -1735,9 +1795,33 @@ class GBDT:
         (gbdt.h num_init_iteration_ semantics; init scores already seeded via
         the dataset's predictor-generated init_score)."""
         other._materialize()
+        K = max(self.num_tree_per_iteration, 1)
         self.models = list(other.models) + self.models
-        self._device_trees = [(None, i % max(self.num_tree_per_iteration, 1)) for i in range(len(other.models))] + self._device_trees
+        self._device_trees = [(None, i % K) for i in range(len(other.models))] + self._device_trees
         self.num_init_iteration = len(other.models) // max(other.num_tree_per_iteration, 1)
+        # continued training CONTINUES the parent run's RNG streams — the
+        # warm-start bit-identity contract (train N, save, warm-start, train
+        # M must equal one uninterrupted N+M run; tests/test_warmstart.py):
+        #  * bagging is stateless fold_in(seed, iteration), so positioning
+        #    iter_ past the merged iterations resumes that stream exactly;
+        #  * the feature_fraction host RNG is stateful, so replay the draws
+        #    the parent consumed (iteration-major, class-minor — the same
+        #    order _sample_feature_masks pre-draws chunks in).
+        self.iter_ = self.num_init_iteration
+        cfg = self.config
+        if (cfg.feature_fraction < 1.0 and self.train_set is not None
+                and self.train_set.num_features > 0):
+            F = self.train_set.num_features
+            k = max(1, int(cfg.feature_fraction * F))
+            # only TRAINED classes draw (train_one_iter gates on
+            # class_need_train before _sample_features) — and a config with
+            # an untrained class disables device chunking, so the parent's
+            # stream advanced by exactly this per-iteration count
+            draws_per_iter = sum(
+                1 for need in self.class_need_train if need
+            )
+            for _ in range(self.num_init_iteration * draws_per_iter):
+                self._feat_rng.choice(F, size=k, replace=False)
 
     def reset_parameter(self, params: Dict) -> None:
         """reset_parameter callback support (ResetConfig path)."""

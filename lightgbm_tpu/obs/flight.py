@@ -169,12 +169,28 @@ def config_digest(config) -> str:
     return _config_digest(config)
 
 
+def manifest_digest(manifest: Dict[str, Any]) -> str:
+    """Stable identity of one recorded run: sha1 over the sorted-key JSON of
+    its manifest record (recorder bookkeeping fields excluded, so the digest
+    recomputed from a flight file on disk matches the one computed from the
+    in-memory manifest at train time). The continuous-training controller
+    journals this next to the published model — a serving-side rollback
+    decision can then name exactly which training run produced the bytes it
+    is about to drop (docs/ContinuousTraining.md)."""
+    body = {k: v for k, v in manifest.items()
+            if k not in ("event", "seq", "t_s")}
+    return hashlib.sha1(
+        json.dumps(body, sort_keys=True, default=_jsonable).encode("utf-8")
+    ).hexdigest()
+
+
 def build_manifest(
     booster,
     num_boost_round: int,
     init_iteration: int,
     resume_from: Optional[str] = None,
     checkpoint_path: Optional[str] = None,
+    parent_fingerprint: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Run-identity header: config digest, dataset shape + label digest,
     jax/backend versions, resume provenance (PR 5 checkpoints)."""
@@ -234,6 +250,10 @@ def build_manifest(
         man["resumed_at_iteration"] = int(gbdt.iter_)
     if checkpoint_path:
         man["checkpoint_path"] = str(checkpoint_path)
+    if parent_fingerprint:
+        # continued training (init_model): which model this run grew from —
+        # the lineage edge the serve side surfaces (docs/ContinuousTraining.md)
+        man["parent_fingerprint"] = str(parent_fingerprint)
     return man
 
 

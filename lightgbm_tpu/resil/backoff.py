@@ -1,16 +1,30 @@
 """Exponential backoff: the one retry-delay schedule for the whole package.
 
-Both consumers of retries — the serve dispatch retry (serve/server.py) and
-the bringup stage retry (helpers/tpu_bringup.py) — draw their sleeps from
-``delays`` so "how long do we wait after a transient failure" is decided in
-exactly one place; the retry LOOPS themselves stay with their callers (serve
-needs its asymmetric CPU-fallback arm, bringup signals failure through a
-result dict rather than exceptions). Stdlib only (the bringup driver must
-not pay a jax/numpy import for it).
+Every consumer of retries — the serve dispatch retry (serve/server.py), the
+bringup stage retry (helpers/tpu_bringup.py) and the continuous-training
+controller's observe/retry loops (lightgbm_tpu/loop/) — draws its sleeps
+from ``delays`` so "how long do we wait after a transient failure" is
+decided in exactly one place; the retry LOOPS themselves stay with their
+callers (serve needs its asymmetric CPU-fallback arm, bringup signals
+failure through a result dict rather than exceptions, the loop controller
+journals between waits). Stdlib only (the bringup driver must not pay a
+jax/numpy import for it).
+
+Two opt-in extensions (defaults preserve the historical schedule exactly):
+
+  * ``jitter``/``seed`` — each delay is scaled by a factor drawn uniformly
+    from ``[1 - jitter, 1 + jitter]``. With ``seed`` given the stream is
+    ``random.Random(seed)`` and therefore REPRODUCIBLE — the controller's
+    kill-anywhere tests replay identical schedules across restarts; without
+    a seed the jitter is process-random (fleet de-synchronization).
+  * ``max_elapsed_s`` — a TOTAL sleep budget: the final delay is truncated
+    to what remains of the budget and the schedule then stops, so a retry
+    loop's worst-case wall time is bounded regardless of ``attempts``.
 """
 from __future__ import annotations
 
-from typing import Iterator
+import random
+from typing import Iterator, Optional
 
 
 def delays(
@@ -18,10 +32,27 @@ def delays(
     base_s: float = 1.0,
     factor: float = 2.0,
     max_s: float = 60.0,
+    jitter: float = 0.0,
+    seed: Optional[int] = None,
+    max_elapsed_s: Optional[float] = None,
 ) -> Iterator[float]:
     """The sleep (seconds) before each RETRY of an ``attempts``-attempt loop:
-    ``attempts - 1`` values, ``base_s * factor**i`` capped at ``max_s``.
-    Deterministic by design — a jittered delay would make the fault-injection
-    tests (resil/faults.py) timing-dependent."""
+    up to ``attempts - 1`` values, ``base_s * factor**i`` capped at ``max_s``,
+    optionally jittered (deterministically when ``seed`` is given) and
+    bounded by the ``max_elapsed_s`` total budget. With the default
+    ``jitter=0`` the schedule is deterministic by design — the
+    fault-injection tests (resil/faults.py) must not be timing-dependent."""
+    rng = random.Random(seed) if jitter > 0 else None
+    elapsed = 0.0
     for i in range(max(attempts - 1, 0)):
-        yield min(base_s * (factor ** i), max_s)
+        d = min(base_s * (factor ** i), max_s)
+        if rng is not None:
+            # scale, then re-cap: a jittered delay must still honor max_s
+            d = min(d * (1.0 + jitter * (2.0 * rng.random() - 1.0)), max_s)
+        if max_elapsed_s is not None and elapsed + d >= max_elapsed_s:
+            d = max_elapsed_s - elapsed
+            if d > 0:
+                yield d
+            return
+        elapsed += d
+        yield d

@@ -24,6 +24,14 @@ Site catalog (docs/FaultTolerance.md keeps the authoritative table):
                         (resil/atomic.py via resil/checkpoint.py)
   ``serve.dispatch``    serve model dispatch (serve/server.py ServeApp)
   ``serve.batcher``     batcher worker, per gathered batch (serve/batcher.py)
+  ``loop.observe``      continuous-training controller, entering the drift
+                        watch (lightgbm_tpu/loop/controller.py)
+  ``loop.retrain``      entering the warm-started retrain
+  ``loop.validate``     entering the candidate-vs-serving holdout gate
+  ``loop.publish``      1st occurrence: entering publish; later occurrences:
+                        inside the atomic rename window of each live-model
+                        write (the rollback republish fires here too)
+  ``loop.swap``         per replica hot-swap (promote AND rollback re-swap)
 
 Determinism: occurrence counters are plain per-process integers — the same
 env var against the same workload fires at exactly the same point every run.

@@ -142,6 +142,7 @@ class ServedModel:
         version: int,
         min_bucket_rows: int = 16,
         drift_monitor: Optional["drift_mod.DriftMonitor"] = None,
+        lineage: Optional[Dict[str, object]] = None,
     ) -> None:
         import jax.numpy as jnp
 
@@ -153,6 +154,13 @@ class ServedModel:
         self.file_sha = file_sha
         self.version = version
         self.loaded_at = time.time()
+        # training lineage from the fingerprint-checked .lineage.json
+        # sidecar the continuous-training controller publishes next to the
+        # model (lightgbm_tpu/loop/): parent-model fingerprint + flight-
+        # recorder manifest digest — what makes a serving-side rollback
+        # decision auditable (docs/ContinuousTraining.md). None when the
+        # model was published by other means.
+        self.lineage = lineage
         # feature-drift monitor (serve/drift.py): host-side occupancy
         # accumulation on the batcher thread; None when drift is disabled
         self.drift = drift_monitor
@@ -219,6 +227,7 @@ class ServedModel:
 
     def info(self) -> Dict[str, object]:
         ens = self.ensemble
+        lin = self.lineage or {}
         return {
             "name": self.name,
             "path": self.path,
@@ -231,6 +240,10 @@ class ServedModel:
             "objective": ens.objective.to_string() if ens.objective else "",
             "average_output": ens.average_output,
             "loaded_at": self.loaded_at,
+            # lineage (null without a matching .lineage.json sidecar)
+            "parent_fingerprint": lin.get("parent_fingerprint"),
+            "manifest_digest": lin.get("manifest_digest"),
+            "published_cycle": lin.get("cycle"),
         }
 
 
@@ -281,6 +294,13 @@ class ModelRegistry:
             booster = Booster(model_str=text)
             ensemble = booster.to_packed()
             file_sha = model_fingerprint(text)
+            # lineage sidecar (loop/controller.py): fingerprint-checked, so
+            # a stale sidecar can never attribute foreign lineage to these
+            # bytes; local import — serving must not pay the loop package's
+            # import unless a registry actually loads a model
+            from ..loop.controller import load_lineage
+
+            lineage = load_lineage(path, file_sha)
             monitor = None
             if self.drift_opts is not None:
                 # per-load monitor: a hot swap starts fresh against the NEW
@@ -294,7 +314,7 @@ class ModelRegistry:
             # concurrent predicts never block behind a hot swap
             served = ServedModel(
                 name, path, ensemble, file_sha, 0, self.min_bucket_rows,
-                drift_monitor=monitor,
+                drift_monitor=monitor, lineage=lineage,
             )
             # the incoming model's warmup compiles are legitimate — they
             # must not trip an armed watchdog (LIGHTGBM_TPU_RETRACE=fail
@@ -500,6 +520,7 @@ class ServeApp:
                     model.name, model.path, Booster(model_str=text).to_packed(),
                     model.file_sha, model.version,
                     self.registry.min_bucket_rows,
+                    lineage=model.lineage,
                 )
             self._cpu_models[model.file_sha] = served
             return served
@@ -855,12 +876,18 @@ class _Handler(BaseHTTPRequestHandler):
                     ),
                 )
                 # request counters + latency are recorded by app.predict
+                lin = served.lineage or {}
                 self._json(
                     200,
                     {
                         "model": served.name,
                         "version": served.version,
                         "fingerprint": served.ensemble.fingerprint,
+                        # lineage: which model this one grew from + which
+                        # training run produced it (null without the loop's
+                        # .lineage.json sidecar) — docs/ContinuousTraining.md
+                        "parent_fingerprint": lin.get("parent_fingerprint"),
+                        "manifest_digest": lin.get("manifest_digest"),
                         "n": int(X.shape[0]),
                         "predictions": np.asarray(out).tolist(),
                     },

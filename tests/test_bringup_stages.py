@@ -28,7 +28,7 @@ def test_stage_table_complete():
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "bench_early",
         "smoke_pallas", "smoke_xla_radix", "smoke_bf16", "smoke_psplit",
         "bench_chunk", "bench_multichip", "bench_predict", "prof", "san",
-        "bench",
+        "loop", "bench",
     }
 
 
@@ -211,3 +211,24 @@ def test_run_san_invokes_smoke_by_file_path(monkeypatch):
     r = tb.run_san()
     assert r["ok"] and seen["stage"] == "san"
     assert seen["argv"][-1].endswith(_os.path.join("helpers", "san_smoke.py"))
+
+
+def test_run_loop_invokes_smoke_by_file_path(monkeypatch):
+    """The loop stage (ISSUE 12) executes helpers/loop_smoke.py by FILE
+    path in a child — the driver never imports the package; the child arms
+    its own sanitizer env and boots its own serve stack."""
+    import os as _os
+
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["stage"] = stage
+        seen["argv"] = argv
+        return {"ok": True}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    r = tb.run_loop()
+    assert r["ok"] and seen["stage"] == "loop"
+    assert seen["argv"][-1].endswith(
+        _os.path.join("helpers", "loop_smoke.py")
+    )

@@ -1,0 +1,413 @@
+"""Closed-loop continuous training: state machine + kill-anywhere proof.
+
+The controller (lightgbm_tpu/loop/) must survive SIGKILL at ANY arrow of
+
+    OBSERVE -> RETRAIN -> VALIDATE -> PUBLISH -> SWAP -> SETTLE -> ROLLBACK
+
+so the subprocess tests here kill a REAL controller at every ``loop.*``
+fault site (resil/faults.py) — including INSIDE the atomic rename window of
+the live-model publish and during a rollback's republish — and assert the
+restarted loop converges: consistent terminal journal state, live model
+file never torn, rollback restoring the previous fingerprint on the
+replica. In-process tests cover the journal's transition rules, the
+validation gate (rejected cycles leave the live file untouched) and the
+lineage sidecar plumbing.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.loop import (
+    AppReplica,
+    LoopConfig,
+    LoopController,
+    LoopJournal,
+    LoopStateError,
+    gate_metric,
+    load_lineage,
+)
+from lightgbm_tpu.models.model_text import model_fingerprint, peek_model_header
+from lightgbm_tpu.resil.faults import ENV_FAULTS
+from lightgbm_tpu.serve.server import ModelRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+def test_journal_transitions_and_atomic_reload(tmp_path):
+    p = str(tmp_path / "j.json")
+    j = LoopJournal.load(p)
+    assert j.state == "observe" and j.cycle == 0
+    j.transition("retrain", trigger={"forced": True})
+    assert j.cycle == 1
+    j.transition("validate", candidate_path="c.txt",
+                 candidate_fingerprint="abc")
+    # every write is a complete atomic record: a fresh load sees it all
+    j2 = LoopJournal.load(p)
+    assert j2.state == "validate" and j2.get("candidate_path") == "c.txt"
+    j2.transition("publish", validation={"passed": True},
+                  previous_fingerprint="old")
+    j2.transition("swap")
+    j2.transition("settle")
+    j2.transition("rollback")
+    j2.finish_cycle("rolled_back")
+    j3 = LoopJournal.load(p)
+    assert j3.state == "observe"
+    assert j3.get("last_outcome") == "rolled_back"
+    assert j3.get("outcomes")["rolled_back"] == 1
+    # the rollback pointer survives the cycle end
+    assert j3.get("previous_fingerprint") == "old"
+
+
+def test_journal_refuses_illegal_edges(tmp_path):
+    j = LoopJournal.load(str(tmp_path / "j.json"))
+    with pytest.raises(LoopStateError):
+        j.transition("publish")  # observe -> publish is not an edge
+    j.transition("retrain")
+    with pytest.raises(LoopStateError):
+        j.transition("swap")
+    with pytest.raises(LoopStateError):
+        j.finish_cycle("promoted")  # retrain cannot terminate a cycle
+    with pytest.raises(LoopStateError):
+        j.transition("validate"), j.finish_cycle("nonsense")
+
+
+def test_journal_refuses_damaged_file(tmp_path):
+    p = str(tmp_path / "j.json")
+    with open(p, "w") as fh:
+        fh.write("{torn")
+    with pytest.raises(LoopStateError):
+        LoopJournal.load(p)
+
+
+def test_new_cycle_clears_candidate_fields(tmp_path):
+    j = LoopJournal.load(str(tmp_path / "j.json"))
+    j.transition("retrain")
+    j.transition("validate", candidate_fingerprint="abc")
+    j.transition("observe")  # rejected arrow shape
+    j.transition("retrain")
+    assert j.get("candidate_fingerprint") is None
+    assert j.cycle == 2
+
+
+# ---------------------------------------------------------------------------
+# gate metrics
+# ---------------------------------------------------------------------------
+
+def test_gate_metric_families():
+    name, auc, bigger = gate_metric("binary")
+    assert (name, bigger) == ("auc", True)
+    y = np.array([0, 0, 1, 1])
+    assert auc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert auc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    assert abs(auc(y, np.array([0.5, 0.5, 0.5, 0.5])) - 0.5) < 1e-12
+    name, ll, bigger = gate_metric("multiclass num_class:3")
+    assert (name, bigger) == ("multi_logloss", False)
+    p = np.full((4, 3), 1 / 3.0)
+    assert abs(ll(np.array([0, 1, 2, 0]), p) - np.log(3)) < 1e-9
+    name, l2, bigger = gate_metric("regression")
+    assert (name, bigger) == ("l2", False)
+    assert l2(np.array([1.0, 2.0]), np.array([1.0, 4.0])) == 2.0
+
+
+# ---------------------------------------------------------------------------
+# in-process controller flows
+# ---------------------------------------------------------------------------
+
+def _provider(cycle):
+    rng = np.random.RandomState(100 + cycle)
+    n = 300
+    shift = 0.0 if cycle == 0 else 1.5
+    X = rng.randn(n, 5) + shift
+    y = ((X[:, 0] - shift) + 0.3 * rng.randn(n) > 0).astype(float)
+    Xh = rng.randn(120, 5) + shift
+    yh = ((Xh[:, 0] - shift) > 0).astype(float)
+    return X, y, Xh, yh
+
+
+_PARAMS = {"objective": "binary", "num_leaves": 8, "verbosity": -1}
+
+
+def _cfg(tmp_path, **over):
+    kw = dict(
+        model_path=str(tmp_path / "live.txt"),
+        workdir=str(tmp_path / "wd"),
+        params=dict(_PARAMS),
+        num_boost_round=5,
+        data_provider=_provider,
+    )
+    kw.update(over)
+    return LoopConfig(**kw)
+
+
+def test_promote_cycle_swaps_replica_and_publishes_lineage(tmp_path):
+    reg = ModelRegistry()
+    cfg = _cfg(tmp_path, replicas=[AppReplica(reg)])
+    ctl = LoopController(cfg)
+    assert ctl.ensure_bootstrap()
+    boot_sha = ctl._file_sha(cfg.model_path)
+    assert not ctl.ensure_bootstrap()  # idempotent
+    assert ctl.run_cycle(force=True) == "promoted"
+    live_sha = ctl._file_sha(cfg.model_path)
+    assert live_sha != boot_sha
+    info = [i for i in reg.list() if i["name"] == cfg.model_name][0]
+    assert info["file_sha"] == live_sha
+    # lineage sidecar: fingerprint-checked, parent = the bootstrap model,
+    # manifest digest matches a recompute from the cycle's flight log
+    lin = load_lineage(cfg.model_path, live_sha)
+    assert lin is not None and lin["parent_fingerprint"] == boot_sha
+    from lightgbm_tpu.obs import flight
+    rerun = flight.manifest_digest(
+        flight.load(lin["flight_path"])["manifest"]
+    )
+    assert lin["manifest_digest"] == rerun
+    assert flight.load(lin["flight_path"])["manifest"][
+        "parent_fingerprint"] == boot_sha
+    # drift sidecar refreshed next to the live file
+    assert os.path.exists(cfg.model_path + ".drift.json")
+    # journal terminal state
+    j = LoopJournal.load(cfg.journal_path)
+    assert j.state == "observe" and j.get("last_outcome") == "promoted"
+    assert j.get("published_fingerprint") == live_sha
+
+
+def test_rejected_candidate_leaves_live_and_replica_untouched(tmp_path):
+    reg = ModelRegistry()
+
+    def bad_provider(cycle):
+        X, y, Xh, yh = _provider(cycle)
+        if cycle > 0:
+            rng = np.random.RandomState(7)
+            y = rng.permutation(y)  # garbage labels: candidate must lose
+        return X, y, Xh, yh
+
+    cfg = _cfg(tmp_path, replicas=[AppReplica(reg)],
+               data_provider=bad_provider,
+               validation_margin=0.0)
+    ctl = LoopController(cfg)
+    ctl.ensure_bootstrap()
+    boot_sha = ctl._file_sha(cfg.model_path)
+    reg.load(cfg.model_name, cfg.model_path)
+    v1 = [i for i in reg.list()][0]["version"]
+    assert ctl.run_cycle(force=True) == "rejected"
+    assert ctl._file_sha(cfg.model_path) == boot_sha, "live file touched!"
+    info = [i for i in reg.list()][0]
+    assert info["file_sha"] == boot_sha and info["version"] == v1
+    j = LoopJournal.load(cfg.journal_path)
+    assert j.state == "observe" and j.get("last_outcome") == "rejected"
+    assert (j.get("validation") or {}).get("passed") is False
+
+
+def test_rollback_restores_previous_on_every_replica(tmp_path):
+    regs = [ModelRegistry(), ModelRegistry()]
+    cfg = _cfg(tmp_path, replicas=[AppReplica(r) for r in regs],
+               settle_fn=lambda ctl, verdict: False)
+    ctl = LoopController(cfg)
+    ctl.ensure_bootstrap()
+    boot_sha = ctl._file_sha(cfg.model_path)
+    assert ctl.run_cycle(force=True) == "rolled_back"
+    assert ctl._file_sha(cfg.model_path) == boot_sha
+    for r in regs:
+        info = [i for i in r.list() if i["name"] == cfg.model_name][0]
+        assert info["file_sha"] == boot_sha, "replica not rolled back"
+    # the rollback restored the bootstrap lineage sidecar state (none)
+    assert load_lineage(cfg.model_path, boot_sha) is None or \
+        load_lineage(cfg.model_path, boot_sha)["fingerprint"] == boot_sha
+
+
+def test_observe_without_trigger_times_out(tmp_path):
+    class Quiet:
+        def poll(self):
+            return False, {"alerts": []}
+
+    cfg = _cfg(tmp_path, drift_source=Quiet(), poll_interval_s=0.01,
+               observe_budget_s=0.05, jitter_seed=1)
+    ctl = LoopController(cfg)
+    ctl.ensure_bootstrap()
+    assert ctl.run_cycle() is None
+    assert LoopJournal.load(cfg.journal_path).state == "observe"
+
+
+def test_lineage_sidecar_fingerprint_mismatch_is_ignored(tmp_path):
+    p = str(tmp_path / "m.txt")
+    with open(p, "w") as fh:
+        fh.write("tree\nend of trees\n")
+    with open(p + ".lineage.json", "w") as fh:
+        json.dump({"version": 1, "fingerprint": "someone-else",
+                   "parent_fingerprint": "x"}, fh)
+    assert load_lineage(p, model_fingerprint("tree\nend of trees\n")) is None
+
+
+def test_cli_once_force_runs_a_cycle(tmp_path):
+    """``python -m lightgbm_tpu.loop --once --force`` end to end on file
+    inputs: bootstraps the live model, then one operator-initiated cycle."""
+    from lightgbm_tpu.loop.__main__ import main
+
+    X, y, Xh, yh = _provider(1)
+    data = str(tmp_path / "train.tsv")
+    hold = str(tmp_path / "holdout.tsv")
+    np.savetxt(data, np.column_stack([y, X]))
+    np.savetxt(hold, np.column_stack([yh, Xh]))
+    params = str(tmp_path / "params.json")
+    with open(params, "w") as fh:
+        json.dump(_PARAMS, fh)
+    live = str(tmp_path / "live.txt")
+    argv = ["--model", live, "--workdir", str(tmp_path / "wd"),
+            "--data", data, "--holdout", hold, "--params", params,
+            "--rounds", "4", "--once", "--force"]
+    # one invocation = bootstrap (live file created) + one forced cycle
+    assert main(argv) == 0
+    j = json.load(open(str(tmp_path / "wd" / "loop_journal.json")))
+    assert j["state"] == "observe" and j["cycle"] == 1
+    assert j["last_outcome"] in ("promoted", "rejected")
+    if j["last_outcome"] == "promoted":
+        sha = model_fingerprint(open(live).read())
+        assert sha == j["published_fingerprint"]
+        assert load_lineage(live, sha) is not None
+    # a second invocation resumes the SAME journal: cycle 2, never a replay
+    assert main(argv) == 0
+    j = json.load(open(str(tmp_path / "wd" / "loop_journal.json")))
+    assert j["cycle"] == 2 and j["state"] == "observe"
+
+
+# ---------------------------------------------------------------------------
+# kill-anywhere: SIGKILL a real controller at every loop.* fault site
+# ---------------------------------------------------------------------------
+
+_CHILD = textwrap.dedent(
+    """
+    import os, sys, json
+    sys.path.insert(0, %r)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    from lightgbm_tpu.loop import AppReplica, LoopConfig, LoopController
+    from lightgbm_tpu.serve.server import ModelRegistry
+
+    wd = sys.argv[1]
+    mode = sys.argv[2]  # boot | cycle | rollback
+    live = os.path.join(wd, "live.txt")
+
+    def provider(cycle):
+        rng = np.random.RandomState(100 + cycle)
+        n = 300
+        shift = 0.0 if cycle == 0 else 1.5
+        X = rng.randn(n, 5) + shift
+        y = ((X[:, 0] - shift) + 0.3 * rng.randn(n) > 0).astype(float)
+        Xh = rng.randn(120, 5) + shift
+        yh = ((Xh[:, 0] - shift) > 0).astype(float)
+        return X, y, Xh, yh
+
+    reg = ModelRegistry()
+    cfg = LoopConfig(
+        model_path=live, workdir=wd,
+        params={"objective": "binary", "num_leaves": 8, "verbosity": -1},
+        num_boost_round=5, data_provider=provider,
+        replicas=[AppReplica(reg)],
+        settle_fn=(lambda c, v: False) if mode == "rollback" else None,
+    )
+    ctl = LoopController(cfg)
+    if mode == "boot":
+        ctl.ensure_bootstrap()
+        print("LOOP-CHILD boot sha=%%s" %% ctl._file_sha(live))
+        sys.exit(0)
+    assert os.path.exists(live), "parent must run boot first"
+    out = ctl.run_cycle(force=True)
+    print("LOOP-CHILD outcome=%%s sha=%%s" %% (out, ctl._file_sha(live)))
+    """
+    % REPO
+)
+
+
+def _run_child(wd, mode, faults=None, timeout=420):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(ENV_FAULTS, None)
+    if faults:
+        env[ENV_FAULTS] = faults
+    return subprocess.run(
+        [sys.executable, "-c", _CHILD, wd, mode],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def _assert_live_untorn(wd):
+    """The atomic-publish invariant: whenever the live file exists it is a
+    COMPLETE model file, never a prefix."""
+    live = os.path.join(wd, "live.txt")
+    if os.path.exists(live):
+        with open(live) as fh:
+            peek_model_header(fh.read())
+
+
+def _journal(wd):
+    """The journal record, or the empty record when the kill landed before
+    the first transition ever wrote one (observe-entry kills)."""
+    try:
+        return json.load(open(os.path.join(wd, "loop_journal.json")))
+    except FileNotFoundError:
+        return {}
+
+
+@pytest.mark.parametrize(
+    "mode,fault,expected",
+    [
+        ("cycle", "loop.observe:1:kill", "promoted"),
+        ("cycle", "loop.retrain:1:kill", "promoted"),
+        ("cycle", "loop.validate:1:kill", "promoted"),
+        # occurrence 1 = publish step entry; occurrence 2 = INSIDE the
+        # atomic rename window of the live-model write (resil/atomic.py
+        # fault_site plumbing)
+        ("cycle", "loop.publish:1:kill", "promoted"),
+        ("cycle", "loop.publish:2:kill", "promoted"),
+        ("cycle", "loop.swap:1:kill", "promoted"),
+        # rollback path: swap #1 is the promote swap, swap #2 the rollback
+        # re-swap; publish #3 is the rollback republish's rename window
+        ("rollback", "loop.swap:2:kill", "rolled_back"),
+        ("rollback", "loop.publish:3:kill", "rolled_back"),
+    ],
+)
+def test_sigkill_at_every_loop_site_then_converge(tmp_path, mode, fault,
+                                                  expected):
+    wd = str(tmp_path)
+    r = _run_child(wd, "boot")
+    assert r.returncode == 0, r.stderr[-2000:]
+    boot_sha = r.stdout.split("sha=")[1].strip()
+
+    r = _run_child(wd, mode, faults=fault)
+    assert r.returncode == -9, (r.returncode, r.stdout, r.stderr[-2000:])
+    assert "LOOP-CHILD outcome" not in r.stdout
+    _assert_live_untorn(wd)
+    # mid-crash the live model is always the old or the (complete) new one
+    live_sha = None
+    if os.path.exists(os.path.join(wd, "live.txt")):
+        with open(os.path.join(wd, "live.txt")) as fh:
+            live_sha = model_fingerprint(fh.read())
+    j = _journal(wd)
+    allowed = {boot_sha, j.get("candidate_fingerprint"),
+               j.get("previous_fingerprint")}
+    assert live_sha in allowed
+
+    # restart: the journaled loop must converge to the expected terminal
+    r = _run_child(wd, mode)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    out = r.stdout.split("outcome=")[1].split()[0]
+    final_sha = r.stdout.split("sha=")[1].strip()
+    assert out == expected
+    j = _journal(wd)
+    assert j["state"] == "observe" and j["last_outcome"] == expected
+    _assert_live_untorn(wd)
+    if expected == "promoted":
+        assert final_sha == j["published_fingerprint"] != boot_sha
+    else:
+        assert final_sha == j["previous_fingerprint"]
+    # no double-publish: exactly ONE completed cycle across kill + restart
+    assert j["cycle"] == 1
+    assert sum(j["outcomes"].values()) == 1

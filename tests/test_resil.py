@@ -39,6 +39,42 @@ def test_backoff_delays_schedule():
     assert list(backoff.delays(1)) == []
 
 
+def test_backoff_jitter_is_seed_reproducible_and_bounded():
+    a = list(backoff.delays(6, base_s=1.0, factor=2.0, max_s=8.0,
+                            jitter=0.3, seed=42))
+    b = list(backoff.delays(6, base_s=1.0, factor=2.0, max_s=8.0,
+                            jitter=0.3, seed=42))
+    c = list(backoff.delays(6, base_s=1.0, factor=2.0, max_s=8.0,
+                            jitter=0.3, seed=7))
+    assert a == b, "same seed must replay the identical schedule"
+    assert a != c, "different seeds must diverge"
+    base = list(backoff.delays(6, base_s=1.0, factor=2.0, max_s=8.0))
+    assert len(a) == len(base)
+    for got, nominal in zip(a, base):
+        assert nominal * 0.7 <= got <= min(nominal * 1.3, 8.0)
+    # jitter without a seed still yields valid, bounded delays
+    for got, nominal in zip(
+        backoff.delays(4, base_s=1.0, jitter=0.5), base
+    ):
+        assert 0.5 * nominal <= got <= min(1.5 * nominal, 8.0)
+
+
+def test_backoff_max_elapsed_budget():
+    # 1 + 2 + 4 = 7 > 5: the third delay is truncated to the remaining 2
+    got = list(backoff.delays(10, base_s=1.0, factor=2.0, max_s=60.0,
+                              max_elapsed_s=5.0))
+    assert got == [1.0, 2.0, 2.0]
+    assert sum(got) == 5.0
+    # a budget smaller than the first delay yields exactly that budget
+    assert list(backoff.delays(10, base_s=4.0, max_elapsed_s=1.5)) == [1.5]
+    # zero budget: no sleeps at all
+    assert list(backoff.delays(10, base_s=1.0, max_elapsed_s=0.0)) == []
+    # jitter + budget compose; total never exceeds the budget
+    tot = sum(backoff.delays(20, base_s=1.0, factor=1.0, jitter=0.2,
+                             seed=3, max_elapsed_s=6.0))
+    assert tot <= 6.0 + 1e-9
+
+
 # ---------------------------------------------------------------------------
 # fault injection
 # ---------------------------------------------------------------------------

@@ -152,6 +152,54 @@ def test_metrics_and_models_endpoints(served):
     assert len(by_name["bin"]["fingerprint"]) == 40
 
 
+def test_lineage_surfaced_in_models_and_predict(served):
+    """A fingerprint-matched .lineage.json sidecar (published by the
+    continuous-training loop) surfaces parent fingerprint + flight-manifest
+    digest on /models AND every /predict response; a sidecar written for
+    different model bytes is ignored (docs/ContinuousTraining.md)."""
+    _, port, app, boosters, extra = served
+    from lightgbm_tpu.models.model_text import model_fingerprint
+
+    path = str(extra["tmp"] / "lin.txt")
+    boosters["bin"].save_model(path)
+    with open(path) as fh:
+        sha = model_fingerprint(fh.read())
+    lineage = {
+        "version": 1, "fingerprint": sha,
+        "parent_fingerprint": "a" * 40,
+        "manifest_digest": "b" * 40, "cycle": 3,
+    }
+    with open(path + ".lineage.json", "w") as fh:
+        json.dump(lineage, fh)
+    status, body = _call(port, "POST", "/models",
+                         {"name": "lin", "path": path})
+    assert status == 200
+    assert body["loaded"]["parent_fingerprint"] == "a" * 40
+    assert body["loaded"]["manifest_digest"] == "b" * 40
+    assert body["loaded"]["published_cycle"] == 3
+    status, models = _call(port, "GET", "/models")
+    info = {i["name"]: i for i in models["models"]}["lin"]
+    assert info["parent_fingerprint"] == "a" * 40
+    status, body = _call(port, "POST", "/predict",
+                         {"rows": extra["Xt"].tolist(), "model": "lin"})
+    assert status == 200
+    assert body["parent_fingerprint"] == "a" * 40
+    assert body["manifest_digest"] == "b" * 40
+    # a model WITHOUT lineage answers with nulls, same schema
+    status, body = _call(port, "POST", "/predict",
+                         {"rows": extra["Xt"].tolist(), "model": "bin"})
+    assert body["parent_fingerprint"] is None
+    assert body["manifest_digest"] is None
+    # fingerprint mismatch: foreign lineage must not be attributed
+    lineage["fingerprint"] = "f" * 40
+    with open(path + ".lineage.json", "w") as fh:
+        json.dump(lineage, fh)
+    status, body = _call(port, "POST", "/models",
+                         {"name": "lin", "path": path})
+    assert status == 200
+    assert body["loaded"]["parent_fingerprint"] is None
+
+
 def test_hot_swap_atomic(served):
     _, port, app, boosters, extra = served
     Xt = extra["Xt"]

@@ -1,0 +1,187 @@
+"""Warm-start bit-identity: the continuous-training bedrock.
+
+The closed-loop controller (lightgbm_tpu/loop/) retrains by warm-starting
+from the live published model (``engine.train(init_model=...)``) on fresh
+data. Its correctness argument — "a retrain is the same run the trainer
+would have produced, continued" — rests on the property proven here: on the
+SAME data and params, training N+M iterations in one run is BYTE-identical
+to training N, saving the model, warm-starting from the file, and training
+M more. That requires three things the init_model path now guarantees
+(docs/ContinuousTraining.md):
+
+  * the score carry is re-seeded by the per-tree f32 replay
+    (``GBDT.warmstart_scores``) — not ``predict_raw``'s f64 accumulation,
+    which lands 1 ulp away on a fraction of rows and forks every later tree;
+  * the serial learner's score add is pinned to plain f32 adds (the same
+    FMA-contraction pin PR 8 gave the data learner), because an FMA'd carry
+    cannot be reproduced from the saved model text at all;
+  * ``_merge_from`` continues the parent run's RNG streams (bagging fold_in
+    position via ``iter_``; the feature_fraction host RNG advanced past the
+    parent's draws).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine
+
+SEED = 13
+
+
+def _data(mode: str, n: int = 260, f: int = 5):
+    rng = np.random.RandomState(SEED)
+    X = rng.randn(n, f)
+    if mode == "multiclass":
+        y = rng.randint(0, 3, n).astype(float)
+    else:
+        y = (X[:, 0] + 0.35 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def _params(mode: str, **extra):
+    p = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+         "min_data_in_leaf": 5}
+    if mode == "multiclass":
+        p.update(objective="multiclass", num_class=3)
+    p.update(extra)
+    return p
+
+
+def _train(params, X, y, rounds, init_model=None, keep=False):
+    return engine.train(
+        dict(params), lgb.Dataset(X, label=y), rounds,
+        init_model=init_model, verbose_eval=False,
+        keep_training_booster=keep,
+    )
+
+
+CASES = [
+    ("binary", {}),
+    ("binary", {"device_chunk_size": 4}),
+    ("binary", {"bagging_fraction": 0.8, "bagging_freq": 1,
+                "feature_fraction": 0.8}),
+    ("binary", {"device_chunk_size": 3, "bagging_fraction": 0.7,
+                "bagging_freq": 2}),
+    ("multiclass", {}),
+    ("multiclass", {"device_chunk_size": 4, "feature_fraction": 0.8}),
+]
+
+
+@pytest.mark.parametrize("mode,extra", CASES)
+def test_warmstart_equals_one_shot(tmp_path, mode, extra):
+    """train(N+M) == train(N) -> save -> init_model warm-start -> train(M),
+    model strings byte-equal — through the FILE round-trip, like the loop
+    controller's retrain."""
+    X, y = _data(mode)
+    params = _params(mode, **extra)
+    N, M = 4, 5
+    one = _train(params, X, y, N + M)
+    first = _train(params, X, y, N)
+    path = str(tmp_path / "n.txt")
+    first.save_model(path)
+    warm = _train(params, X, y, M, init_model=path)
+    assert warm.model_to_string() == one.model_to_string(), (
+        "warm-start drifted from the one-shot run (%s, %r)" % (mode, extra)
+    )
+
+
+def test_warmstart_with_untrained_class_and_feature_fraction(tmp_path):
+    """A multiclass run with a class absent from the labels draws feature
+    masks only for TRAINED classes — the warm-start RNG replay must advance
+    by exactly that count, not K per iteration."""
+    rng = np.random.RandomState(SEED)
+    X = rng.randn(240, 5)
+    y = rng.choice([0.0, 2.0], 240)  # class 1 never occurs
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 6,
+              "verbosity": -1, "feature_fraction": 0.8,
+              "min_data_in_leaf": 5}
+    one = _train(params, X, y, 7)
+    first = _train(params, X, y, 3)
+    path = str(tmp_path / "n.txt")
+    first.save_model(path)
+    warm = _train(params, X, y, 4, init_model=path)
+    assert warm.model_to_string() == one.model_to_string()
+
+
+def test_warmstart_from_in_process_booster(tmp_path):
+    """init_model may also be a live Booster object — same contract."""
+    X, y = _data("binary")
+    params = _params("binary")
+    one = _train(params, X, y, 7)
+    first = _train(params, X, y, 3)
+    warm = _train(params, X, y, 4, init_model=first)
+    assert warm.model_to_string() == one.model_to_string()
+
+
+def test_warmstart_scores_match_live_carry():
+    """The f32 per-tree replay reproduces the trainer's live score carry
+    bit for bit — from the in-process booster AND from the saved text."""
+    X, y = _data("binary")
+    params = _params("binary")
+    bst = _train(params, X, y, 5, keep=True)
+    carry = np.asarray(bst._gbdt.scores)
+    ws = bst._gbdt.warmstart_scores(X)
+    assert ws is not None and np.array_equal(ws, carry)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    ws2 = loaded._gbdt.warmstart_scores(X)
+    assert ws2 is not None and np.array_equal(ws2, carry)
+
+
+def test_warmstart_scores_declines_rf_and_dart():
+    """Carries that are not plain ordered tree sums must return None so
+    callers fall back to the f64 path instead of silently drifting."""
+    X, y = _data("binary", n=120)
+    rf = engine.train(
+        {"objective": "binary", "boosting": "rf", "num_leaves": 6,
+         "bagging_fraction": 0.8, "bagging_freq": 1, "verbosity": -1},
+        lgb.Dataset(X, label=y), 4, verbose_eval=False,
+        keep_training_booster=True,
+    )
+    assert rf._gbdt.warmstart_scores(X) is None
+    dart = engine.train(
+        {"objective": "binary", "boosting": "dart", "num_leaves": 6,
+         "verbosity": -1},
+        lgb.Dataset(X, label=y), 4, verbose_eval=False,
+        keep_training_booster=True,
+    )
+    assert dart._gbdt.warmstart_scores(X) is None
+
+
+def test_warmstart_with_valid_sets_matches_eval_history(tmp_path):
+    """Valid-set carries replay through the same f32 path, so the continued
+    run's eval values — the inputs to early-stopping decisions — equal the
+    one-shot run's boundary-for-boundary."""
+    X, y = _data("binary")
+    rng = np.random.RandomState(SEED + 1)
+    Xv = rng.randn(90, 5)
+    yv = (Xv[:, 0] > 0).astype(float)
+    params = _params("binary")
+
+    def run(rounds, init_model=None):
+        res = {}
+        engine.train(
+            dict(params), lgb.Dataset(X, label=y), rounds,
+            valid_sets=[lgb.Dataset(Xv, label=yv)], valid_names=["v"],
+            init_model=init_model, verbose_eval=False, evals_result=res,
+        )
+        return res
+
+    full = run(9)
+    first = _train(params, X, y, 4)
+    path = str(tmp_path / "n.txt")
+    first.save_model(path)
+    cont = run(5, init_model=path)
+    for metric, vals in full["v"].items():
+        assert vals[4:] == cont["v"][metric], metric
+
+
+def test_resume_and_init_model_still_exclusive(tmp_path):
+    X, y = _data("binary", n=80)
+    with pytest.raises(lgb.LightGBMError):
+        engine.train(
+            _params("binary"), lgb.Dataset(X, label=y), 2,
+            resume_from=str(tmp_path / "no.ckpt"),
+            init_model=str(tmp_path / "no.txt"),
+        )
