@@ -525,6 +525,25 @@ def _run() -> None:
 
     adopt_params, adopt_record = _adopt_from_bringup(platform)
 
+    # histogram autotune adoption (ISSUE 13): a TUNE_HIST.json next to this
+    # file (written by the bringup `tune` stage) is adopted via the env var
+    # GBDT._setup_train consults — unless the operator already pinned a
+    # table or disabled tuning. A table measured on a different backend or
+    # chip family self-filters at load (ops/histogram.resolve_route), so a
+    # CPU-written cache can never route an on-chip run.
+    tune_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "TUNE_HIST.json"
+    )
+    if (
+        os.path.exists(tune_path)
+        and "LIGHTGBM_TPU_HIST_TUNE" not in os.environ
+    ):
+        os.environ["LIGHTGBM_TPU_HIST_TUNE"] = tune_path
+        print(
+            "bench: adopting histogram tune cache %s" % tune_path,
+            file=sys.stderr, flush=True,
+        )
+
     import jax
 
     # persistent compilation cache: the grow_tree program is large (the
@@ -893,6 +912,24 @@ def _run() -> None:
     # records on this (helpers/multichip_bench.py, docs/DataParallel.md)
     extra["n_devices"] = len(jax.devices())
     extra["tree_learner"] = params.get("tree_learner", "serial")
+    # histogram routing provenance (ISSUE 13): bench_diff WARNs (never
+    # FAILs) when two records were measured under different routing — a
+    # tune-table flip must read as a routing change, not a code regression
+    try:
+        from lightgbm_tpu.ops import histogram as _hist_mod
+
+        _route = getattr(booster._gbdt, "_hist_route", None)
+        extra["hist_routing"] = {
+            "impl_default": _hist_mod.default_impl(),
+            "env_impl": _hist_mod._ENV_IMPL or None,
+            "tune_digest": _route.digest if _route is not None else None,
+            "tune_source": (
+                os.path.basename(_route.source)
+                if _route is not None and _route.source else None
+            ),
+        }
+    except Exception as e:
+        print("bench: hist routing stamp failed: %s" % e, file=sys.stderr)
     if predict_rec:
         extra["predict"] = predict_rec
     # the shared structured run report (obs/registry.py): phase gauges, jit

@@ -13,6 +13,10 @@ apply per-metric thresholds and emit a markdown verdict table:
   * ``predict.p99_ms`` rise > 25%                      -> WARN
   * ``growth_segments_s`` share shift > 10 points      -> WARN
   * ``roofline_source`` measured -> analytic           -> WARN
+  * ``hist_routing`` changed (env/default impl or
+    tune-table digest; obs/tune.py)                    -> WARN
+    (a routing flip changes which kernels were measured — the throughput
+    rows then reflect routing, never gated as a code regression)
   * serve drift alert counted / PSI gauge > 0.2        -> WARN
     (serve/drift.py: drifted input invalidates comparisons but is a data
     condition, not a code regression)
@@ -185,6 +189,37 @@ def compare(
         status = WARN if (brs == "measured" and crs != "measured") else PASS
         rows.append(_row("roofline_source", brs, crs, "no measured->analytic",
                          status, ""))
+
+    # histogram routing provenance (obs/tune.py, ISSUE 13): records measured
+    # under different kernel routing (env impl, backend default, or a
+    # different tune-table digest) are comparing different kernels — the
+    # throughput rows then reflect a routing change, not a code regression,
+    # so this WARNs and never FAILs (docs/HistogramRouting.md)
+    bhr, chr_ = baseline.get("hist_routing"), current.get("hist_routing")
+    if bhr is not None or chr_ is not None:
+        def _fmt_routing(h):
+            if not h:
+                return None
+            impl = h.get("env_impl") or h.get("impl_default")
+            dig = h.get("tune_digest")
+            return "%s%s" % (impl, " tune=%s" % dig if dig else "")
+
+        if bhr is None or chr_ is None:
+            # one record predates the routing stamp: nothing to verify —
+            # informational, never noise on every first new-format diff
+            rows.append(_row(
+                "hist_routing", _fmt_routing(bhr), _fmt_routing(chr_),
+                "unchanged", SKIP,
+                "routing provenance absent in one record",
+            ))
+        else:
+            same = _fmt_routing(bhr) == _fmt_routing(chr_)
+            rows.append(_row(
+                "hist_routing", _fmt_routing(bhr), _fmt_routing(chr_),
+                "unchanged", PASS if same else WARN,
+                "" if same else "histogram kernel routing changed — "
+                "throughput deltas reflect routing, not a code regression",
+            ))
 
     # serve feature drift (serve/drift.py): any PSI alert in the current
     # capture, or a tracked PSI gauge above 0.2, is a WARN — drifted input

@@ -63,6 +63,16 @@
 #                               # one seeded mid-publish SIGKILL recovered
 #                               # from the journal — under the full
 #                               # runtime sanitizer
+#   helpers/check.sh --tune     # lint gate, then the histogram-autotuner
+#                               # smoke: sweep a tiny bucket-shape set on
+#                               # CPU, persist + reload the tune cache,
+#                               # gate the measured win (tuned route no
+#                               # slower than the static default at every
+#                               # swept shape, strictly faster at >= 1),
+#                               # and prove the routing machinery is
+#                               # bit-transparent (default-pinned table ==
+#                               # untuned bytes; same-table reruns and
+#                               # chunk=1-vs-4 byte-identical)
 #   helpers/check.sh --bench-diff [CUR BASE]
 #                               # the bench regression gate: golden-fixture
 #                               # self-test (synthetic regression must FAIL,
@@ -81,9 +91,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -165,6 +175,11 @@ fi
 if [ "$MODE" = "--loop" ]; then
     echo "== loop smoke (drift -> retrain -> validate -> publish -> swap + SIGKILL recovery) =="
     exec env JAX_PLATFORMS=cpu python helpers/loop_smoke.py
+fi
+
+if [ "$MODE" = "--tune" ]; then
+    echo "== tune smoke (sweep + cache round-trip + perf gate + bit-transparency) =="
+    exec env JAX_PLATFORMS=cpu python helpers/tune_smoke.py
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

@@ -78,6 +78,10 @@ STAGE_TIMEOUTS = {
     "pack4": 900,      # nibble-packing measurement (VERDICT r3 item 8)
     "smoke": 1800,     # bucket-lattice switch compile at 100k rows
     "smoke_seq": 1800,  # sequential grower (spec-batch win measurement)
+    "tune": 1800,   # histogram autotune sweep: every supported impl raced
+                    # at the grower's bucket-shape distribution, persisted
+                    # as TUNE_HIST.json for bench/training auto-adoption
+                    # (obs/tune.py, ISSUE 13)
     "bench_early": 3600,  # headline secured before the long tail of stages
     "smoke_pallas": 1800,  # same smoke, pallas histogram impl (routing race)
     "smoke_xla_radix": 1800,  # same smoke, plain-XLA radix factorization
@@ -727,6 +731,42 @@ def run_loop(stage: str = "loop") -> dict:
     )
 
 
+def run_tune(stage: str = "tune") -> dict:
+    """Histogram autotune sweep (obs/tune.py, ISSUE 13) — a child process
+    (`python -m lightgbm_tpu.obs.tune`, driver stays jax-free) races every
+    supported histogram impl (xla / xla_radix / scatter / pallas /
+    pallas_packed4, gated by impl_supported + the chip's CHIP_PEAKS
+    vmem_bytes) at the bucket-shape distribution the grower emits for the
+    1M bench geometry, and atomically persists TUNE_HIST.json. Running it
+    BEFORE bench_early means the very next bench worker — and every
+    training that adopts LIGHTGBM_TPU_HIST_TUNE — routes each shape class
+    to its measured winner unattended (docs/HistogramRouting.md)."""
+    env = dict(os.environ)
+    out = os.path.join(REPO, "TUNE_HIST.json")
+    if _REHEARSAL:
+        # a CPU rehearsal must never publish the production tune cache:
+        # bench.py auto-adopts TUNE_HIST.json, and although a CPU-backend
+        # table self-filters on chip (resolve_route), it WOULD route the
+        # relay-down CPU-fallback benches — same isolation rule as the
+        # rehearsal summary file
+        env["JAX_PLATFORMS"] = "cpu"
+        out = os.path.join(REPO, "TUNE_HIST_REHEARSAL.json")
+    result = _run_child(
+        stage,
+        [sys.executable, "-m", "lightgbm_tpu.obs.tune",
+         "--out", out,
+         # trained histogram widths are num_bin <= max_bin (binning.py), so
+         # the route keys the grower actually emits at the bench geometry
+         # are 255 (max_bin=255), 63, and 15 (packed4 territory, B<=16) —
+         # NOT the round powers of two, which would never match a call
+         "--rows", "1048576", "--bins", "15,63,255", "--features", "28",
+         "--dtypes", "float32,bfloat16", "--repeats", "3"],
+        env=env,
+    )
+    result.setdefault("ok", bool(result.get("digest")))
+    return result
+
+
 def run_bench(stage: str = "bench") -> dict:
     env = dict(os.environ)
     env.pop("BENCH_FORCE_PLATFORMS", None)
@@ -840,12 +880,18 @@ def main() -> int:
     for stage, src in (("matmul", MATMUL), ("pallas", PALLAS),
                        ("smoke", SMOKE),
                        ("smoke_seq", SMOKE_SEQ),
-                       # headline FIRST: the relay has died mid-bringup in
-                       # three of four rounds; with smoke+smoke_seq in the
-                       # summary the bench already auto-adopts the better
-                       # grower, so the 1M number is secured before the
-                       # measurement tail (the final bench re-runs with the
-                       # full bake-off and overwrites)
+                       # histogram autotune BEFORE the headline: the sweep
+                       # persists TUNE_HIST.json, so bench_early (and every
+                       # later training) already routes each bucket shape
+                       # to its measured winner (obs/tune.py, ISSUE 13)
+                       ("tune", "TUNE"),
+                       # headline FIRST after routing is measured: the
+                       # relay has died mid-bringup in three of four
+                       # rounds; with smoke+smoke_seq in the summary the
+                       # bench already auto-adopts the better grower, so
+                       # the 1M number is secured before the measurement
+                       # tail (the final bench re-runs with the full
+                       # bake-off and overwrites)
                        ("bench_early", None),
                        ("smoke_pallas", SMOKE_PALLAS),
                        ("smoke_bf16", SMOKE_BF16),
@@ -875,6 +921,8 @@ def main() -> int:
         with _stage_span(stage):
             if src == "MULTICHIP":
                 runner = lambda s=stage: run_multichip(s)  # noqa: E731
+            elif src == "TUNE":
+                runner = lambda s=stage: run_tune(s)  # noqa: E731
             elif src == "SAN":
                 runner = lambda s=stage: run_san(s)  # noqa: E731
             elif src == "LOOP":

@@ -1,0 +1,188 @@
+"""Histogram-autotuner smoke: the `check.sh --tune` gate (ISSUE 13).
+
+ONE invocation proves the whole tune-cache lifecycle on CPU:
+
+ 1. SWEEP — measure every supported impl at the grower's bucket-shape
+    distribution for a small training geometry (obs/tune.py), write the
+    cache atomically, reload it (digest + schema round-trip).
+ 2. PERF GATE — the acceptance criterion, from the sweep's own recorded
+    medians: the tuned route is no slower than the static default impl at
+    EVERY swept shape, and strictly faster (>= 1.1x) at >= 1 — the
+    measured CPU win the static route was leaving on the table (the
+    scatter default loses up to ~9x at small-bucket shapes on this class
+    of box; the r5 on-silicon notes found the same inversion for TPU
+    small buckets).
+ 3. EXACTNESS — (a) retraining under a DEFAULT-PINNED table (every entry
+    = the backend default impl) is BIT-IDENTICAL to the untuned run: the
+    routing machinery itself adds zero arithmetic change; (b) two
+    trainings under the real winners table are byte-identical
+    (frozen-per-run determinism); (c) chunk=1 vs device_chunk_size=4
+    match under BOTH tables (the device-resident contract holds under
+    routing; parameters footers stripped — device_chunk_size echoes
+    there).
+
+Run under JAX_PLATFORMS=cpu (check.sh does). Emits a one-line JSON verdict
+for the bringup driver.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.obs import tune  # noqa: E402
+from lightgbm_tpu.ops import histogram as hist_mod  # noqa: E402
+
+N_ROWS, N_FEAT, MAX_BIN = 3000, 8, 63
+ROUNDS = 8
+PARAMS = {
+    "objective": "binary", "num_leaves": 15, "max_bin": MAX_BIN,
+    "learning_rate": 0.1, "verbosity": -1, "min_data_in_leaf": 5,
+}
+# strict-win threshold: the observed inversions are 1.3x-9x, so 1.1x keeps
+# the gate meaningful while riding above scheduler noise
+STRICT_WIN = 1.1
+
+
+def _data():
+    rng = np.random.RandomState(7)
+    X = rng.randn(N_ROWS, N_FEAT)
+    y = (X[:, 0] + 0.5 * rng.randn(N_ROWS) > 0).astype(np.float64)
+    return X, y
+
+
+def _strip_params(model_str: str) -> str:
+    """Trees + feature metadata only — the parameters footer echoes
+    device_chunk_size and legitimately differs across chunk settings."""
+    return model_str.split("parameters:")[0]
+
+
+def _train(X, y, extra=None):
+    p = dict(PARAMS)
+    p.update(extra or {})
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=ROUNDS)
+    return bst.model_to_string()
+
+
+def _perf_gate(table):
+    """(ok, worst_ratio, best_ratio, details): winner vs the static default
+    from the sweep's recorded per-impl medians."""
+    default = hist_mod.default_impl()
+    worst = float("inf")
+    best = 0.0
+    details = []
+    for e in table["entries"]:
+        times = e.get("times_ms") or {}
+        if default not in times:
+            return False, 0.0, 0.0, ["default %r not swept at %s" %
+                                     (default, e)]
+        ratio = times[default] / times[e["impl"]]
+        worst = min(worst, ratio)
+        best = max(best, ratio)
+        details.append(
+            "B=%d rows=%d: %s %.3fms vs %s %.3fms (%.2fx)"
+            % (e["B"], e["rows_bucket"], e["impl"], times[e["impl"]],
+               default, times[default], ratio)
+        )
+    # the winner is the per-shape argmin, so >= 1.0 everywhere holds by
+    # construction when the default was raced; the strict-win clause is the
+    # real measurement
+    ok = worst >= 1.0 and best >= STRICT_WIN
+    return ok, worst, best, details
+
+
+def main() -> int:
+    X, y = _data()
+    with tempfile.TemporaryDirectory(prefix="tune_smoke_") as td:
+        winners_path = os.path.join(td, "TUNE_HIST.json")
+        pinned_path = os.path.join(td, "TUNE_PINNED.json")
+
+        # ---- 1. sweep + persist + reload -------------------------------
+        shapes = tune.sweep_shapes(N_ROWS, [MAX_BIN], N_FEAT)
+        # two attempts absorb a noisy first measurement pass on a loaded box
+        for attempt in range(2):
+            table = tune.sweep(shapes, repeats=3)
+            perf_ok, worst, best_ratio, details = _perf_gate(table)
+            if perf_ok:
+                break
+        tune.save_table(table, winners_path)
+        reloaded = tune.load_table(winners_path)
+        assert reloaded["digest"] == table["digest"], "round-trip digest"
+        print("tune-smoke: sweep %d shapes -> %d entries, digest %s"
+              % (len(shapes), len(table["entries"]), table["digest"]))
+        for line in details:
+            print("tune-smoke:   " + line)
+        assert perf_ok, (
+            "perf gate failed: tuned route must be no slower everywhere "
+            "(worst ratio %.3f) and >= %.1fx faster somewhere (best %.3f)"
+            % (worst, STRICT_WIN, best_ratio)
+        )
+        print("tune-smoke: PERF GATE ok (worst %.2fx, best %.2fx vs "
+              "default %r)" % (worst, best_ratio, hist_mod.default_impl()))
+
+        # ---- 2. routing machinery is bit-transparent -------------------
+        default = hist_mod.default_impl()
+        pinned = tune.build_table(
+            [dict(e, impl=default) for e in table["entries"]]
+        )
+        tune.save_table(pinned, pinned_path)
+        untuned = _train(X, y)
+        under_pinned = _train(X, y, {"hist_tune": pinned_path})
+        assert under_pinned == untuned, (
+            "default-pinned table must train BIT-IDENTICAL to the untuned "
+            "run — the routing seam itself leaked an arithmetic change"
+        )
+        print("tune-smoke: default-pinned table bit-identical to untuned")
+
+        # ---- 3. frozen-per-run determinism + chunk contract ------------
+        tuned_a = _train(X, y, {"hist_tune": winners_path})
+        tuned_b = _train(X, y, {"hist_tune": winners_path})
+        assert tuned_a == tuned_b, "same-table reruns must be byte-identical"
+        routed = tuned_a != untuned
+        # the perf gate above proved a non-default winner exists, so the
+        # winners table MUST change routed arithmetic — a vacuous pass here
+        # (key mismatch, broken pick lookup) would leave every exactness
+        # check below comparing the untuned run against itself
+        assert routed, (
+            "winners table with non-default impls never engaged the route "
+            "— the smoke's exactness checks would be vacuous"
+        )
+        print("tune-smoke: winners-table determinism ok (route engaged)")
+        chunk_pinned = _train(
+            X, y, {"hist_tune": pinned_path, "device_chunk_size": 4}
+        )
+        assert _strip_params(chunk_pinned) == _strip_params(untuned), (
+            "chunk=4 under the pinned table diverged from chunk=1"
+        )
+        chunk_tuned = _train(
+            X, y, {"hist_tune": winners_path, "device_chunk_size": 4}
+        )
+        assert _strip_params(chunk_tuned) == _strip_params(tuned_a), (
+            "chunk=4 under the winners table diverged from chunk=1"
+        )
+        print("tune-smoke: chunk=1 vs chunk=4 identical under both tables")
+
+        print(json.dumps({
+            "ok": True, "entries": len(table["entries"]),
+            "digest": table["digest"],
+            "perf_worst_ratio": round(worst, 3),
+            "perf_best_ratio": round(best_ratio, 3),
+            "route_engaged": bool(routed),
+            "winners": {str(e["rows_bucket"]): e["impl"]
+                        for e in table["entries"]},
+        }), flush=True)
+        print("TUNE-SMOKE PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

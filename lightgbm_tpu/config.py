@@ -384,6 +384,15 @@ class Config:
     # and the native CPU learner fall back to 1 automatically
     # (docs/DeviceResidentBoosting.md).
     device_chunk_size: int = 1
+    # Histogram kernel autotune cache: path to a measured shape->impl
+    # routing table (written by `python -m lightgbm_tpu.obs.tune` /
+    # the bringup `tune` stage; docs/HistogramRouting.md). "" consults the
+    # LIGHTGBM_TPU_HIST_TUNE env var; "off" disables both. The table is
+    # FROZEN per training run at setup; run provenance (not model
+    # semantics), so it is excluded from the model's parameters footer
+    # (NON_MODEL_PARAMS) and stamped into the flight manifest as a digest
+    # instead.
+    hist_tune: str = ""
 
     # resolved, not user-set
     is_parallel: bool = False
@@ -508,6 +517,14 @@ class Config:
 
     def to_dict(self) -> Dict[str, Any]:
         return {f.name: getattr(self, f.name) for f in dataclasses.fields(self)}
+
+
+#: Config fields that are run provenance, not model semantics: the model
+#: text's parameters footer skips them (models/model_text.py) so artifact
+#: bytes cannot depend on where a tune cache happened to live — the tuned
+#: run's identity is the flight manifest's hist_route_digest instead
+#: (docs/HistogramRouting.md).
+NON_MODEL_PARAMS = frozenset({"hist_tune"})
 
 
 def coerce_bool(v: Any) -> bool:

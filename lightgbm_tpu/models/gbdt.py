@@ -33,6 +33,7 @@ from ..obs import memwatch, retrace as retrace_mod
 from ..objective import ObjectiveFunction
 from ..ops import grow_native
 from ..ops.grow import grow_tree, grow_tree_scan, spec_batch_slots
+from ..ops.histogram import route_rows_variant as hist_route_rows_variant
 from ..ops.predict import PredictTree, make_predict_tree, tree_predict_value
 from ..ops.split import CegbParams, SplitParams
 from ..utils import log
@@ -101,6 +102,9 @@ class GBDT:
         self.valid_metrics: List[List[Metric]] = []
         self.valid_names: List[str] = []
         self._eval_history: Dict[str, Dict[str, List[float]]] = {}
+        # frozen per-run histogram routing (ops/histogram.HistRoute); set by
+        # _setup_train — predict-only boosters keep None (no histograms)
+        self._hist_route = None
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -149,6 +153,14 @@ class GBDT:
         self.num_group_bins = (
             int(train_set.max_group_bins) if train_set.is_bundled else None
         )
+        # FREEZE the histogram tune route for this training run: a pure
+        # function of (call shape, this object) from here on — the tune
+        # cache being rewritten mid-process (a bringup window racing a
+        # training job) can never change a run that already set up. The
+        # frozen object rides every grow_tree/train_chunk jit static key
+        # and is stamped (digest) into the flight manifest
+        # (docs/HistogramRouting.md).
+        self._hist_route = self._resolve_hist_route()
         self.split_params = SplitParams(
             lambda_l1=cfg.lambda_l1,
             lambda_l2=cfg.lambda_l2,
@@ -208,6 +220,26 @@ class GBDT:
         # named memwatch point: the binned matrix + training carries are now
         # resident (gated on LIGHTGBM_TPU_MEMWATCH; obs/memwatch.py)
         memwatch.auto_snapshot("post_bin")
+
+    def _resolve_hist_route(self):
+        """Load + freeze the measured histogram routing table for this run.
+
+        Source precedence: the ``hist_tune`` param (explicit path — load
+        failures raise), then the LIGHTGBM_TPU_HIST_TUNE env var (ambient
+        adoption, e.g. bench/bringup — failures warn once and fall back to
+        static routing); ``hist_tune="off"`` disables both. The loaded
+        table is filtered to this backend + device family and to impls
+        that can actually serve each shape (ops/histogram.resolve_route).
+        """
+        from ..obs import tune as tune_mod
+        from ..ops import histogram as hist_mod
+
+        table, src = tune_mod.active_table(
+            getattr(self.config, "hist_tune", "")
+        )
+        if table is None:
+            return None
+        return hist_mod.resolve_route(table, source=src)
 
     def _setup_cegb(self, train_set: BinnedDataset) -> None:
         """CEGB penalty vectors mapped onto used features (config.h:389-405)."""
@@ -941,6 +973,7 @@ class GBDT:
             cfg.num_leaves, cfg.max_depth, self.num_bins, self.num_group_bins,
             self.split_params, cfg.tpu_hist_chunk, cfg.tpu_hist_dtype,
             cfg.tpu_hist_mode, self._two_way, self._forced_splits,
+            self._hist_route,
             ("data", int(mesh.shape["data"])) if sharded else None,
         )
         fn = self._chunk_fns.get(key)
@@ -958,7 +991,7 @@ class GBDT:
             two_way=self._two_way, forced_splits=self._forced_splits,
             cegb=self.cegb_params, cegb_state=None, hist_buf=None,
             bins_nf=None if sharded else self.bins_dev_nf,
-            hist_pool_slots=slots,
+            hist_pool_slots=slots, hist_route=self._hist_route,
         )
         if sharded:
             grow_kwargs["axis_name"] = "data"
@@ -1236,6 +1269,7 @@ class GBDT:
             hist_dtype=cfg.tpu_hist_dtype,
             hist_mode=cfg.tpu_hist_mode,
             two_way=self._two_way,
+            hist_route=self._hist_route,
         )
         cegb_on = self.cegb_params.enabled
         # LRU pool cap, honored by every learner (the reference's
@@ -1281,6 +1315,11 @@ class GBDT:
                 M, hist_mode=cfg.tpu_hist_mode,
                 has_lazy_cegb=self.cegb_params.has_lazy,
                 pooled=slots is not None and slots < M, cegb_on=cegb_on,
+                route_rows_variant=hist_route_rows_variant(
+                    self._hist_route,
+                    num_bins=self.num_group_bins or self.num_bins,
+                    hist_dtype=cfg.tpu_hist_dtype, n_rows=self.num_data,
+                ),
             ):
                 sbuf = getattr(self, "_spec_buf", None)
                 if sbuf is None or sbuf.shape != (M, F, self.num_bins, 3):

@@ -132,8 +132,15 @@ def save_model_to_string(gbdt, start_iteration: int = 0, num_iteration: int = -1
         # (gbdt_model_text.cpp:328-331)
         body += gbdt.loaded_parameter + "\n"
     else:
+        from ..config import NON_MODEL_PARAMS
+
         cfg = gbdt.config
         for k, v in cfg.to_dict().items():
+            if k in NON_MODEL_PARAMS:
+                # run provenance (e.g. the hist_tune cache path), not model
+                # semantics: keeping it out pins model bytes to the model,
+                # not to where a tune cache lived (docs/HistogramRouting.md)
+                continue
             if isinstance(v, list):
                 v = ",".join(str(x) for x in v)
             body += "[%s: %s]\n" % (k, v)

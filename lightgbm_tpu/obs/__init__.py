@@ -28,6 +28,10 @@ report); the serve-side drift monitor lives in serve/drift.py. One spine:
    (``LIGHTGBM_TPU_SAN=transfer,nan,locks``): transfer guards at the jitted
    dispatch seams, NaN tripwires on the score carries, lock-order inversion
    detection (docs/StaticAnalysis.md §Runtime sanitizer).
+ * :mod:`~lightgbm_tpu.obs.tune`     — the shape-aware histogram autotuner
+   (``python -m lightgbm_tpu.obs.tune``): measured per-shape kernel
+   routing tables, atomically persisted, frozen per training run
+   (docs/HistogramRouting.md). Imported lazily (it pulls ops/ on use).
 
 Importing this package never touches a jax backend.
 """

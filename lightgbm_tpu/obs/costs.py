@@ -140,6 +140,9 @@ def chip_peaks(
         "peak_flops": rec["peak_flops"],
         "peak_flops_bf16": rec["peak_flops_bf16"],
         "peak_bw": rec["peak_bw"],
+        # the Pallas scoped-VMEM ceiling: the histogram autotuner
+        # (obs/tune.py) gates pallas contenders on it before timing them
+        "vmem_bytes": rec["vmem_bytes"],
         "chip": label,
         "assumed": assumed,
     }

@@ -454,6 +454,10 @@ def _build_kernels(gbdt):
     max_depth = cfg.max_depth
     chunk = cfg.tpu_hist_chunk
     hist_dtype = cfg.tpu_hist_dtype
+    # the run's FROZEN histogram route: every per-shard segment must trace
+    # the exact kernels the fused data-parallel program routed to, or the
+    # bitwise-identity proof against it compares different arithmetic
+    hist_route = getattr(gbdt, "_hist_route", None)
     f32 = jnp.float32
     neg_inf = jnp.float32(-jnp.inf)
     mono_arr = feature_meta["monotone"].astype(jnp.int32)
@@ -474,7 +478,8 @@ def _build_kernels(gbdt):
     def root_local_body(grad, hess, bag, bins_l):
         vals_all = leaf_values(grad, hess, bag)
         lhist = leaf_histogram(
-            bins_l, vals_all, B, chunk=chunk, hist_dtype=hist_dtype
+            bins_l, vals_all, B, chunk=chunk, hist_dtype=hist_dtype,
+            route=hist_route,
         )
         lsum = jnp.stack([
             jnp.sum(grad * bag), jnp.sum(hess * bag), jnp.sum(bag),
@@ -667,6 +672,7 @@ def _build_kernels(gbdt):
         kern = make_bucket_kernels(
             bins_l, meta, B, num_group_bins=None, bins_nf=None,
             chunk=chunk, hist_dtype=hist_dtype, kb=0,
+            hist_route=hist_route,
         )
         lb = lb1[0]
         lp = lp1[0]
@@ -700,6 +706,7 @@ def _build_kernels(gbdt):
         kern = make_bucket_kernels(
             bins_l, meta, B, num_group_bins=None, bins_nf=None,
             chunk=chunk, hist_dtype=hist_dtype, kb=0,
+            hist_route=hist_route,
         )
         lb = lb1[0]
         lp = lp1[0]

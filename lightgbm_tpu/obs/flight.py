@@ -245,6 +245,15 @@ def build_manifest(
     }
     if mesh is not None:
         man["mesh"] = mesh
+    # the frozen histogram tune route (ops/histogram.HistRoute, ISSUE 13):
+    # the digest IS the run's routing identity — two flight logs with equal
+    # digests trained under byte-identical kernel routing, and bench_diff
+    # treats a digest change as "throughput rows reflect routing, not
+    # regression" (docs/HistogramRouting.md)
+    route = getattr(gbdt, "_hist_route", None)
+    if route is not None:
+        man["hist_route_digest"] = route.digest
+        man["hist_tune_source"] = route.source
     if resume_from:
         man["resume_from"] = str(resume_from)
         man["resumed_at_iteration"] = int(gbdt.iter_)

@@ -191,6 +191,7 @@ def attribute_training(gbdt) -> Dict[str, object]:
         "donated": getattr(gbdt, "_hist_buf", None) is not None,
     }
     from ..ops.grow import spec_batch_slots
+    from ..ops.histogram import route_rows_variant
 
     kb = spec_batch_slots(
         M,
@@ -198,6 +199,12 @@ def attribute_training(gbdt) -> Dict[str, object]:
         has_lazy_cegb=gbdt.cegb_params.has_lazy,
         pooled=slots is not None and slots < M,
         cegb_on=gbdt.cegb_params.enabled,
+        route_rows_variant=route_rows_variant(
+            getattr(gbdt, "_hist_route", None),
+            num_bins=getattr(gbdt, "num_group_bins", None) or B,
+            hist_dtype=cfg.tpu_hist_dtype,
+            n_rows=getattr(gbdt, "num_data", None),
+        ),
     )
     if kb:
         out["spec_rhist"] = {

@@ -208,13 +208,17 @@ def _build_kernels(gbdt):
     max_depth = cfg.max_depth
     chunk = cfg.tpu_hist_chunk
     hist_dtype = cfg.tpu_hist_dtype
+    # the run's FROZEN histogram route: the segmented kernels must resolve
+    # every shape class to the same impl the fused grower traced, or the
+    # bitwise-identity proof below would compare different arithmetic
+    hist_route = getattr(gbdt, "_hist_route", None)
     f32 = jnp.float32
     neg_inf = jnp.float32(-jnp.inf)
     mono_arr = feature_meta["monotone"].astype(jnp.int32)
 
     kern = make_bucket_kernels(
         bins, feature_meta, B, num_group_bins=None, bins_nf=bins_nf,
-        chunk=chunk, hist_dtype=hist_dtype, kb=0,
+        chunk=chunk, hist_dtype=hist_dtype, kb=0, hist_route=hist_route,
     )
 
     def depth_gate(gain, depth):
@@ -233,7 +237,8 @@ def _build_kernels(gbdt):
     def root_fn(grad, hess, bag_mask, fmask):
         vals_all = leaf_values(grad, hess, bag_mask)
         root_hist = leaf_histogram(
-            bins, vals_all, B, chunk=chunk, hist_dtype=hist_dtype
+            bins, vals_all, B, chunk=chunk, hist_dtype=hist_dtype,
+            route=hist_route,
         )
         root_g = jnp.sum(grad * bag_mask)
         root_h = jnp.sum(hess * bag_mask)
@@ -576,8 +581,18 @@ def profile_growth(booster_or_gbdt, iters: int = 2,
         params=gbdt.split_params, chunk=cfg.tpu_hist_chunk,
         hist_dtype=cfg.tpu_hist_dtype, hist_mode="bucketed",
         two_way=gbdt._two_way, bins_nf=gbdt.bins_dev_nf,
+        hist_route=getattr(gbdt, "_hist_route", None),
     )
-    kb = spec_batch_slots(cfg.num_leaves, hist_mode="bucketed")
+    from ..ops.histogram import route_rows_variant as _rrv
+
+    kb = spec_batch_slots(
+        cfg.num_leaves, hist_mode="bucketed",
+        route_rows_variant=_rrv(
+            getattr(gbdt, "_hist_route", None), num_bins=gbdt.num_bins,
+            hist_dtype=cfg.tpu_hist_dtype,
+            n_rows=int(gbdt.bins_dev.shape[1]),
+        ),
+    )
     book = SegmentBook()
     warm_book = SegmentBook()  # warmup pass: compiles land here, not in the record
     fused_s = 0.0
@@ -623,7 +638,8 @@ def profile_growth(booster_or_gbdt, iters: int = 2,
              jax.ShapeDtypeStruct((gbdt.bins_dev.shape[1], 3),
                                   np.float32),
              gbdt.num_bins),
-            dict(chunk=cfg.tpu_hist_chunk, hist_dtype=cfg.tpu_hist_dtype),
+            dict(chunk=cfg.tpu_hist_chunk, hist_dtype=cfg.tpu_hist_dtype,
+                 route=getattr(gbdt, "_hist_route", None)),
         )
 
     per_tree = {

@@ -54,6 +54,7 @@ from .histogram import (
     histogram_source,
     leaf_histogram,
     leaf_values,
+    route_effective_impls,
 )
 from .split import (
     MISSING_NAN,
@@ -108,6 +109,7 @@ def spec_batch_slots(
     cegb_on: bool = False,
     use_subtract: bool = True,
     custom_split: bool = False,
+    route_rows_variant: bool = False,
 ) -> int:
     """Speculative-batch width grow_tree will trace with (0 = sequential).
 
@@ -115,11 +117,19 @@ def spec_batch_slots(
     KB from this, and callers that allocate the donated ``spec_buf`` carry
     (models/gbdt.py) or attribute its HBM footprint (obs/memwatch.py) call
     it with the same arguments so they can never disagree with the trace.
+
+    ``route_rows_variant`` (histogram.route_rows_variant of the run's frozen
+    tune route) declines spec mode: the spec batch histograms candidates at
+    the batch-max bucket size, so a route whose impl choice varies with the
+    row bucket would let the same logical segment take different kernels in
+    the fused (spec) vs segmented (W=1) programs — breaking the profiler's
+    bitwise-identity proof (docs/HistogramRouting.md §Exactness).
     """
     bucketed = hist_mode == "bucketed" and not has_lazy_cegb and num_leaves > 1
     spec_ok = (
         bucketed and not pooled and not cegb_on and use_subtract
-        and not custom_split and _ENV_SPLIT_IMPL != "pallas"
+        and not custom_split and not route_rows_variant
+        and _ENV_SPLIT_IMPL != "pallas"
     )
     if _ENV_GROW == "seq":
         kb = 0
@@ -295,6 +305,29 @@ def _ceil_log2(n: int) -> int:
 MIN_BUCKET_LOG2 = 8  # smallest gathered-segment bucket (256 rows)
 
 
+def bucket_sizes(N: int) -> Tuple[int, ...]:
+    """The gathered-segment bucket lattice for an ``N``-row dataset: the
+    {2^k} ∪ {3·2^(k-1)} family (x1.33/x1.5 steps, capping round-up waste at
+    33% where pure powers of two waste up to 2x), honoring the import-time
+    LIGHTGBM_TPU_LATTICE compile-cost knob.
+
+    THE shape distribution the bucketed grower emits histogram calls at —
+    shared by ``make_bucket_kernels`` (the lax.switch branch set) and the
+    histogram autotuner's sweep (obs/tune.py), which must measure exactly
+    these shapes for its routing table to describe real work."""
+    step = 2 if _ENV_LATTICE == "coarse" else 1
+    sizes = {
+        min(1 << b, N)
+        for b in range(MIN_BUCKET_LOG2, _ceil_log2(N) + 1, step)
+    }
+    if _ENV_LATTICE == "":
+        sizes |= {
+            min(3 << b, N)
+            for b in range(MIN_BUCKET_LOG2 - 1, _ceil_log2(N) + 1)
+        }
+    return tuple(sorted(sizes | {N}))
+
+
 def _branch_steps(cap: int):
     """Branch-size family up to ``cap``, honoring the same
     LIGHTGBM_TPU_LATTICE compile-cost knob as the bucket lattice:
@@ -338,6 +371,7 @@ def make_bucket_kernels(
     hist_dtype: str = "float32",
     feature_sharded: bool = False,
     kb: int = 0,
+    hist_route=None,
 ) -> BucketKernels:
     """Build the bucketed partition / segment-histogram kernels for one
     dataset layout. ``kb`` is the speculative-batch width the caller will
@@ -347,7 +381,15 @@ def make_bucket_kernels(
     the sequential segment profiler (obs/prof.py), and the SHARDED
     segment profiler (obs/dist.py), which traces these same kernels
     per-shard inside shard_map bodies so its local-compute segments are
-    op-identical to the fused data-parallel program's."""
+    op-identical to the fused data-parallel program's.
+
+    ``hist_route`` is the run's frozen histogram tune route
+    (ops/histogram.HistRoute) — THIS is the one seam that hands the
+    measured per-shape routing to every consumer at once: each bucket
+    branch's leaf_histogram call resolves its impl from the route at trace
+    time, keyed on that branch's static segment size, so the fused grower,
+    both profilers and the sharded path can never disagree on which kernel
+    a shape class runs (docs/HistogramRouting.md)."""
     N = bins.shape[1]
     B = num_bins
     F = feature_meta["num_bin"].shape[0]
@@ -382,17 +424,8 @@ def make_bucket_kernels(
     # _ENV_LATTICE (import-time, like histogram._ENV_IMPL) trades bounded
     # histogram over-work for lax.switch branch count and therefore
     # first-contact compile time (20-40s+ per branch class on TPU).
-    step = 2 if _ENV_LATTICE == "coarse" else 1
-    sizes = {
-        min(1 << b, N)
-        for b in range(MIN_BUCKET_LOG2, _ceil_log2(N) + 1, step)
-    }
-    if _ENV_LATTICE == "":
-        sizes |= {
-            min(3 << b, N)
-            for b in range(MIN_BUCKET_LOG2 - 1, _ceil_log2(N) + 1)
-        }
-    SIZES = sorted(sizes | {N})
+    # bucket_sizes is also the autotuner's sweep distribution (obs/tune.py).
+    SIZES = list(bucket_sizes(N))
     sizes_arr = jnp.asarray(SIZES, jnp.int32)
 
     # flat-partition branch lattice over 256-row units, up to the worst
@@ -542,7 +575,7 @@ def make_bucket_kernels(
                 return jax.vmap(
                     lambda b, v: leaf_histogram(
                         b, v, B_hist, chunk=chunk, hist_dtype=hist_dtype,
-                        feature_sharded=feature_sharded,
+                        feature_sharded=feature_sharded, route=hist_route,
                     )
                 )(b_seg, vals)
 
@@ -575,7 +608,7 @@ _NODE_I_COLS = np.array([0, 1, 2, 3, 2, 3], np.int32)
         "num_leaves", "max_depth", "num_bins", "params", "num_group_bins",
         "chunk", "axis_name", "split_fn", "psum_hist", "forced_splits", "cegb",
         "cegb_rescan", "hist_mode", "hist_dtype", "two_way", "feature_sharded",
-        "hist_pool_slots", "use_subtract",
+        "hist_pool_slots", "use_subtract", "hist_route",
     ),
     donate_argnames=("hist_buf", "spec_buf"),
 )
@@ -608,6 +641,7 @@ def grow_tree(
     hist_pool_slots: Optional[int] = None,
     use_subtract: bool = True,
     spec_buf: Optional[jax.Array] = None,
+    hist_route=None,
 ):
     """Grow one tree; returns (TreeArrays, leaf_id [N]).
 
@@ -651,6 +685,11 @@ def grow_tree(
     is gated on the ``spec_flag`` carry, which starts all-False). Returned
     aliased as the LAST output element so the caller can re-donate;
     allocate it only when :func:`spec_batch_slots` says spec mode engages.
+    ``hist_route``: the run's frozen histogram tune route
+    (ops/histogram.HistRoute, frozen at GBDT._setup_train) — static, so
+    the compiled program's identity includes the table it routed under;
+    every leaf_histogram this tree traces resolves its impl from it
+    (docs/HistogramRouting.md).
     """
     retrace_mod.note_trace("ops.grow_tree")  # runs once per real XLA trace
     N = bins.shape[1]
@@ -715,6 +754,11 @@ def grow_tree(
     # histogram pools (slot state is per-split), custom split searches
     # (may contain collectives that don't vmap), masked mode, and the
     # use_subtract=False oracle.
+    # the impl set THIS run's reachable bucket classes resolve to under the
+    # frozen route ({default} when no route / env pinned): >1 impl gates
+    # spec mode off, and a uniform single impl decides the flat-vs-lanes
+    # spec histogram below (flat hardcodes the xla one-hot arithmetic)
+    _route_impls = route_effective_impls(hist_route, B_hist, hist_dtype, N)
     KB = spec_batch_slots(
         M,
         hist_mode=hist_mode,
@@ -723,13 +767,20 @@ def grow_tree(
         cegb_on=cegb_on,
         use_subtract=use_subtract,
         custom_split=split_fn is not find_best_split,
+        route_rows_variant=len(_route_impls) > 1,
     )
     if _ENV_SPEC_HIST:
         use_flat = _ENV_SPEC_HIST == "flat"
     else:
         from .histogram import _ENV_IMPL as _hist_env
 
-        eff_impl = _hist_env or ("xla" if _default_backend() == "tpu" else "")
+        # flat spec histograms share onehot_chunk_partial (xla arithmetic),
+        # so they are only bitwise-consistent when the effective impl IS
+        # xla: env override first, else the route's uniform impl (which is
+        # the backend default when no route is active)
+        eff_impl = _hist_env or (
+            next(iter(_route_impls)) if len(_route_impls) == 1 else ""
+        )
         use_flat = eff_impl == "xla"
     global _LAST_GROW_MODE, _LAST_SPEC_HIST  # trace-time test introspection
     _LAST_GROW_MODE = "spec" if KB else "seq"
@@ -798,7 +849,7 @@ def grow_tree(
         _kern = make_bucket_kernels(
             bins, feature_meta, B, num_group_bins=num_group_bins,
             bins_nf=bins_nf, chunk=chunk, hist_dtype=hist_dtype,
-            feature_sharded=feature_sharded, kb=KB,
+            feature_sharded=feature_sharded, kb=KB, hist_route=hist_route,
         )
         partition_batch = _kern.partition_batch
 
@@ -1064,6 +1115,7 @@ def grow_tree(
     root_hist = leaf_histogram(
         bins, root_vals, B_hist, chunk=chunk, axis_name=hist_axis,
         hist_dtype=hist_dtype, feature_sharded=feature_sharded,
+        route=hist_route,
     )
     # Root totals from the histogram of feature 0 would miss rows in padded bins;
     # sum the mask directly instead (psum'd under shard_map like GBDT's root sync,
@@ -1382,7 +1434,7 @@ def grow_tree(
             small_hist = leaf_histogram(
                 bins, masked_values(small_mask), B_hist, chunk=chunk,
                 axis_name=hist_axis, hist_dtype=hist_dtype,
-                feature_sharded=feature_sharded,
+                feature_sharded=feature_sharded, route=hist_route,
             )
         if bundled:
             if hist_axis is None and axis_name is not None:
@@ -1410,7 +1462,7 @@ def grow_tree(
                 h = leaf_histogram(
                     bins, masked_values(lmask), B_hist, chunk=chunk,
                     axis_name=hist_axis, hist_dtype=hist_dtype,
-                    feature_sharded=feature_sharded,
+                    feature_sharded=feature_sharded, route=hist_route,
                 )
             if bundled:
                 if hist_axis is None and axis_name is not None:

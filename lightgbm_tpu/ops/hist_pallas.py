@@ -444,3 +444,21 @@ def supported(
         except Exception:
             return False
     return backend == "tpu"
+
+
+def supported_packed4(
+    num_bins: int, backend: Optional[str] = None, ignore_backend: bool = False
+) -> bool:
+    """:func:`supported` twin for the nibble-packed kernel: B <= 16 (two
+    4-bit bins per byte — dense_nbits_bin.hpp's packing question), TPU
+    backend unless ``ignore_backend`` (forced interpret-mode runs)."""
+    if num_bins > 16:
+        return False
+    if ignore_backend:
+        return True
+    if backend is None:
+        try:
+            backend = jax.default_backend()
+        except Exception:
+            return False
+    return backend == "tpu"

@@ -13,23 +13,35 @@ The histogram layout is ``[num_features, num_bins, 3]`` float32 with channels
 accumulation follows the reference's GPU path, which demonstrates AUC parity with
 single-precision accumulators (docs/GPU-Performance.rst:131-145).
 
-``leaf_histogram`` dispatches at trace time on the default backend: the
-chunked one-hot contraction is the TPU default (measured winner over the
-pallas v1 kernel at every r4 on-silicon shape — BENCH_NOTES.md), a chunked
-scatter-add serves CPU, and the radix-packed Pallas kernels
-(ops/hist_pallas.py) remain selectable via LIGHTGBM_TPU_HIST_IMPL for the
-bringup bake-off.
+``leaf_histogram`` dispatches at trace time, in precedence order:
+
+  1. an explicit ``impl=`` argument (tests, the bringup bake-off races);
+  2. the ``LIGHTGBM_TPU_HIST_IMPL`` env escape hatch (frozen at import);
+  3. a frozen per-run :class:`HistRoute` — the measured, shape-keyed tune
+     table (obs/tune.py sweep, persisted via resil/atomic, frozen at
+     ``GBDT._setup_train``; docs/HistogramRouting.md);
+  4. the static backend default (:func:`default_impl`): the chunked one-hot
+     contraction on TPU (measured winner over the pallas v1 kernel at every
+     r4 on-silicon full-N shape — BENCH_NOTES.md), the chunked scatter-add
+     on CPU.
+
+The route is a pure function of the call shape and the frozen table, and it
+rides the jit static args — so routing is deterministic for a training run
+and every exactness contract (chunk=1-vs-K, segmented-vs-fused, sharded,
+checkpoint resume) holds *within* a run by construction.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional
+import hashlib
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import hist_pallas
+from ..utils import log
 
 
 class HistogramSource:
@@ -155,6 +167,11 @@ def _default_backend() -> str:
         return "cpu"
 
 
+# The full impl vocabulary leaf_histogram can route among. "pallas_packed4"
+# is the nibble-packed (two 4-bit bins per byte) MXU kernel — promoted from
+# measurement-only into the routed set for <=16-bin shapes (ISSUE 13).
+IMPLS = ("xla", "xla_radix", "scatter", "pallas", "pallas_packed4")
+
 # Resolved ONCE at import so routing is deterministic per process: leaf_histogram
 # is jitted with impl as a static arg, and an env var read at trace time would
 # silently keep stale routing for already-compiled shapes if it changed later.
@@ -163,9 +180,292 @@ def _default_backend() -> str:
 # reason).
 from ..utils.platform import env_choice
 
-_ENV_IMPL = env_choice(
-    "LIGHTGBM_TPU_HIST_IMPL", ("xla", "xla_radix", "scatter", "pallas")
-)
+_ENV_IMPL = env_choice("LIGHTGBM_TPU_HIST_IMPL", IMPLS)
+
+
+def default_impl(backend: Optional[str] = None) -> str:
+    """The static routing default a shape falls to with no explicit impl,
+    env override, or tune-table entry: the scatter-add on CPU (F*N adds vs
+    the one-hot's 2*F*N*B flops), the MXU one-hot contraction elsewhere."""
+    b = backend if backend is not None else _default_backend()
+    return "scatter" if b == "cpu" else "xla"
+
+
+def impl_supported(
+    impl: str,
+    num_bins: int,
+    backend: Optional[str] = None,
+    ignore_backend: bool = False,
+) -> bool:
+    """Can ``impl`` serve a ``num_bins``-wide histogram on ``backend``?
+
+    The ONE supported() vocabulary the router, the tune sweep (obs/tune.py)
+    and the table-load filter (:func:`resolve_route`) share, so a table can
+    never route a shape to a kernel that cannot lower there."""
+    if impl in ("xla", "xla_radix", "scatter"):
+        return True
+    if impl == "pallas":
+        return hist_pallas.supported(num_bins, backend, ignore_backend)
+    if impl == "pallas_packed4":
+        return hist_pallas.supported_packed4(num_bins, backend, ignore_backend)
+    return False
+
+
+def rows_bucket(n: int) -> int:
+    """Shape-class row bucket: ``n`` rounded UP to the grower's bucket
+    lattice family {2^k} ∪ {3·2^(k-1)} (ops/grow.py bucket_sizes). The
+    bucketed grower only ever calls leaf_histogram at lattice sizes, so on
+    those calls the bucket IS the call shape; full-N calls (root, masked
+    mode) round up to the nearest class."""
+    n = max(int(n), 1)
+    k = (n - 1).bit_length()  # smallest k with 2^k >= n
+    p = 1 << k
+    t = 3 << (k - 2) if k >= 2 else p  # 3*2^(k-2) == 0.75 * 2^k
+    return t if t >= n else p
+
+
+class HistRoute:
+    """Frozen shape-class -> impl routing table for ONE training run.
+
+    Built once from a measured tune table (obs/tune.py) at
+    ``GBDT._setup_train`` and threaded as a jit STATIC argument through
+    ``grow_tree`` / ``make_bucket_kernels`` / ``leaf_histogram`` — the route
+    is a pure function of (call shape, this frozen object), so a tune cache
+    rewritten mid-process can never change an already-set-up run, and every
+    compiled program's identity includes the table it routed under.
+
+    ``entries`` maps ``(B, K, hist_dtype, rows_bucket)`` -> impl name;
+    hashable/comparable by value so jit caches key correctly. ``digest`` is
+    the content digest the flight manifest records (docs/HistogramRouting.md).
+    """
+
+    __slots__ = ("entries", "digest", "source", "_map")
+
+    def __init__(
+        self,
+        entries,
+        source: str = "",
+    ) -> None:
+        ent: Tuple = tuple(sorted(
+            ((int(b), int(k), str(d), int(r)), str(impl))
+            for (b, k, d, r), impl in entries
+        ))
+        self.entries = ent
+        self._map = dict(ent)
+        if len(self._map) != len(ent):
+            # duplicate shape classes with CONFLICTING impls (e.g. two sweep
+            # outputs merged by hand): routing would silently follow sort
+            # order instead of a measurement, and two semantically-equal
+            # tables could carry different digests — refuse loudly
+            dupes = sorted(
+                {k for k, v in ent if self._map[k] != v}
+            )
+            if dupes:
+                from ..utils.log import LightGBMError
+
+                raise LightGBMError(
+                    "histogram route has conflicting impls for shape "
+                    "class(es) %s — merge tables by re-sweeping, not by "
+                    "concatenating entries" % (dupes,)
+                )
+            # exact duplicates: deduplicate so the digest is canonical
+            ent = tuple(sorted(self._map.items()))
+            self.entries = ent
+        self.source = str(source)
+        self.digest = hashlib.sha256(repr(ent).encode("utf-8")).hexdigest()[:16]
+
+    def pick(
+        self, rows: int, num_bins: int, k: int, hist_dtype: str
+    ) -> Optional[str]:
+        """Impl for this call shape, or None (-> the static default)."""
+        return self._map.get(
+            (int(num_bins), int(k), str(hist_dtype), rows_bucket(rows))
+        )
+
+    def rows_variant(self, default: str) -> bool:
+        """Shape-blind conservative check: True when ANY entry routes away
+        from ``default``. Callers that know the run's geometry should use
+        :func:`route_effective_impls` / the shape-aware
+        :func:`route_rows_variant` instead — an entry whose (B, K, dtype)
+        class this run can never emit must not cost it spec mode."""
+        return any(v != default for v in self._map.values())
+
+    def effective_impls(
+        self, default: str, num_bins: int, k: int, hist_dtype: str,
+        row_buckets,
+    ) -> set:
+        """The set of impls the given row-bucket classes of ONE (B, K,
+        dtype) group resolve to — classes without an entry fall back to
+        ``default``."""
+        return {
+            self._map.get(
+                (int(num_bins), int(k), str(hist_dtype), int(rb)), default
+            )
+            for rb in row_buckets
+        }
+
+    def __eq__(self, other) -> bool:
+        return type(other) is HistRoute and other.entries == self.entries
+
+    def __hash__(self) -> int:
+        return hash((HistRoute, self.entries))
+
+    def __repr__(self) -> str:
+        return "HistRoute(%d entries, digest=%s%s)" % (
+            len(self.entries), self.digest,
+            ", source=%r" % self.source if self.source else "",
+        )
+
+
+def route_effective_impls(
+    route: Optional[HistRoute],
+    num_bins: int,
+    hist_dtype: str,
+    n_rows: int,
+    k: int = 3,
+) -> set:
+    """The set of impls a run at this geometry actually resolves to: its
+    reachable row-bucket classes (the grower's bucket lattice for
+    ``n_rows``, ops/grow.py ``bucket_sizes``) looked up in the route's
+    (``num_bins``, ``k``, ``hist_dtype``) group, defaulting per class.
+    ``{default_impl()}`` when the route is absent or env-overridden."""
+    if route is None or _ENV_IMPL:
+        return {default_impl()}
+    from .grow import bucket_sizes  # lazy: grow imports this module
+
+    buckets = {rows_bucket(s) for s in bucket_sizes(int(n_rows))}
+    return route.effective_impls(
+        default_impl(), num_bins, k, hist_dtype, buckets
+    )
+
+
+def route_rows_variant(
+    route: Optional[HistRoute],
+    num_bins: Optional[int] = None,
+    hist_dtype: Optional[str] = None,
+    n_rows: Optional[int] = None,
+    k: int = 3,
+) -> bool:
+    """Does ``route`` make the effective impl depend on the row bucket?
+
+    The spec-mode gate (ops/grow.py ``spec_batch_slots``): the speculative
+    grower histograms a candidate batch at the batch-max bucket size while
+    the sequential/segmented (W=1) form uses each segment's own bucket — a
+    route whose impl choice VARIES across the run's reachable bucket
+    classes would let the SAME logical segment take different impls in the
+    two programs and break the profiler's fused-vs-segmented bitwise
+    identity (obs/prof.py). Such a route runs the sequential grower; a
+    route that resolves every reachable class to ONE impl (the default, or
+    uniformly any single kernel) is self-consistent and leaves spec mode
+    on. With the run geometry (``num_bins``/``hist_dtype``/``n_rows``) the
+    check is exact — entries for unreachable (B, dtype) groups cost
+    nothing; without it, conservatively shape-blind. With
+    LIGHTGBM_TPU_HIST_IMPL in force the route never engages (env
+    precedence), so it cannot introduce variance."""
+    if route is None or _ENV_IMPL:
+        return False
+    if num_bins is None or hist_dtype is None or n_rows is None:
+        return route.rows_variant(default_impl())
+    return len(
+        route_effective_impls(route, num_bins, hist_dtype, n_rows, k)
+    ) > 1
+
+
+def resolve_route(
+    table: Optional[dict], source: str = ""
+) -> Optional[HistRoute]:
+    """Tune-table dict (obs/tune.py schema) -> frozen :class:`HistRoute`.
+
+    Filters to THIS process's backend + device family and drops entries
+    whose impl cannot serve their shape here (``impl_supported``) — a table
+    measured on a TPU never routes a CPU run and vice versa. Returns None
+    when nothing survives (callers then use the static default)."""
+    if not table or not table.get("entries"):
+        return None
+    backend = _default_backend()
+    if table.get("backend") != backend:
+        log.warn_once(
+            "hist-tune-backend-mismatch",
+            "histogram tune table %s was measured on backend=%r but this "
+            "process runs %r; ignoring it (static default routing applies)"
+            % (source or "<dict>", table.get("backend"), backend),
+        )
+        return None
+    fam = device_family()
+    tfam = table.get("device_family")
+    if tfam and fam and tfam != fam:
+        log.warn_once(
+            "hist-tune-device-mismatch",
+            "histogram tune table %s was measured on device family %r but "
+            "this process runs %r; ignoring it"
+            % (source or "<dict>", tfam, fam),
+        )
+        return None
+    if tfam and fam is None and tfam != backend:
+        # this chip's family is UNRECOGNIZED (normalize_device_kind knows
+        # no name for it) while the table names a concrete family from
+        # another generation — adopting stale winners silently would
+        # violate the "v5e never routes v6e" contract. A table measured on
+        # an equally-unrecognized chip records its backend as the family
+        # (build_table fallback) and still matches above.
+        log.warn_once(
+            "hist-tune-unknown-device",
+            "histogram tune table %s was measured on device family %r but "
+            "this chip's family is unrecognized; ignoring it (re-sweep on "
+            "this chip to adopt measured routing)" % (source or "<dict>",
+                                                      tfam),
+        )
+        return None
+    ents = []
+    for e in table["entries"]:
+        impl = str(e.get("impl", ""))
+        b = int(e["B"])
+        if impl not in IMPLS or not impl_supported(impl, b, backend):
+            log.warn_once(
+                "hist-tune-unsupported:%s:%d" % (impl, b),
+                "histogram tune entry (B=%d impl=%r) is not supported on "
+                "this backend/shape; dropping it from the route" % (b, impl),
+            )
+            continue
+        ents.append(
+            ((b, int(e["K"]), str(e["hist_dtype"]), int(e["rows_bucket"])),
+             impl)
+        )
+    if not ents:
+        return None
+    return HistRoute(ents, source=source)
+
+
+def device_family() -> Optional[str]:
+    """This process's normalized chip family (obs/costs.py's ONE device-kind
+    vocabulary) — the tune table's device key, so a cache written on v5e is
+    never adopted on v6e."""
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        return None
+    from ..obs.costs import normalize_device_kind
+
+    return normalize_device_kind(kind)
+
+
+def _note_impl_fallback(requested: str, num_bins: int) -> None:
+    """A forced impl (explicit, env, or a tune entry) that cannot serve this
+    shape falls back to the XLA one-hot — loudly, once per (impl, B), and
+    counted so bench/bringup artifacts surface how often routing degraded."""
+    log.warn_once(
+        "hist-impl-fallback:%s:%d" % (requested, num_bins),
+        "impl=%r requested (explicitly, via LIGHTGBM_TPU_HIST_IMPL, or a "
+        "tune-table entry) but that kernel does not support num_bins=%d; "
+        "falling back to the XLA one-hot implementation"
+        % (requested, num_bins),
+    )
+    from ..obs.registry import REGISTRY
+
+    REGISTRY.counter(
+        "hist_impl_fallback_total",
+        "leaf_histogram impl requests that fell back to the XLA one-hot",
+    ).inc(requested=requested)
 
 
 def _pick_chunk(num_features: int, num_bins: int, requested: int, n: int) -> int:
@@ -185,6 +485,7 @@ def _pick_chunk(num_features: int, num_bins: int, requested: int, n: int) -> int
     jax.jit,
     static_argnames=(
         "num_bins", "chunk", "axis_name", "impl", "hist_dtype", "feature_sharded",
+        "route", "interpret",
     ),
 )
 def leaf_histogram(
@@ -196,6 +497,8 @@ def leaf_histogram(
     impl: str = "auto",
     hist_dtype: str = "float32",
     feature_sharded: bool = False,
+    route: Optional[HistRoute] = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """Histogram of per-row values over binned features.
 
@@ -209,46 +512,66 @@ def leaf_histogram(
       axis_name: if set, psum the result over that mesh axis (the data-parallel
         ReduceScatter path of data_parallel_tree_learner.cpp:161 collapsed into
         one XLA collective).
-      impl: "auto" (chunked scatter-add on CPU, one-hot contraction on TPU
-        and elsewhere), "pallas", "scatter", "xla" (the one-hot
-        contraction — also the differential oracle for the others), or
-        "xla_radix" (the radix factorization in plain XLA).
-      hist_dtype: MXU operand dtype for the pallas kernel and the XLA
+      impl: "auto" (env override -> frozen ``route`` -> the backend default,
+        see the module banner), "pallas", "pallas_packed4" (nibble-packed
+        MXU kernel, B <= 16), "scatter", "xla" (the one-hot contraction —
+        also the differential oracle for the others), or "xla_radix" (the
+        radix factorization in plain XLA).
+      hist_dtype: MXU operand dtype for the pallas kernels and the XLA
         one-hot/radix contractions — "float32" (exact) or "bfloat16"
         (rounds grad/hess operands; the one-hot side and the count channel
         are exact 0/1 values, and accumulation stays f32 via
         preferred_element_type — the reference GPU path's single-precision
         trade, docs/GPU-Performance.rst:131-145).
+      route: frozen per-run :class:`HistRoute` (the measured tune table);
+        consulted only for ``impl="auto"`` with no env override, keyed on
+        this call's actual (rows, B, K, dtype) shape class at trace time.
+      interpret: run the pallas kernels in interpret mode (differential
+        tests off-TPU; never set on the training path).
 
     Returns:
       ``[F, B, K]`` float32 histogram.
     """
     if impl == "auto" and _ENV_IMPL:
         impl = _ENV_IMPL
-    if impl == "pallas" and not hist_pallas.supported(num_bins, ignore_backend=True):
-        # A forced 'pallas' must still satisfy the kernel's shape constraints
-        # (num_bins bound from the VMEM block rules) or it would mis-lower
-        # instead of falling back.
-        import warnings
-
-        warnings.warn(
-            "impl='pallas' requested (explicitly or via LIGHTGBM_TPU_HIST_IMPL) "
-            "but the pallas kernel does not support num_bins=%d; falling back "
-            "to the XLA one-hot implementation" % (num_bins,)
+    if impl == "auto" and route is not None:
+        picked = route.pick(
+            bins.shape[1], num_bins, values.shape[1], hist_dtype
         )
+        if picked is not None:
+            impl = picked
+    if impl in ("pallas", "pallas_packed4") and not impl_supported(
+        impl, num_bins, ignore_backend=True
+    ):
+        # A forced pallas impl must still satisfy the kernel's shape
+        # constraints (num_bins bound from the VMEM block rules / nibble
+        # width) or it would mis-lower instead of falling back.
+        _note_impl_fallback(impl, num_bins)
         impl = "xla"
     if impl == "pallas":
         hist = hist_pallas.histogram_pallas(
-            bins, values, num_bins, chunk=max(chunk, 512), dtype_name=hist_dtype
+            bins, values, num_bins, chunk=max(chunk, 512),
+            dtype_name=hist_dtype, interpret=interpret,
+        )
+        return _combine(hist, axis_name)
+    if impl == "pallas_packed4":
+        # nibble packing happens inside the jit: [F, N] u8 + [N, K] f32 ->
+        # ([F, N/2] u8, [N/2, 2K] f32) is a cheap vectorized relayout that
+        # halves the bin-matrix HBM stream the kernel reads
+        bins_p, vals_p = hist_pallas.pack4(bins, values)
+        hist = hist_pallas.histogram_pallas_packed4(
+            bins_p, vals_p, num_bins, chunk=max(chunk // 2, 512),
+            dtype_name=hist_dtype, interpret=interpret,
         )
         return _combine(hist, axis_name)
     if impl == "auto" and _default_backend() == "tpu":
-        # Measured on v5e-1 (BENCH_NOTES r4): XLA one-hot 16.8 ms vs pallas
-        # v1 34.8 ms for a full-N 1Mx28x255 pass — the one-hot contraction is
-        # the on-chip winner at every measured shape, so TPU auto routes here.
-        # The pallas kernels stay selectable (LIGHTGBM_TPU_HIST_IMPL=pallas)
-        # and the bringup bake-off re-races them (incl. the feature-batched
-        # v2) each chip window; flip this default if a kernel wins.
+        # The STATIC fallback for shapes with no tune entry: the one-hot
+        # contraction measured fastest at the full-N 1Mx28x255 pass on
+        # v5e-1 (16.8 ms vs pallas v1's 34.8 ms — BENCH_NOTES r4). Shapes
+        # the bringup `tune` stage has measured route through the frozen
+        # HistRoute above instead — per-shape winners are a persisted
+        # measurement (obs/tune.py, docs/HistogramRouting.md), no longer a
+        # hand-flipped default.
         impl = "xla"
     if impl == "scatter" or (impl == "auto" and _default_backend() == "cpu"):
         # CPU: a scatter-add is the dense_bin.hpp:71 loop XLA can actually run

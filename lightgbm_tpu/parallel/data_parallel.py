@@ -69,6 +69,7 @@ def grow_tree_data_parallel(
     cegb_state=None,
     two_way: bool = True,
     hist_pool_slots=None,
+    hist_route=None,
 ):
     """Explicit shard_map data-parallel growth; returns (TreeArrays, leaf_id).
 
@@ -91,7 +92,7 @@ def grow_tree_data_parallel(
     key = (
         mesh, tuple(meta_keys), num_leaves, max_depth, num_bins,
         num_group_bins, params, chunk, hist_dtype, hist_mode, forced_splits,
-        cegb, two_way, hist_pool_slots,
+        cegb, two_way, hist_pool_slots, hist_route,
     )
     fn = _FN_CACHE.get(key)
     if fn is None:
@@ -119,6 +120,7 @@ def grow_tree_data_parallel(
                 cegb=cegb,
                 hist_pool_slots=hist_pool_slots,
                 cegb_state=(fu, uid) if cegb_on else None,
+                hist_route=hist_route,
             )
 
         row = P("data")

@@ -55,6 +55,7 @@ def grow_tree_feature_parallel(
     cegb_state=None,
     two_way: bool = True,
     hist_pool_slots=None,
+    hist_route=None,
 ):
     """Feature-sharded growth; returns (TreeArrays, leaf_id), both replicated."""
     fcol = NamedSharding(mesh, P("feature", None))
@@ -109,6 +110,7 @@ def grow_tree_feature_parallel(
         cegb=cegb,
         cegb_state=cegb_state,
         hist_pool_slots=hist_pool_slots,
+        hist_route=hist_route,
     )
     if cegb.enabled and pad:
         tree, leaf_id, (fu, uid) = out

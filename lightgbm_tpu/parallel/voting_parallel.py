@@ -168,6 +168,7 @@ def grow_tree_voting_parallel(
     cegb_state=None,
     two_way: bool = True,
     hist_pool_slots=None,
+    hist_route=None,
 ):
     """Voting-parallel growth; returns (TreeArrays replicated, leaf_id sharded).
 
@@ -188,7 +189,7 @@ def grow_tree_voting_parallel(
     key = (
         mesh, tuple(meta_keys), num_leaves, max_depth, num_bins,
         num_group_bins, params, top_k, chunk, hist_dtype, hist_mode,
-        forced_splits, cegb, two_way, hist_pool_slots,
+        forced_splits, cegb, two_way, hist_pool_slots, hist_route,
     )
     fn = _FN_CACHE.get(key)
     if fn is None:
@@ -223,6 +224,7 @@ def grow_tree_voting_parallel(
                 hist_pool_slots=hist_pool_slots,
                 cegb_state=(fu, uid) if cegb_on else None,
                 cegb_rescan=rescan_fn,
+                hist_route=hist_route,
             )
 
         row = P("data")

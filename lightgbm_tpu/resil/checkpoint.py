@@ -68,8 +68,17 @@ def _json_scalar(obj):
 
 
 def _config_digest(config) -> str:
+    # NON_MODEL_PARAMS (e.g. the hist_tune cache path) are run provenance,
+    # not model semantics: resuming with the identical tune table at a
+    # different path must not warn "parameters differ" — route identity is
+    # tracked separately via the manifest's hist_route_digest
+    from ..config import NON_MODEL_PARAMS
+
     return hashlib.sha1(
-        repr(sorted(config.to_dict().items())).encode("utf-8")
+        repr(sorted(
+            (k, v) for k, v in config.to_dict().items()
+            if k not in NON_MODEL_PARAMS
+        )).encode("utf-8")
     ).hexdigest()
 
 
@@ -205,6 +214,12 @@ def save_checkpoint(
         "n_valid": len(getattr(gbdt, "valid_scores", [])),
         "valid_ident": _valid_idents(gbdt),
         "mesh": _mesh_desc(gbdt),
+        # frozen histogram routing (ops/histogram.HistRoute): a resume
+        # under a DIFFERENT tune table replays different kernel arithmetic
+        # — detected at load and warned like a config-digest drift
+        "hist_route_digest": getattr(
+            getattr(gbdt, "_hist_route", None), "digest", None
+        ),
     }
     # canonical [K, N] carry: any sharded-chunk row padding is dropped so
     # the artifact bytes do not depend on the mesh that produced them
@@ -341,6 +356,17 @@ def restore(booster, path: str, cbs_after=None) -> Checkpoint:
             log.warning(
                 "resume: training parameters differ from the checkpoint's; "
                 "the resumed run will NOT be bit-identical to the original"
+            )
+        ck_route = m.get("hist_route_digest")
+        live_route = getattr(
+            getattr(gbdt, "_hist_route", None), "digest", None
+        )
+        if "hist_route_digest" in m and ck_route != live_route:
+            log.warning(
+                "resume: histogram tune route differs from the "
+                "checkpoint's (%s vs %s); routed kernel arithmetic changes "
+                "and the resumed run will NOT be bit-identical to the "
+                "original (docs/HistogramRouting.md)" % (ck_route, live_route)
             )
         live_mesh = _mesh_desc(gbdt)
         if "mesh" not in m:

@@ -66,7 +66,7 @@ _QUICK_MODULES = {
     "test_resil", "test_sanitize",
     "test_serve_drift", "test_serve_packed",
     "test_serve_resil", "test_serve_server", "test_snapshot_timers",
-    "test_vfile", "test_warmstart",
+    "test_tune", "test_vfile", "test_warmstart",
 }
 
 

@@ -1,0 +1,41 @@
+"""JX011 bad fixture: the nibble-packed (packed4) histogram call shape with
+one contract violation per check — proof the lint gate sees the promoted
+``histogram_pallas_packed4`` idiom (ISSUE 13), not just the radix kernels."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+FB = 8
+NUM_BINS = 16
+
+
+def _kernel_p4(bins_ref, vt_ref, out_ref, *, num_bins, dtype):
+    c = pl.program_id(2)  # grid below is rank 2: axis 2 out of range
+    b = bins_ref[:, :].astype(jnp.int32)
+    even = b & 15
+    # out_shape declares float32; this stores the operand dtype instead
+    out_ref[0] += (even[None, :, :] * vt_ref[:][:, None, :]).sum(-1).astype(
+        jnp.bfloat16
+    )
+
+
+def bad_packed4_call(bins_packed, vt, n_chunks, C, K2):
+    kernel = functools.partial(
+        _kernel_p4, num_bins=NUM_BINS, dtype=jnp.float32
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(4, n_chunks),
+        in_specs=[
+            # index_map takes ONE coordinate against the rank-2 grid
+            pl.BlockSpec((FB, C), lambda f8: (f8, 0), memory_space=pltpu.VMEM),
+        ],
+        # rank-2 block for the rank-3 out_shape entry
+        out_specs=pl.BlockSpec(
+            (FB, NUM_BINS), lambda f8, c: (f8, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((32, 3, NUM_BINS), jnp.float32),
+    )(bins_packed, vt)  # 1 in_spec, 2 operands

@@ -1,0 +1,60 @@
+"""JX011 good fixture: a faithful mirror of the promoted packed4 call
+(ops/hist_pallas.histogram_pallas_packed4) — two 4-bit bins per byte, one
+one-hot dot per half, accumulator block pinned across the chunk grid. Every
+contract satisfied; the lint gate must stay silent."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+FB = 8
+NUM_BINS = 16
+
+
+def _kernel_p4(bins_ref, vt_ref, out_ref, *, num_bins, dtype):
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    vt = vt_ref[:].astype(dtype)  # [2K, C2]
+    k2, C2 = vt.shape
+    k_n = k2 // 2
+    b_all = bins_ref[:, :].astype(jnp.int32)  # [FB, C2]
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (C2, num_bins), 1)
+    for j in range(FB):
+        b_even = b_all[j] & 15
+        b_odd = b_all[j] >> 4
+        oh_e = (b_even[:, None] == b_iota).astype(dtype)
+        oh_o = (b_odd[:, None] == b_iota).astype(dtype)
+        out_ref[j] += jax.lax.dot_general(
+            vt[:k_n], oh_e, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + jax.lax.dot_general(
+            vt[k_n:], oh_o, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def good_packed4_call(bins_packed, vt, fp8, n_chunks, C, K2, K):
+    kernel = functools.partial(
+        _kernel_p4, num_bins=NUM_BINS, dtype=jnp.float32
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(fp8, n_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f8, c: (f8, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K2, C), lambda f8, c: (0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (FB, K, NUM_BINS), lambda f8, c: (f8, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((32, K, NUM_BINS), jnp.float32),
+    )(bins_packed, vt)

@@ -25,10 +25,10 @@ def test_every_stage_parses():
 def test_stage_table_complete():
     """Every stage run by main() has a timeout entry, and vice versa."""
     assert set(tb.STAGE_TIMEOUTS) == {
-        "matmul", "pallas", "pack4", "smoke", "smoke_seq", "bench_early",
-        "smoke_pallas", "smoke_xla_radix", "smoke_bf16", "smoke_psplit",
-        "bench_chunk", "bench_multichip", "bench_predict", "prof", "san",
-        "loop", "bench",
+        "matmul", "pallas", "pack4", "smoke", "smoke_seq", "tune",
+        "bench_early", "smoke_pallas", "smoke_xla_radix", "smoke_bf16",
+        "smoke_psplit", "bench_chunk", "bench_multichip", "bench_predict",
+        "prof", "san", "loop", "bench",
     }
 
 
@@ -192,6 +192,34 @@ def test_rehearsal_mode_is_isolated():
     src = open(tb.__file__).read()
     assert 'TPU_BRINGUP_REHEARSAL.json' in src
     assert 'BENCH_FORCE_PLATFORMS"] = "cpu"' in src
+
+
+def test_run_tune_invokes_module_sweep(monkeypatch):
+    """The tune stage (ISSUE 13) runs `python -m lightgbm_tpu.obs.tune` in
+    a child (driver stays jax-free) writing TUNE_HIST.json at the repo root
+    — the exact path bench.py's auto-adoption looks for — ahead of
+    bench_early, and its ok verdict keys on the sweep's digest."""
+    import os
+
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["stage"] = stage
+        seen["argv"] = argv
+        return {"digest": "abc123", "entries": 24}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    r = tb.run_tune()
+    assert r["ok"] and seen["stage"] == "tune"
+    assert seen["argv"][1:3] == ["-m", "lightgbm_tpu.obs.tune"]
+    out = seen["argv"][seen["argv"].index("--out") + 1]
+    assert out == os.path.join(tb.REPO, "TUNE_HIST.json")
+
+    def fake_run_child_fail(stage, argv, env=None):
+        return {"ok": False, "error": "rc=1"}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child_fail)
+    assert not tb.run_tune()["ok"]
 
 
 def test_run_san_invokes_smoke_by_file_path(monkeypatch):

@@ -401,6 +401,24 @@ def test_jx011_scratch_refs_not_mistaken_for_out_refs(tmp_path):
     assert run_lint([str(p)], root=str(tmp_path), select=["JX011"]) == []
 
 
+def test_jx011_packed4_fixture():
+    """The promoted packed4 histogram idiom (ISSUE 13) is provably inside
+    the lint gate's sight: a nibble-packed call with seeded violations is
+    flagged per contract, and the faithful mirror of the real
+    ``histogram_pallas_packed4`` invocation is clean."""
+    findings = _lint(os.path.join(LINT_DIR, "jx011_packed4_bad.py"), "JX011")
+    details = sorted(f.detail for f in findings)
+    assert details == sorted([
+        "_kernel_p4:program_id=2",       # axis 2 against the rank-2 grid
+        "_kernel_p4:store_dtype",        # bf16 store into a f32 out ref
+        "in_specs[0]:index_map_arity",   # 1-arg lambda, rank-2 grid
+        "in_specs_count",                # 1 spec, 2 operands
+        "out[0]:block_rank",             # rank-2 block, rank-3 out_shape
+    ]), [f.format() for f in findings]
+    assert _lint(os.path.join(LINT_DIR, "jx011_packed4_good.py"),
+                 "JX011") == []
+
+
 def test_jx011_real_pallas_seams_clean():
     """The shipped kernels must satisfy their own hygiene rule — the Pallas
     PR grows from these seams under JX011's gate."""

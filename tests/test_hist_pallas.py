@@ -110,6 +110,61 @@ def test_packed4_matches_oracle(rng):
     np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
 
 
+def test_packed4_via_leaf_histogram(rng):
+    """pallas_packed4 is part of leaf_histogram's routed impl vocabulary
+    (ISSUE 13): the router packs the raw [F, N] bins itself and the result
+    matches the numpy oracle AND the XLA one-hot differential baseline."""
+    F, n, B = 7, 2001, 16  # odd n exercises the pack4 pad row
+    bins = rng.randint(0, B, (F, n)).astype(np.uint8)
+    vals = rng.randn(n, 3).astype(np.float32)
+    ref = histogram_reference(bins, vals, B)
+    out = np.asarray(
+        leaf_histogram(jnp.asarray(bins), jnp.asarray(vals), B,
+                       impl="pallas_packed4", chunk=1024, interpret=True)
+    )
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-4)
+    base = np.asarray(
+        leaf_histogram(jnp.asarray(bins), jnp.asarray(vals), B,
+                       impl="xla", chunk=1024)
+    )
+    np.testing.assert_allclose(out, base, rtol=1e-6, atol=1e-5)
+
+
+def test_packed4_supported_gating():
+    """supported_packed4 is the router's gate: <= 16 bins, TPU backend
+    (shape-only under ignore_backend, the forced-interpret test mode)."""
+    from lightgbm_tpu.ops.hist_pallas import supported_packed4
+
+    assert supported_packed4(16, backend="tpu")
+    assert not supported_packed4(17, backend="tpu")
+    assert not supported_packed4(16, backend="cpu")
+    assert supported_packed4(16, ignore_backend=True)
+    assert not supported_packed4(17, ignore_backend=True)
+    from lightgbm_tpu.ops.histogram import impl_supported
+
+    assert impl_supported("pallas_packed4", 16, "tpu")
+    assert not impl_supported("pallas_packed4", 32, "tpu")
+    assert not impl_supported("pallas_packed4", 16, "cpu")
+    assert impl_supported("xla", 256, "cpu")
+
+
+def test_packed4_over16_falls_back_to_xla(rng):
+    """A forced pallas_packed4 at B > 16 must fall back to the XLA one-hot
+    (warn_once + counter) instead of mis-lowering — same contract as the
+    radix kernel's num_bins bound."""
+    F, n, B = 3, 512, 32
+    bins = rng.randint(0, B, (F, n)).astype(np.uint8)
+    vals = rng.randn(n, 3).astype(np.float32)
+    out = np.asarray(
+        leaf_histogram(jnp.asarray(bins), jnp.asarray(vals), B,
+                       impl="pallas_packed4")
+    )
+    base = np.asarray(
+        leaf_histogram(jnp.asarray(bins), jnp.asarray(vals), B, impl="xla")
+    )
+    np.testing.assert_array_equal(out, base)
+
+
 @pytest.mark.parametrize("num_bins", [16, 63, 255])
 def test_xla_radix_matches_oracle(rng, num_bins):
     """The plain-XLA radix factorization against the numpy oracle and the

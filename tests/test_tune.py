@@ -1,0 +1,330 @@
+"""Tune-cache lifecycle + shape-keyed histogram routing (ISSUE 13).
+
+Covers the contracts docs/HistogramRouting.md promises: atomic persisted
+tables round-trip and refuse stale/tampered caches loudly; the route is
+FROZEN per training run (same-table reruns byte-identical, a cache swapped
+mid-process cannot change an already-set-up run); a default-pinned table is
+bit-transparent; the flight manifest stamps the route digest; the spec-mode
+gate and the impl-fallback path behave as specified.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import tune
+from lightgbm_tpu.ops import histogram as hist_mod
+from lightgbm_tpu.utils.log import LightGBMError
+
+N, F, MAX_BIN, ROUNDS = 2000, 6, 31, 6
+PARAMS = {
+    "objective": "binary", "num_leaves": 7, "max_bin": MAX_BIN,
+    "learning_rate": 0.1, "verbosity": -1, "min_data_in_leaf": 5,
+}
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.RandomState(3)
+    X = rng.randn(N, F)
+    y = (X[:, 0] + 0.5 * rng.randn(N) > 0).astype(np.float64)
+    return X, y
+
+
+def _train(data, extra=None):
+    X, y = data
+    p = dict(PARAMS)
+    p.update(extra or {})
+    bst = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=ROUNDS)
+    return bst
+
+
+def _entries(impl, bins=MAX_BIN, dtype="float32"):
+    """Entries covering every bucket class a N-row training emits."""
+    from lightgbm_tpu.ops.grow import bucket_sizes
+
+    rows = sorted({hist_mod.rows_bucket(s) for s in bucket_sizes(N)})
+    return [
+        {"B": bins, "K": 3, "hist_dtype": dtype, "rows_bucket": r,
+         "impl": impl}
+        for r in rows
+    ]
+
+
+# ---------------------------------------------------------------------------
+# table lifecycle: atomic round-trip, schema, digest
+# ---------------------------------------------------------------------------
+
+def test_save_load_round_trip(tmp_path):
+    table = tune.build_table(_entries("xla"))
+    path = str(tmp_path / "t.json")
+    tune.save_table(table, path)
+    got = tune.load_table(path)
+    assert got["entries"] == table["entries"]
+    assert got["digest"] == table["digest"] == tune.entries_digest(
+        table["entries"]
+    )
+    # atomic publish leaves no temp droppings
+    assert [p for p in os.listdir(tmp_path) if p.endswith(".tmp")] == []
+
+
+def test_stale_schema_refused(tmp_path):
+    table = tune.build_table(_entries("xla"))
+    table["schema"] = tune.SCHEMA + 1
+    path = str(tmp_path / "stale.json")
+    with open(path, "w") as fh:
+        json.dump(table, fh)
+    with pytest.raises(LightGBMError, match="schema"):
+        tune.load_table(path)
+
+
+def test_tampered_digest_refused(tmp_path):
+    table = tune.build_table(_entries("xla"))
+    table["entries"][0]["impl"] = "scatter"  # edit without resealing
+    path = str(tmp_path / "tampered.json")
+    with open(path, "w") as fh:
+        json.dump(table, fh)
+    with pytest.raises(LightGBMError, match="digest"):
+        tune.load_table(path)
+
+
+def test_active_table_precedence(tmp_path, monkeypatch):
+    path = str(tmp_path / "t.json")
+    tune.save_table(tune.build_table(_entries("xla")), path)
+    # param wins; "off" disables even the env var; env is the ambient tier
+    monkeypatch.delenv(tune.ENV_PATH, raising=False)
+    assert tune.active_table("")[0] is None
+    assert tune.active_table(path)[1] == path
+    monkeypatch.setenv(tune.ENV_PATH, path)
+    assert tune.active_table("")[1] == path
+    assert tune.active_table("off")[0] is None
+    # explicit bad path raises; ambient bad path degrades to None
+    with pytest.raises(LightGBMError):
+        tune.active_table(str(tmp_path / "missing.json"))
+    monkeypatch.setenv(tune.ENV_PATH, str(tmp_path / "missing.json"))
+    assert tune.active_table("")[0] is None
+
+
+# ---------------------------------------------------------------------------
+# route resolution + routing semantics
+# ---------------------------------------------------------------------------
+
+def test_resolve_filters_backend_and_unsupported(tmp_path):
+    # wrong backend -> no route at all
+    table = tune.build_table(_entries("xla"), backend="tpu",
+                             device_family="v5e")
+    assert hist_mod.resolve_route(table) is None
+    # right backend, but a pallas entry cannot serve on CPU -> dropped
+    ents = _entries("xla") + [
+        {"B": 16, "K": 3, "hist_dtype": "float32", "rows_bucket": 512,
+         "impl": "pallas_packed4"},
+    ]
+    table = tune.build_table(ents, backend="cpu", device_family="cpu")
+    route = hist_mod.resolve_route(table, source="t")
+    assert route is not None
+    assert route.pick(512, 16, 3, "float32") is None  # dropped entry
+    assert route.pick(512, MAX_BIN, 3, "float32") == "xla"
+
+
+def test_conflicting_duplicate_entries_refused():
+    """Hand-merged tables with two impls for one shape class must refuse —
+    routing by entry sort order is not a measurement; exact duplicates
+    deduplicate to a canonical digest."""
+    key = (MAX_BIN, 3, "float32", 512)
+    with pytest.raises(LightGBMError, match="conflicting"):
+        hist_mod.HistRoute([(key, "scatter"), (key, "xla_radix")])
+    r = hist_mod.HistRoute([(key, "xla"), (key, "xla")])
+    assert r.entries == hist_mod.HistRoute([(key, "xla")]).entries
+    assert r.digest == hist_mod.HistRoute([(key, "xla")]).digest
+
+
+def test_unknown_device_family_refuses_foreign_table(monkeypatch):
+    """A chip normalize_device_kind cannot name must not adopt a table
+    measured on a KNOWN different family; a table whose family fell back
+    to the bare backend (measured on an equally-unknown chip) still
+    matches."""
+    monkeypatch.setattr(hist_mod, "device_family", lambda: None)
+    backend = hist_mod._default_backend()
+    foreign = tune.build_table(_entries("xla"), backend=backend,
+                               device_family="v5e")
+    assert hist_mod.resolve_route(foreign) is None
+    own = tune.build_table(_entries("xla"), backend=backend,
+                           device_family=backend)
+    assert hist_mod.resolve_route(own) is not None
+
+
+def test_rows_bucket_matches_grower_lattice():
+    # lattice values are their own bucket; everything else rounds UP to the
+    # next {2^k, 3*2^(k-1)} class — the key contract sweep_shapes relies on
+    from lightgbm_tpu.ops.grow import bucket_sizes
+
+    for s in bucket_sizes(100000):
+        assert hist_mod.rows_bucket(s) == s or s == 100000
+    assert hist_mod.rows_bucket(1536) == 1536
+    assert hist_mod.rows_bucket(1537) == 2048
+    assert hist_mod.rows_bucket(2049) == 3072
+    assert hist_mod.rows_bucket(1) == 1
+
+
+def test_route_rows_variant_gates_spec():
+    from lightgbm_tpu.ops.grow import bucket_sizes, spec_batch_slots
+
+    default = hist_mod.default_impl()
+    other = "xla_radix" if default != "xla_radix" else "xla"
+    variant = hist_mod.HistRoute(
+        [((MAX_BIN, 3, "float32", 512), other)]
+    )
+    pinned = hist_mod.HistRoute(
+        [((MAX_BIN, 3, "float32", 512), default)]
+    )
+    # shape-blind (conservative) form
+    assert hist_mod.route_rows_variant(variant)
+    assert not hist_mod.route_rows_variant(pinned)
+    assert not hist_mod.route_rows_variant(None)
+    # shape-AWARE form: the same entry in an UNREACHABLE (B, dtype) group
+    # must not cost this run its spec mode...
+    kw = dict(num_bins=128, hist_dtype="float32", n_rows=4096)
+    assert not hist_mod.route_rows_variant(variant, **kw)
+    # ...a partially-covering non-default route in the REACHABLE group
+    # varies (uncovered buckets fall to the default)...
+    kw = dict(num_bins=MAX_BIN, hist_dtype="float32", n_rows=4096)
+    assert hist_mod.route_rows_variant(variant, **kw)
+    # ...and a route covering EVERY reachable bucket uniformly with one
+    # non-default impl is self-consistent: spec stays on
+    buckets = {hist_mod.rows_bucket(s) for s in bucket_sizes(4096)}
+    uniform = hist_mod.HistRoute(
+        [((MAX_BIN, 3, "float32", rb), other) for rb in buckets]
+    )
+    assert not hist_mod.route_rows_variant(uniform, **kw)
+    assert hist_mod.route_effective_impls(
+        uniform, MAX_BIN, "float32", 4096
+    ) == {other}
+    # the spec gate consumes it: a rows-variant route forces the
+    # sequential grower (docs/HistogramRouting.md §Exactness)
+    assert spec_batch_slots(31, route_rows_variant=True) == 0
+
+
+def test_impl_fallback_warns_once_and_counts(rng):
+    from lightgbm_tpu.obs.registry import REGISTRY
+    from lightgbm_tpu.utils import log as log_mod
+
+    import jax.numpy as jnp
+
+    bins = jnp.asarray(rng.randint(0, 32, (3, 512)).astype(np.uint8))
+    vals = jnp.asarray(rng.randn(512, 3).astype(np.float32))
+    before = REGISTRY.counter("hist_impl_fallback_total").value(
+        requested="pallas_packed4"
+    )
+    log_mod.reset_warn_once()
+    out = np.asarray(
+        hist_mod.leaf_histogram(bins, vals, 32, impl="pallas_packed4")
+    )
+    base = np.asarray(hist_mod.leaf_histogram(bins, vals, 32, impl="xla"))
+    np.testing.assert_array_equal(out, base)
+    after = REGISTRY.counter("hist_impl_fallback_total").value(
+        requested="pallas_packed4"
+    )
+    assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# frozen-per-run exactness
+# ---------------------------------------------------------------------------
+
+def test_same_table_reruns_byte_identical(tmp_path, data):
+    path = str(tmp_path / "w.json")
+    other = "xla" if hist_mod.default_impl() != "xla" else "xla_radix"
+    tune.save_table(tune.build_table(_entries(other)), path)
+    m1 = _train(data, {"hist_tune": path}).model_to_string()
+    m2 = _train(data, {"hist_tune": path}).model_to_string()
+    assert m1 == m2
+
+
+def test_default_pinned_table_is_bit_transparent(tmp_path, data):
+    path = str(tmp_path / "p.json")
+    tune.save_table(
+        tune.build_table(_entries(hist_mod.default_impl())), path
+    )
+    untuned = _train(data).model_to_string()
+    pinned = _train(data, {"hist_tune": path}).model_to_string()
+    # hist_tune is excluded from the parameters footer (NON_MODEL_PARAMS),
+    # so the FULL model strings must match — routing machinery on, zero
+    # arithmetic change, zero artifact-byte change
+    assert pinned == untuned
+
+
+def test_table_swap_mid_process_is_inert(tmp_path, data):
+    """The route freezes at _setup_train: rewriting the cache afterwards
+    must not touch the already-set-up run."""
+    X, y = data
+    path = str(tmp_path / "w.json")
+    other = "xla" if hist_mod.default_impl() != "xla" else "xla_radix"
+    tune.save_table(tune.build_table(_entries(other)), path)
+    ref = _train(data, {"hist_tune": path}).model_to_string()
+
+    params = dict(PARAMS, hist_tune=path)
+    bst = lgb.Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    # swap the cache AFTER setup froze the route
+    tune.save_table(
+        tune.build_table(_entries(hist_mod.default_impl())), path
+    )
+    for _ in range(ROUNDS):
+        bst.update()
+    assert bst.model_to_string() == ref
+
+
+def test_routed_training_differs_and_chunk_contract_holds(tmp_path, data):
+    """A genuinely re-routed run changes model arithmetic (proof the seam
+    engages) while the device-chunk contract holds under the same frozen
+    table."""
+    path = str(tmp_path / "w.json")
+    other = "xla" if hist_mod.default_impl() != "xla" else "xla_radix"
+    tune.save_table(tune.build_table(_entries(other)), path)
+    untuned = _train(data).model_to_string()
+    tuned = _train(data, {"hist_tune": path}).model_to_string()
+    assert tuned != untuned, "route never engaged (keys missed?)"
+
+    def strip(s):
+        return s.split("parameters:")[0]
+
+    tuned_c = _train(
+        data, {"hist_tune": path, "device_chunk_size": 3}
+    ).model_to_string()
+    assert strip(tuned_c) == strip(tuned)
+
+
+def test_flight_manifest_stamps_route_digest(tmp_path, data):
+    path = str(tmp_path / "w.json")
+    table = tune.build_table(_entries("xla_radix"))
+    tune.save_table(table, path)
+    flight_path = str(tmp_path / "flight.jsonl")
+    _train(data, {"hist_tune": path, "flight_record": flight_path})
+    from lightgbm_tpu.obs import flight
+
+    man = flight.load(flight_path)["manifest"]
+    route = hist_mod.resolve_route(table, source=path)
+    assert man["hist_route_digest"] == route.digest
+    assert man["hist_tune_source"] == path
+    # untuned runs stamp nothing (absent key, not null)
+    flight2 = str(tmp_path / "flight2.jsonl")
+    _train(data, {"flight_record": flight2})
+    assert "hist_route_digest" not in flight.load(flight2)["manifest"]
+
+
+def test_checkpoint_records_route_digest(tmp_path, data):
+    """resil/checkpoint stamps the frozen route's digest so a resume under
+    different routing warns instead of silently diverging."""
+    X, y = data
+    path = str(tmp_path / "w.json")
+    table = tune.build_table(_entries("xla_radix"))
+    tune.save_table(table, path)
+    ck = str(tmp_path / "ck.npz")
+    p = dict(PARAMS, hist_tune=path)
+    lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=4,
+              checkpoint_path=ck, checkpoint_rounds=2)
+    arc = np.load(ck, allow_pickle=False)
+    man = json.loads(bytes(arc["manifest"]).decode("utf-8"))
+    route = hist_mod.resolve_route(table, source=path)
+    assert man["hist_route_digest"] == route.digest
