@@ -907,6 +907,63 @@ def _run() -> None:
             growth_prof = {"error": "%s: %s" % (type(e).__name__, str(e)[:200])}
             print("bench: segment profiler failed: %s" % e, file=sys.stderr)
 
+    # ---- device-timeline audit (obs/devprof.py, ISSUE 14) ----------------
+    # a short profiled window of already-compiled iterations, parsed into
+    # op-level attribution + the host/device/transfer-bound verdict —
+    # device_busy_fraction and transfer_seconds land in the record (and
+    # bench_diff WARNs on their drift). BENCH_DEVPROF=0 skips; the capture
+    # is a temp dir, never the operator's LIGHTGBM_TPU_PROFILE target.
+    devprof_rec = None
+    if os.environ.get("BENCH_DEVPROF", "1") not in ("", "0"):
+        try:
+            import tempfile
+
+            from lightgbm_tpu.obs import devprof as devprof_mod
+
+            remaining = float(
+                os.environ.get(
+                    "BENCH_WORKER_BUDGET_S",
+                    os.environ.get("BENCH_TIMEOUT_S", 2400),
+                )
+            ) - (time.time() - _WATCHDOG_T0)
+            if remaining < 120:
+                devprof_rec = {
+                    "skipped": "tight budget (%.0fs left)" % remaining
+                }
+            else:
+                dp_iters = int(os.environ.get("BENCH_DEVPROF_ITERS", "3"))
+                if chunk > 1:
+                    # chunked dispatch profiles in whole chunks: round the
+                    # requested window UP to a chunk multiple instead of
+                    # silently ignoring the env override
+                    dp_iters = chunk * max(
+                        1, (dp_iters + chunk - 1) // chunk)
+                try:
+                    dp_kind = jax.devices()[0].device_kind
+                except Exception:
+                    dp_kind = None
+                with tempfile.TemporaryDirectory(
+                    prefix="lgbtpu_devprof_"
+                ) as td:
+                    with devprof_mod.capture(td):
+                        run_iters(dp_iters)
+                        float(np.asarray(
+                            jax.numpy.ravel(booster._gbdt.scores)[0]))
+                    devprof_rec = devprof_mod.analyze_dir(
+                        td, device_kind=dp_kind, platform=platform,
+                        iters=dp_iters,
+                    )
+                devprof_mod.publish(devprof_rec)
+                print(
+                    "bench: devprof verdict -> %s"
+                    % json.dumps(devprof_rec.get("verdict")),
+                    file=sys.stderr, flush=True,
+                )
+        except Exception as e:
+            devprof_rec = {"error": "%s: %s" % (type(e).__name__,
+                                                str(e)[:200])}
+            print("bench: devprof failed: %s" % e, file=sys.stderr)
+
     extra = {"platform": platform, "train_auc": round(float(auc), 6)}
     # visible device world: the multichip scaling analysis joins bench
     # records on this (helpers/multichip_bench.py, docs/DataParallel.md)
@@ -995,6 +1052,16 @@ def _run() -> None:
         extra["growth_prof"] = growth_prof
         if growth_prof.get("segments_per_tree_s"):
             extra["growth_segments_s"] = growth_prof["segments_per_tree_s"]
+    if devprof_rec:
+        extra["device_timeline"] = devprof_rec
+        # headline fields bench_diff's WARN row reads (never a FAIL:
+        # busy-fraction drift is a diagnosis pointer, not a regression)
+        if devprof_rec.get("device_busy_fraction") is not None:
+            extra["device_busy_fraction"] = devprof_rec[
+                "device_busy_fraction"]
+        tr_total = (devprof_rec.get("transfers") or {}).get("total_seconds")
+        if tr_total is not None:
+            extra["transfer_seconds"] = tr_total
     try:
         from lightgbm_tpu.obs import costs as _costs_mod
 

@@ -20,6 +20,10 @@ apply per-metric thresholds and emit a markdown verdict table:
   * serve drift alert counted / PSI gauge > 0.2        -> WARN
     (serve/drift.py: drifted input invalidates comparisons but is a data
     condition, not a code regression)
+  * ``device_busy_fraction`` drop > 0.15 /
+    ``transfer_seconds`` > 2x (obs/devprof.py)          -> WARN
+    (the bound-ness of the run moved — a pointer into the record's
+    device_timeline section, never gated as a code regression)
 
 Throughput comparisons apply only between records from the SAME platform —
 a CPU-fallback capture vs an on-chip record is apples-to-oranges and every
@@ -60,6 +64,7 @@ THRESHOLDS = {
     "predict_p99_rise_pct": 25.0,
     "segment_share_shift_pts": 10.0,
     "scaling_eff_drop": 0.10,
+    "busy_fraction_drop": 0.15,
 }
 
 PASS, WARN, FAIL, SKIP = "PASS", "WARN", "FAIL", "SKIP"
@@ -263,6 +268,43 @@ def compare(
                 "scaling_efficiency", bse, cse,
                 ">-%.2f" % th["scaling_eff_drop"], status,
                 "%+.3f (never a hard FAIL; see comms_fraction)" % d,
+            ))
+
+    # device-timeline audit (obs/devprof.py, ISSUE 14): a busy-fraction
+    # drop (or a transfer-time blow-up) between same-platform records
+    # means the bound-ness of the run moved — a diagnosis pointer into the
+    # device_timeline section, NOT a throughput gate, so it WARNs and
+    # never FAILs
+    bdb = baseline.get("device_busy_fraction")
+    cdb = current.get("device_busy_fraction")
+    if bdb is not None or cdb is not None:
+        if bdb is None or cdb is None:
+            rows.append(_row(
+                "device_busy_fraction", bdb, cdb, "-", SKIP,
+                "devprof stamp absent in one record",
+            ))
+        elif not same_platform:
+            rows.append(_row("device_busy_fraction", bdb, cdb, "-", SKIP,
+                             plat_note))
+        else:
+            d = float(cdb) - float(bdb)
+            status = WARN if d < -th["busy_fraction_drop"] else PASS
+            rows.append(_row(
+                "device_busy_fraction", bdb, cdb,
+                ">-%.2f" % th["busy_fraction_drop"], status,
+                "%+.3f (never a hard FAIL; see device_timeline)" % d,
+            ))
+        bts = baseline.get("transfer_seconds")
+        cts = current.get("transfer_seconds")
+        # max(2x, 0.01s floor): a 0.0s baseline (clean device-resident run)
+        # must still WARN when transfers appear, not fall through a falsy
+        # guard — that 0 -> seconds jump is the exact regression this row
+        # exists to surface
+        if (same_platform and bts is not None and cts is not None
+                and float(cts) > max(2.0 * float(bts), 0.01)):
+            rows.append(_row(
+                "transfer_seconds", bts, cts, "<=2x", WARN,
+                "H2D/D2H time doubled — check the devprof transfer table",
             ))
 
     # growth-segment share drift (profiler breakdown, obs/prof.py)

@@ -73,6 +73,15 @@
 #                               # bit-transparent (default-pinned table ==
 #                               # untuned bytes; same-table reruns and
 #                               # chunk=1-vs-4 byte-identical)
+#   helpers/check.sh --devprof  # lint gate, then the device-timeline
+#                               # smoke: capture a scoped jax.profiler
+#                               # window around real boosting iterations,
+#                               # parse the emitted Chrome trace with the
+#                               # stdlib devprof parser, assert a
+#                               # non-empty attributed timeline + a
+#                               # host/device/transfer-bound verdict +
+#                               # the device_timeline report section —
+#                               # ONE invocation (obs/devprof.py)
 #   helpers/check.sh --bench-diff [CUR BASE]
 #                               # the bench regression gate: golden-fixture
 #                               # self-test (synthetic regression must FAIL,
@@ -91,9 +100,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -180,6 +189,11 @@ fi
 if [ "$MODE" = "--tune" ]; then
     echo "== tune smoke (sweep + cache round-trip + perf gate + bit-transparency) =="
     exec env JAX_PLATFORMS=cpu python helpers/tune_smoke.py
+fi
+
+if [ "$MODE" = "--devprof" ]; then
+    echo "== devprof smoke (capture -> parse -> verdict + report section) =="
+    exec env JAX_PLATFORMS=cpu python helpers/devprof_smoke.py
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

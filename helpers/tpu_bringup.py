@@ -91,6 +91,10 @@ STAGE_TIMEOUTS = {
     "bench_multichip": 3600,  # devices∈{1,4,8} sharded-chunk scaling (ISSUE 8)
     "bench_predict": 1800,  # packed-inference serving bench (ISSUE 3)
     "prof": 1800,   # segment-profiled mini-train (obs/prof.py, ISSUE 6)
+    "devprof": 1800,  # device-timeline audit: capture -> parse -> verdict
+                      # (obs/devprof.py, ISSUE 14) — on silicon this is the
+                      # first artifact that says host/device/transfer-bound
+                      # from real /device: lanes
     "san": 1800,    # graftsan stress smoke under full instrumentation
                     # (obs/sanitize.py, ISSUE 11)
     "loop": 1800,   # continuous-training loop smoke: drift -> retrain ->
@@ -731,6 +735,20 @@ def run_loop(stage: str = "loop") -> dict:
     )
 
 
+def run_devprof(stage: str = "devprof") -> dict:
+    """Device-timeline audit smoke (helpers/devprof_smoke.py, ISSUE 14) —
+    executed by FILE path in a child process, driver stays jax-free. The
+    child captures a scoped jax.profiler window around real boosting
+    iterations, parses the emitted Chrome trace with the stdlib devprof
+    parser, and emits the host/device/transfer-bound verdict — so the
+    next unattended chip window ships the DIAGNOSIS (why TPU <> CPU)
+    alongside the bench numbers, recorded into TPU_BRINGUP.json."""
+    return _run_child(
+        stage,
+        [sys.executable, os.path.join(REPO, "helpers", "devprof_smoke.py")],
+    )
+
+
 def run_tune(stage: str = "tune") -> dict:
     """Histogram autotune sweep (obs/tune.py, ISSUE 13) — a child process
     (`python -m lightgbm_tpu.obs.tune`, driver stays jax-free) races every
@@ -908,6 +926,10 @@ def main() -> int:
                        # kernel-level attribution: segment breakdown +
                        # bitwise proof + cost analysis, on silicon (ISSUE 6)
                        ("prof", PROF),
+                       # device-timeline audit: profiled capture -> parsed
+                       # lanes -> host/device/transfer-bound verdict with
+                       # evidence, from the REAL chip (ISSUE 14)
+                       ("devprof", "DEVPROF"),
                        # runtime sanitizer stress smoke: concurrent
                        # predict + hot-swap + drain + drift + scrape under
                        # LIGHTGBM_TPU_SAN=transfer,nan,locks (ISSUE 11)
@@ -925,6 +947,8 @@ def main() -> int:
                 runner = lambda s=stage: run_tune(s)  # noqa: E731
             elif src == "SAN":
                 runner = lambda s=stage: run_san(s)  # noqa: E731
+            elif src == "DEVPROF":
+                runner = lambda s=stage: run_devprof(s)  # noqa: E731
             elif src == "LOOP":
                 runner = lambda s=stage: run_loop(s)  # noqa: E731
             elif src is None:

@@ -32,6 +32,12 @@ report); the serve-side drift monitor lives in serve/drift.py. One spine:
    (``python -m lightgbm_tpu.obs.tune``): measured per-shape kernel
    routing tables, atomically persisted, frozen per training run
    (docs/HistogramRouting.md). Imported lazily (it pulls ops/ on use).
+ * :mod:`~lightgbm_tpu.obs.devprof`  — the device-timeline auditor
+   (``python -m lightgbm_tpu.obs.devprof``): parses the XLA profile a
+   ``LIGHTGBM_TPU_PROFILE`` capture emits, attributes device self-time to
+   the TraceAnnotation segment vocabulary, and classifies the run
+   host- / device- / transfer-bound (docs/Observability.md §Device
+   timeline). Stdlib-only parsing; imported lazily by its callers.
 
 Importing this package never touches a jax backend.
 """

@@ -14,6 +14,8 @@ Sections (each rendered only when its input is present):
   * per-tree gain + leaf count along the boosting sequence
   * cumulative gain-importance evolution of the top features
   * growth segment breakdown (obs/prof.py, PR 6)
+  * device timeline audit (obs/devprof.py: busy/idle lanes, top-op table,
+    segment-grouped device self-time, transfers, bound-ness verdict)
   * serve drift table (serve/drift.py PSI per feature)
   * bench series (headline value across BENCH_r*.json rounds)
   * counters/gauges digest
@@ -368,6 +370,96 @@ def _section_segments(metrics: Dict) -> str:
     )
 
 
+def _section_device_timeline(metrics: Dict) -> str:
+    """The device-timeline audit (obs/devprof.py): busy/idle per lane,
+    segment-grouped device self-time (``unattributed`` rendered like any
+    other — loudly), the top-op table with roofline placement, transfer
+    totals, and the bound-ness verdict with its evidence inline."""
+    rec = metrics.get("device_timeline")
+    if not isinstance(rec, dict) or not rec:
+        return ""
+    out = ["<h2>Device timeline</h2>"]
+    v = rec.get("verdict") or {}
+    if v.get("bound"):
+        cls = "ok" if v["bound"] == "device-bound" else "alert"
+        out.append(
+            '<div><span class="%s">verdict: %s</span> — '
+            '<span class="small">%s</span></div>'
+            % (cls, _esc(v["bound"]), _esc(v.get("why", "")))
+        )
+    if rec.get("lanes_source"):
+        out.append(
+            '<div class="small">lanes: %s · window %ss · '
+            "device_busy_fraction %s · attributed %s</div>"
+            % (
+                _esc(rec["lanes_source"]), _fmt(float(rec.get("window_s", 0))),
+                "-" if rec.get("device_busy_fraction") is None
+                else "%.3f" % rec["device_busy_fraction"],
+                "-" if rec.get("attributed_fraction") is None
+                else "%.0f%%" % (100 * rec["attributed_fraction"]),
+            )
+        )
+    lanes = rec.get("lanes") or []
+    if lanes:
+        out.append(svg_stacked_bars(
+            [
+                (
+                    str(ln.get("device", "?")),
+                    [
+                        ("busy", float(ln.get("busy_s", 0.0)), "#2563eb"),
+                        ("idle",
+                         max(float(rec.get("window_s", 0.0))
+                             - float(ln.get("busy_s", 0.0)), 0.0),
+                         "#d8dce4"),
+                    ],
+                )
+                for ln in lanes
+            ],
+            title="busy vs idle per device lane", unit=" s",
+        ))
+    segs = rec.get("segments") or {}
+    if segs:
+        out.append(svg_bar_chart(
+            [(k, float(s)) for k, s in segs.items()],
+            title="device self-time per segment (TraceAnnotation "
+                  "attribution)", unit=" s",
+        ))
+    tops = rec.get("top_ops") or []
+    if tops:
+        out.append(_table(
+            ("op", "segment", "self s", "count", "share", "peak FLOPs"),
+            [
+                (
+                    str(t.get("op", ""))[:60], t.get("segment", ""),
+                    _fmt(float(t.get("self_s", 0.0))), t.get("count", 0),
+                    "%.1f%%" % (100 * float(t.get("share", 0.0))),
+                    "-" if t.get("peak_flops_fraction") is None
+                    else "%.2f%%" % (100 * t["peak_flops_fraction"]),
+                )
+                for t in tops
+            ],
+        ))
+    tr = rec.get("transfers") or {}
+    if tr:
+        rows = []
+        for direction in ("h2d", "d2h"):
+            d = tr.get(direction) or {}
+            if d:
+                rows.append((direction, d.get("count", 0),
+                             _fmt(float(d.get("seconds", 0.0))),
+                             _fmt(float(d.get("bytes", 0)))))
+        if rows:
+            out.append(_table(("direction", "events", "seconds", "bytes"),
+                              rows))
+    gaps = rec.get("dispatch_gaps") or {}
+    if gaps.get("histogram"):
+        out.append(svg_bar_chart(
+            [(k, float(n)) for k, n in gaps["histogram"].items()],
+            title="dispatch-gap (device idle) histogram", unit=" gaps",
+        ))
+    return "".join(out)
+
+
 def _section_drift(metrics: Dict, drift: Optional[Dict]) -> str:
     # (sort key, model, feature, psi text, state) — psi sorts NUMERICALLY
     # (string sort would rank "9.0" above "12.3"); None psi sinks to the end
@@ -609,6 +701,7 @@ def render(
         _section_trees(flight),
         _section_importance_evolution(flight),
         _section_segments(mblock),
+        _section_device_timeline(mblock),
         _section_drift(mblock, drift),
         _section_bench(bench_records or []),
         _section_multichip(bench_records or []),

@@ -169,7 +169,7 @@ def start(path: Optional[str] = None) -> Tracer:
             # an env-derived default path gets a .rank<N> suffix — two ranks
             # must never clobber one trace file. Explicit paths are the
             # caller's responsibility (bringup already appends .stage_*).
-            target = _rank_suffixed(target)
+            target = rank_suffixed(target)
         _TRACER = Tracer(target)
         if not _ATEXIT_ARMED:
             _ATEXIT_ARMED = True
@@ -177,9 +177,14 @@ def start(path: Optional[str] = None) -> Tracer:
         return _TRACER
 
 
-def _rank_suffixed(target: str) -> str:
+def rank_suffixed(target: str) -> str:
     """``<target>.rank<N>`` when a multi-process jax.distributed world is
-    initialized (consults only an already-imported jax; never imports it)."""
+    initialized (consults only an already-imported jax; never imports it).
+    Shared clobber fix for every env-derived per-process artifact path:
+    the tracer's LIGHTGBM_TPU_TRACE file here, utils/timer.maybe_profile's
+    LIGHTGBM_TPU_PROFILE dir, and obs/devprof.capture's profile window —
+    devprof.find_trace_files folds the ``.rank<N>`` siblings back together
+    at parse time."""
     if ".rank" in target:
         return target
     jx = sys.modules.get("jax")
@@ -298,7 +303,12 @@ def merge_traces(out_path: str, in_paths) -> Dict:
     pair is remapped to a fresh DISJOINT pid with a ``process_name``
     metadata row naming its origin, so same-pid events from different
     processes can never interleave; ``dropped_events`` markers are summed
-    and preserved. Returns {files, events, pids, dropped, path}."""
+    and preserved. Gzipped inputs (``*.json.gz`` — the XLA profiler's own
+    export format) load transparently, so per-rank LIGHTGBM_TPU_PROFILE
+    captures merge next to the host-span files.
+    Returns {files, events, pids, dropped, path}."""
+    from . import devprof as devprof_mod  # one gz-transparent loader
+
     events: List[Dict] = []
     pid_map: Dict = {}
     dropped = 0
@@ -306,8 +316,7 @@ def merge_traces(out_path: str, in_paths) -> Dict:
     files = 0
     for i, p in enumerate(in_paths):
         try:
-            with open(p, encoding="utf-8") as fh:
-                doc = json.load(fh)
+            doc = devprof_mod.load_chrome_trace(str(p))
         except (OSError, ValueError):
             continue  # a torn/absent child trace must not kill the merge
         files += 1
@@ -360,13 +369,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                       "with disjoint pids",
     )
     mg.add_argument("inputs", nargs="+",
-                    help="trace files (shell-unexpanded globs accepted)")
+                    help="trace files, shell-unexpanded globs, or "
+                         "LIGHTGBM_TPU_PROFILE capture dirs (expanded to "
+                         "their per-rank trace.json.gz files)")
     mg.add_argument("-o", "--out", default="trace_merged.json")
     args = ap.parse_args(argv)
     paths: List[str] = []
     for item in args.inputs:
         hits = sorted(glob_mod.glob(item))
-        paths.extend(hits if hits else [item])
+        for hit in hits if hits else [item]:
+            if os.path.isdir(hit):
+                # a profiler capture dir: fold its (and its .rank<N>
+                # siblings') Chrome traces in — obs/devprof.py owns the
+                # directory-layout knowledge, stdlib only like this module
+                from . import devprof as devprof_mod
+
+                paths.extend(devprof_mod.find_trace_files(hit))
+            else:
+                paths.append(hit)
+    # a dir and its .rank<N> sibling both matching the glob would fold the
+    # same files twice — order-preserving dedupe
+    paths = list(dict.fromkeys(paths))
     stats = merge_traces(args.out, paths)
     print(
         "trace merge: %(files)d file(s) -> %(path)s "

@@ -171,13 +171,25 @@ class PhaseTimers:
 
 @contextlib.contextmanager
 def maybe_profile():
-    """jax.profiler trace around training when LIGHTGBM_TPU_PROFILE is set."""
+    """jax.profiler trace around training when LIGHTGBM_TPU_PROFILE is set.
+
+    Under an initialized multi-process ``jax.distributed`` world every
+    rank inherits the SAME env var, and two profiler sessions writing one
+    dir clobber each other's ``plugins/profile/<ts>`` session — so the
+    env-derived dir gets the shared ``.rank<N>`` suffix (obs/trace.py
+    ``rank_suffixed``, the same fix PR 9 gave LIGHTGBM_TPU_TRACE);
+    ``obs.devprof`` and ``obs.trace merge`` fold the per-rank dirs back
+    together at parse time. Parse the capture with
+    ``python -m lightgbm_tpu.obs.devprof parse <dir>``
+    (docs/Observability.md §Device timeline).
+    """
     out_dir = os.environ.get(ENV_PROFILE, "")
     if not out_dir:
         yield
         return
     import jax
 
+    out_dir = trace_mod.rank_suffixed(out_dir)
     jax.profiler.start_trace(out_dir)
     try:
         yield

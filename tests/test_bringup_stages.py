@@ -28,7 +28,7 @@ def test_stage_table_complete():
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "tune",
         "bench_early", "smoke_pallas", "smoke_xla_radix", "smoke_bf16",
         "smoke_psplit", "bench_chunk", "bench_multichip", "bench_predict",
-        "prof", "san", "loop", "bench",
+        "prof", "devprof", "san", "loop", "bench",
     }
 
 
@@ -239,6 +239,28 @@ def test_run_san_invokes_smoke_by_file_path(monkeypatch):
     r = tb.run_san()
     assert r["ok"] and seen["stage"] == "san"
     assert seen["argv"][-1].endswith(_os.path.join("helpers", "san_smoke.py"))
+
+
+def test_run_devprof_invokes_smoke_by_file_path(monkeypatch):
+    """The devprof stage (ISSUE 14) executes helpers/devprof_smoke.py by
+    FILE path in a child — the driver never imports the package (stays
+    jax-free); the child captures, parses, and emits the bound-ness
+    verdict line the summary records."""
+    import os as _os
+
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["stage"] = stage
+        seen["argv"] = argv
+        return {"ok": True, "verdict": "host-bound"}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    r = tb.run_devprof()
+    assert r["ok"] and seen["stage"] == "devprof"
+    assert seen["argv"][-1].endswith(
+        _os.path.join("helpers", "devprof_smoke.py")
+    )
 
 
 def test_run_loop_invokes_smoke_by_file_path(monkeypatch):
