@@ -82,6 +82,16 @@
 #                               # host/device/transfer-bound verdict +
 #                               # the device_timeline report section —
 #                               # ONE invocation (obs/devprof.py)
+#   helpers/check.sh --elastic  # lint gate, then the elastic preemption-
+#                               # tolerance smoke: ONE invocation at forced-
+#                               # 8-CPU-device shapes — SIGKILL mid-run ->
+#                               # same-mesh resume, SIGTERM -> emergency
+#                               # checkpoint + exit 75 -> auto-resume
+#                               # byte-equal to the uninterrupted run,
+#                               # 8->2 resharded resume (loud warning +
+#                               # structural identity), serial<->data@1
+#                               # byte-identity (docs/FaultTolerance.md
+#                               # §Elastic training)
 #   helpers/check.sh --bench-diff [CUR BASE]
 #                               # the bench regression gate: golden-fixture
 #                               # self-test (synthetic regression must FAIL,
@@ -100,9 +110,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--elastic|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof, --elastic or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -194,6 +204,11 @@ fi
 if [ "$MODE" = "--devprof" ]; then
     echo "== devprof smoke (capture -> parse -> verdict + report section) =="
     exec env JAX_PLATFORMS=cpu python helpers/devprof_smoke.py
+fi
+
+if [ "$MODE" = "--elastic" ]; then
+    echo "== elastic smoke (SIGKILL/SIGTERM -> resume byte-identity + 8->2 reshard) =="
+    exec python helpers/elastic_smoke.py
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

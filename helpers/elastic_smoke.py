@@ -1,0 +1,332 @@
+"""check.sh --elastic: the elastic preemption-tolerance chain, ONE invocation.
+
+Drives a real data-parallel training through every kill the scheduler can
+throw at it, on forced-8-CPU-device workers (the ISSUE-15 shapes), and
+gates on the exactness taxonomy docs/FaultTolerance.md §Elastic training
+documents:
+
+  1. **uninterrupted reference** — 12 rounds, data learner, chunked,
+     bagging, 8 devices.
+  2. **SIGKILL mid-run** — a fault-injected ``train.iteration:9:kill``
+     murders the checkpointing run between boundaries (rc=-9; the archive
+     from boundary 6 survives).
+  3. **resume + SIGTERM preemption** — the resumed run (same mesh) is
+     SIGTERMed mid-train with ``preempt_exit`` armed: it must publish an
+     EMERGENCY boundary checkpoint and exit with the documented preemption
+     code 75 (EX_TEMPFAIL), not 0 and not a crash code.
+  4. **auto-resume** — resuming the emergency checkpoint to completion
+     yields a final model BYTE-equal to the uninterrupted reference, with
+     exactly 12 trees (one completed run, no double-trained boundary).
+  4b. **SIGKILL at `train.preempt`** — a kill BETWEEN the latched SIGTERM
+     and the emergency write: the pre-preemption archive must carry a
+     byte-identical resume (the kill-anywhere matrix at the new sites).
+  5. **8 -> 2 reshard** — the same mid-run checkpoint resumed on TWO
+     forced devices: must complete with the loud reshard warning, split
+     structure identical to the reference, prefix trees byte-exact, and
+     suffix leaf values within ulp tolerance (the psum grouping changed —
+     byte-identity across a world-size change is NOT claimed, measured
+     impossible; the reference's own distributed training has the same
+     num_machines dependence).
+  6. **serial <-> data@1 reshard** — a serial checkpoint resumed as the
+     data learner on one device IS byte-identical (world size unchanged).
+
+HARD FAILURES: any byte mismatch in legs 4/6, a wrong exit code in leg 3,
+a missing emergency checkpoint, structural divergence in leg 5, or a
+missing reshard warning.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ROUNDS = 12
+CKPT_ROUNDS = 3
+
+WORKER = r'''
+import os, sys, time
+sys.path.insert(0, %(repo)r)
+from lightgbm_tpu.utils.platform import force_cpu_devices
+jax = force_cpu_devices(int(os.environ["ELASTIC_NDEV"]))
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine, callback
+from lightgbm_tpu.resil.preempt import PREEMPT_EXIT_CODE, TrainingPreempted
+
+mode = sys.argv[1]
+ckpt = sys.argv[2]
+out = sys.argv[3] if len(sys.argv) > 3 else ""
+
+rng = np.random.RandomState(7)
+N, F = 1003, 6
+X = rng.randn(N, F)
+y = (X[:, 0] + 0.3 * rng.randn(N) > 0).astype(float)
+
+params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "bagging_freq": 2, "bagging_fraction": 0.8,
+          "feature_fraction": 0.8}
+if mode == "reshard":
+    # warnings visible: the parent asserts the loud reshard warning fired
+    # (verbosity is footer-only — the tree comparisons are body-structural)
+    params["verbosity"] = 0
+if os.environ.get("ELASTIC_LEARNER", "data") == "data":
+    params.update(tree_learner="data", device_chunk_size=3)
+
+kw = {}
+if mode in ("ckpt", "resume", "resume_preempt", "reshard"):
+    kw["checkpoint_path"] = ckpt
+    kw["checkpoint_rounds"] = %(ckpt_rounds)d
+if mode in ("resume", "resume_preempt", "reshard"):
+    kw["resume_from"] = ckpt
+cbs = None
+if mode == "resume_preempt":
+    kw["preempt_exit"] = True
+    def pacer(env):
+        # give the parent a window to land its SIGTERM between boundaries
+        print("BOUNDARY %%d" %% env.iteration, flush=True)
+        time.sleep(0.3)
+    pacer.order = 90
+    cbs = [pacer]
+
+try:
+    bst = engine.train(params, lgb.Dataset(X, label=y), %(rounds)d,
+                       verbose_eval=False, callbacks=cbs, **kw)
+except TrainingPreempted as e:
+    print("PREEMPTED iter=%%d ckpt=%%s" %% (e.iteration, e.checkpoint_path),
+          flush=True)
+    sys.exit(PREEMPT_EXIT_CODE)
+
+if out:
+    with open(out, "w") as fh:
+        fh.write(bst.model_to_string())
+print("TREES %%d" %% len(bst._gbdt.trees()), flush=True)
+print("CHILD-DONE", flush=True)
+''' % {"repo": REPO, "rounds": ROUNDS, "ckpt_rounds": CKPT_ROUNDS}
+
+
+def _env(ndev, learner="data", faults=""):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=%d" % ndev
+    env["ELASTIC_NDEV"] = str(ndev)
+    env["ELASTIC_LEARNER"] = learner
+    if faults:
+        env["LIGHTGBM_TPU_FAULTS"] = faults
+    else:
+        env.pop("LIGHTGBM_TPU_FAULTS", None)
+    return env
+
+
+def _run(args, env, timeout=600, expect_rc=0, tag=""):
+    r = subprocess.run([sys.executable, "-c", WORKER] + list(args),
+                       env=env, cwd=REPO, capture_output=True, text=True,
+                       timeout=timeout)
+    if expect_rc is not None and r.returncode != expect_rc:
+        print("elastic_smoke FAILED [%s]: rc=%s (expected %s)"
+              % (tag, r.returncode, expect_rc))
+        print(r.stdout[-1500:])
+        print(r.stderr[-1500:])
+        sys.exit(1)
+    return r
+
+
+def _sigterm_at_first_boundary(proc, timeout_s=300.0):
+    """Read the child's stdout until the first BOUNDARY marker, then
+    SIGTERM it. A watchdog timer SIGKILLs a child that wedges before its
+    first boundary — `for line in proc.stdout` blocks inside readline, so
+    an in-loop clock check could never fire (a direct check.sh run has no
+    bringup stage timeout above it)."""
+    import threading
+
+    killer = threading.Timer(timeout_s, proc.kill)
+    killer.daemon = True
+    killer.start()
+    try:
+        for line in proc.stdout:
+            if line.startswith("BOUNDARY"):
+                proc.send_signal(signal.SIGTERM)
+                return True
+        return False  # EOF without a boundary (wedged child was killed)
+    finally:
+        killer.cancel()
+
+
+def _model_body(path):
+    with open(path, encoding="utf-8") as fh:
+        return fh.read().split("parameters:")[0]
+
+
+def _trees(path):
+    """(split_feature tuple, threshold tuple, leaf_value tuple) per tree,
+    parsed from the model text — enough for structural + value checks."""
+    import re
+
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read().split("parameters:")[0]
+    out = []
+    for block in text.split("\nTree=")[1:]:
+        f = {}
+        for line in block.splitlines():
+            m = re.match(r"(split_feature|threshold|leaf_value)=(.*)", line)
+            if m:
+                f[m.group(1)] = m.group(2).split()
+        out.append((tuple(f.get("split_feature", [])),
+                    tuple(f.get("threshold", [])),
+                    tuple(float(v) for v in f.get("leaf_value", []))))
+    return out
+
+
+def main() -> int:
+    import tempfile
+
+    work = tempfile.mkdtemp(prefix="elastic_smoke_")
+    ckpt = os.path.join(work, "run.ckpt")
+    ref_out = os.path.join(work, "ref.txt")
+    final_out = os.path.join(work, "final.txt")
+    reshard_out = os.path.join(work, "reshard2.txt")
+    t0 = time.time()
+
+    # 1. uninterrupted reference @ 8 devices
+    _run(["ref", "", ref_out], _env(8), tag="ref")
+    print("elastic_smoke: reference trained (8 devices)")
+
+    # 2. SIGKILL mid-run. The chunked loop makes ~6 train.iteration passes
+    # for 12 rounds (first iteration sequential, then chunks of 3, then the
+    # tail); occurrence 4 lands after the iteration-7 checkpoint with 5
+    # iterations still to train
+    r = _run(["ckpt", ckpt], _env(8, faults="train.iteration:4:kill"),
+             expect_rc=-9, tag="sigkill")
+    assert "CHILD-DONE" not in r.stdout, "kill did not land"
+    assert os.path.exists(ckpt), "no checkpoint survived the SIGKILL"
+    print("elastic_smoke: SIGKILLed mid-run; checkpoint survived")
+
+    # 3. resume (same mesh) + SIGTERM preemption -> emergency ckpt + exit 75
+    proc = subprocess.Popen(
+        [sys.executable, "-c", WORKER, "resume_preempt", ckpt],
+        env=_env(8), cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    # wait for the first post-resume boundary so the SIGTERM lands mid-run
+    if not _sigterm_at_first_boundary(proc):
+        proc.wait(timeout=30)
+        print("elastic_smoke FAILED: resumed run never reached a boundary")
+        return 1
+    tail = proc.stdout.read()
+    err = proc.stderr.read()
+    proc.wait(timeout=300)
+    if proc.returncode != 75:
+        print("elastic_smoke FAILED: preempted run exited %s, expected 75"
+              % proc.returncode)
+        print(tail[-800:], err[-800:])
+        return 1
+    assert "PREEMPTED" in tail, tail[-400:]
+    assert "CHILD-DONE" not in tail, "preempted run claimed completion"
+    print("elastic_smoke: SIGTERM honored -> emergency checkpoint + exit 75")
+    # snapshot the EMERGENCY checkpoint for the reshard leg: the auto-resume
+    # below keeps checkpointing to the same path and would leave only the
+    # final (nothing-left-to-train) boundary behind
+    mid_ckpt = os.path.join(work, "mid.ckpt")
+    with open(ckpt, "rb") as src, open(mid_ckpt, "wb") as dst:
+        dst.write(src.read())
+
+    # 4. auto-resume to completion: byte-equal to the uninterrupted run
+    r = _run(["resume", ckpt, final_out], _env(8), tag="auto-resume")
+    assert "TREES %d" % ROUNDS in r.stdout, (
+        "expected exactly %d trees (one completed run): %s"
+        % (ROUNDS, r.stdout[-200:]))
+    if _model_body(final_out) != _model_body(ref_out):
+        print("elastic_smoke FAILED: kill->resume->preempt->resume model "
+              "differs from the uninterrupted run")
+        return 1
+    print("elastic_smoke: auto-resume BYTE-identical to uninterrupted "
+          "(%d trees, no double-trained boundary)" % ROUNDS)
+
+    # 4b. kill-anywhere at the new fault sites: SIGKILL BETWEEN the latched
+    # SIGTERM and the emergency write (train.preempt) — the pre-preemption
+    # checkpoint must carry a byte-identical resume
+    kp_ckpt = os.path.join(work, "killpreempt.ckpt")
+    with open(mid_ckpt, "rb") as src, open(kp_ckpt, "wb") as dst:
+        dst.write(src.read())
+    proc = subprocess.Popen(
+        [sys.executable, "-c", WORKER, "resume_preempt", kp_ckpt],
+        env=_env(8, faults="train.preempt:1:kill"), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    if not _sigterm_at_first_boundary(proc):
+        proc.wait(timeout=30)
+        print("elastic_smoke FAILED: train.preempt leg never reached a "
+              "boundary")
+        return 1
+    proc.stdout.read()
+    proc.stderr.read()
+    proc.wait(timeout=300)
+    if proc.returncode != -9:
+        print("elastic_smoke FAILED: train.preempt kill exited %s, "
+              "expected -9" % proc.returncode)
+        return 1
+    kp_out = os.path.join(work, "killpreempt.txt")
+    r = _run(["resume", kp_ckpt, kp_out], _env(8), tag="killpreempt-resume")
+    if _model_body(kp_out) != _model_body(ref_out):
+        print("elastic_smoke FAILED: resume after a train.preempt kill "
+              "differs from the uninterrupted run")
+        return 1
+    print("elastic_smoke: SIGKILL at train.preempt -> periodic checkpoint "
+          "carried a BYTE-identical resume")
+
+    # 5. the same checkpoint resharded onto 2 devices. The emergency ckpt
+    # from leg 3 was taken at an 8-device boundary — exactly the artifact a
+    # shrunken preemption slice must be able to consume.
+    r = _run(["reshard", mid_ckpt, reshard_out], _env(2), tag="reshard-8to2")
+    assert "resharding data@8" in r.stderr and "ulp" in r.stderr, (
+        "reshard warning missing from stderr: %s" % r.stderr[-600:])
+    ref_trees, re_trees = _trees(ref_out), _trees(reshard_out)
+    assert len(re_trees) == ROUNDS, len(re_trees)
+    drifted = 0
+    for i, (a, b) in enumerate(zip(ref_trees, re_trees)):
+        assert a[0] == b[0], "split features diverge at tree %d" % i
+        assert a[1] == b[1], "thresholds diverge at tree %d" % i
+        if a[2] != b[2]:
+            drifted += 1
+            for va, vb in zip(a[2], b[2]):
+                assert abs(va - vb) <= 2e-4 * max(abs(va), 1e-6) + 2e-6, (
+                    "leaf drift beyond ulp tolerance at tree %d" % i)
+    print("elastic_smoke: 8->2 reshard completed — split structure "
+          "identical, %d/%d trees with ulp-level leaf drift (warned)"
+          % (drifted, ROUNDS))
+
+    # 6. serial <-> data@1: world size unchanged -> byte-identical
+    ser_ckpt = os.path.join(work, "serial.ckpt")
+    ser_ref = os.path.join(work, "serial_ref.txt")
+    ser_out = os.path.join(work, "serial_as_data.txt")
+    _run(["ref", "", ser_ref], _env(1, learner="serial"), tag="serial-ref")
+    _run(["ckpt", ser_ckpt],
+         _env(1, learner="serial", faults="train.iteration:9:kill"),
+         expect_rc=-9, tag="serial-kill")
+    _run(["resume", ser_ckpt, ser_out], _env(1, learner="data"),
+         tag="serial-to-data1")
+    if _model_body(ser_out) != _model_body(ser_ref):
+        print("elastic_smoke FAILED: serial -> data@1 resume not "
+              "byte-identical")
+        return 1
+    print("elastic_smoke: serial -> data@1 resume BYTE-identical")
+
+    print("elastic_smoke OK: SIGKILL + SIGTERM(75) + auto-resume "
+          "byte-identity, 8->2 reshard structural identity, serial<->data@1 "
+          "byte-identity")
+    print(json.dumps({
+        "ok": True, "rounds": ROUNDS, "devices": 8,
+        "preempt_exit_code": 75, "byte_identical_after_preempt": True,
+        "byte_identical_after_preempt_kill": True,
+        "reshard_structural_match": True,
+        "reshard_drifted_trees": drifted,
+        "serial_data1_byte_identical": True,
+        "wall_s": round(time.time() - t0, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,19 +42,39 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, ".tpu_bringup.log")
 
 
-def _load_backoff():
-    """lightgbm_tpu.resil.backoff by FILE path: importing it through the
+def _load_resil(modname: str):
+    """A lightgbm_tpu.resil module by FILE path: importing it through the
     package would execute lightgbm_tpu/__init__ and pull jax into this
-    driver process, which stays jax-free on the no-trace path by design."""
+    driver process, which stays jax-free on the no-trace path by design.
+    Only the deliberately jax-free resil modules (backoff, preempt) load
+    this way."""
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
-        "lgbtpu_resil_backoff",
-        os.path.join(REPO, "lightgbm_tpu", "resil", "backoff.py"),
+        "lgbtpu_resil_%s" % modname,
+        os.path.join(REPO, "lightgbm_tpu", "resil", "%s.py" % modname),
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_backoff():
+    return _load_resil("backoff")
+
+
+_PREEMPT_RC = None
+
+
+def _preempt_exit_code() -> int:
+    """resil/preempt.py's PREEMPT_EXIT_CODE — the documented 'SIGTERMed
+    child published an emergency checkpoint; re-run to resume' exit code
+    run_with_retry treats as resumable. Cached: _run_child consults it on
+    every nonzero-rc child."""
+    global _PREEMPT_RC
+    if _PREEMPT_RC is None:
+        _PREEMPT_RC = int(_load_resil("preempt").PREEMPT_EXIT_CODE)
+    return _PREEMPT_RC
 
 
 # transient tunnel/TPU-client wedges (the relay dying and coming back, a
@@ -100,6 +120,10 @@ STAGE_TIMEOUTS = {
     "loop": 1800,   # continuous-training loop smoke: drift -> retrain ->
                     # validate -> publish -> swap + mid-publish SIGKILL
                     # recovery on the real serve stack (loop/, ISSUE 12)
+    "elastic": 1800,  # elastic preemption-tolerance smoke: SIGKILL ->
+                      # same-mesh resume byte-identity, SIGTERM -> exit-75
+                      # emergency checkpoint -> auto-resume, 8->2 reshard
+                      # structural identity (resil/, ISSUE 15)
     "bench": 3600,
 }
 
@@ -664,6 +688,12 @@ def _run_child(stage: str, argv, env=None) -> dict:
     if proc.returncode != 0 or result is None:
         result = {"ok": False, "error": "rc=%s" % proc.returncode,
                   "stderr_tail": err.strip()[-800:]}
+        if proc.returncode == _preempt_exit_code():
+            # the child was SIGTERMed mid-train and published an emergency
+            # checkpoint before exiting (resil/preempt.py): re-running the
+            # stage RESUMES it — run_with_retry treats this as transient
+            result["preempted"] = True
+            result["error"] = "preempted (rc=%s)" % proc.returncode
     result["wall_s"] = round(time.time() - t0, 1)
     log_line(stage, result)
     return result
@@ -674,11 +704,16 @@ def run_stage(stage: str, src: str) -> dict:
 
 
 def _is_transient(result: dict) -> bool:
-    """Only the wedge shape is worth retrying: a timeout-KILLED child (hung
-    tunnel / wedged TPU client, the failure this retry exists for). A child
-    that ran to completion and failed (nonzero rc, in-child assertion) is
-    deterministic — re-running it just doubles time-to-red on real TPU time
-    without new information."""
+    """Only two shapes are worth retrying: a timeout-KILLED child (hung
+    tunnel / wedged TPU client, the failure this retry exists for), and a
+    PREEMPTED child (exit code 75: it published an emergency checkpoint on
+    SIGTERM, so the re-run resumes the stage instead of restarting it —
+    docs/FaultTolerance.md §Elastic training). A child that ran to
+    completion and failed (other nonzero rc, in-child assertion) is
+    deterministic — re-running it just doubles time-to-red on real TPU
+    time without new information."""
+    if result.get("preempted"):
+        return True
     return str(result.get("error", "")).startswith("timeout")
 
 
@@ -703,8 +738,11 @@ def run_with_retry(stage: str, fn) -> dict:
             log_line(stage, {"retry_after_attempt": attempt,
                              "backoff_s": delay})
             print(
-                "bringup: stage %s failed (attempt %d/%d); retrying in %.0fs"
-                % (stage, attempt, attempts, delay),
+                "bringup: stage %s %s (attempt %d/%d); %s in %.0fs"
+                % (stage, "preempted" if result.get("preempted") else "failed",
+                   attempt, attempts,
+                   "resuming from its emergency checkpoint"
+                   if result.get("preempted") else "retrying", delay),
                 flush=True,
             )
             time.sleep(delay)
@@ -732,6 +770,21 @@ def run_loop(stage: str = "loop") -> dict:
     backend, not just the CPU CI box."""
     return _run_child(
         stage, [sys.executable, os.path.join(REPO, "helpers", "loop_smoke.py")]
+    )
+
+
+def run_elastic(stage: str = "elastic") -> dict:
+    """Elastic preemption-tolerance smoke (helpers/elastic_smoke.py,
+    ISSUE 15) — executed by FILE path in a child process, driver stays
+    jax-free. The child drives the full chain at forced-8-CPU-device
+    shapes: SIGKILL mid-run -> same-mesh resume byte-identical, SIGTERM ->
+    emergency checkpoint + exit 75 -> auto-resume byte-identical, plus the
+    8->2 reshard (structural identity, exact carries, loud warning). On
+    silicon this is the evidence a preempted pod run costs a boundary, not
+    the run."""
+    return _run_child(
+        stage,
+        [sys.executable, os.path.join(REPO, "helpers", "elastic_smoke.py")],
     )
 
 
@@ -938,6 +991,9 @@ def main() -> int:
                        # warm-start retrain -> gate -> publish -> swap with
                        # SIGKILL recovery on the real stack (ISSUE 12)
                        ("loop", "LOOP"),
+                       # elastic preemption tolerance: SIGKILL/SIGTERM ->
+                       # resume byte-identity + reshard chain (ISSUE 15)
+                       ("elastic", "ELASTIC"),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         with _stage_span(stage):
@@ -951,6 +1007,8 @@ def main() -> int:
                 runner = lambda s=stage: run_devprof(s)  # noqa: E731
             elif src == "LOOP":
                 runner = lambda s=stage: run_loop(s)  # noqa: E731
+            elif src == "ELASTIC":
+                runner = lambda s=stage: run_elastic(s)  # noqa: E731
             elif src is None:
                 runner = lambda s=stage: run_bench(s)  # noqa: E731
             else:

@@ -23,6 +23,7 @@ from .config import Config, load_config_file
 from .engine import train as train_api
 from .io import load_sidecar, load_text_file
 from .resil.atomic import atomic_write_text
+from .resil.preempt import PREEMPT_EXIT_CODE, TrainingPreempted
 from .utils import log
 from .utils.vfile import vopen
 from .utils.log import LightGBMError
@@ -121,6 +122,11 @@ def run_train(config: Config, params: Dict[str, str]) -> None:
         checkpoint_path=config.checkpoint_path or None,
         checkpoint_rounds=max(config.checkpoint_rounds, 0),
         resume_from=config.resume_from or None,
+        # checkpoint_keep / preempt_exit deliberately NOT passed as kwargs:
+        # they ride the params map engine.train pops, where an EXPLICIT
+        # preempt_exit=false wins over LIGHTGBM_TPU_PREEMPT=1 — a
+        # `config.preempt_exit or None` kwarg would collapse that false to
+        # "unset" and the env would re-arm the job
     )
     booster.save_model(config.output_model)
     log.info("Finished training; model saved to %s" % config.output_model)
@@ -237,6 +243,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                 run_serve(config, params)
             else:
                 log.fatal("Unknown task: %s" % config.task)
+    except TrainingPreempted as e:
+        # the preemption contract (docs/FaultTolerance.md §Elastic
+        # training): a durable emergency checkpoint was published at the
+        # last boundary, and the DISTINCT exit code tells orchestrators
+        # (loop restart, tpu_bringup run_with_retry) "resume me" instead
+        # of "I failed"
+        log.warning(
+            "train preempted (%s); emergency checkpoint: %s — re-run with "
+            "resume_from to continue; exiting %d"
+            % (e, e.checkpoint_path or "<none>", PREEMPT_EXIT_CODE)
+        )
+        return PREEMPT_EXIT_CODE
     except LightGBMError as e:
         # application_main's catch block ("Met Exceptions", main.cpp): a clean
         # message + nonzero exit, not a traceback

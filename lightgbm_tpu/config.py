@@ -292,6 +292,14 @@ class Config:
     checkpoint_path: str = ""
     checkpoint_rounds: int = -1
     resume_from: str = ""
+    # Elastic training (docs/FaultTolerance.md §Elastic training):
+    # checkpoint_keep=N retains the N newest archives (<path>, <path>.1 ...;
+    # resume falls back loudly past a torn newest); preempt_exit=true makes
+    # SIGTERM write an emergency boundary checkpoint and exit with the
+    # documented preemption code 75 (EX_TEMPFAIL) that loop/bringup
+    # auto-resume from (also armable via LIGHTGBM_TPU_PREEMPT=1).
+    checkpoint_keep: int = 1
+    preempt_exit: bool = False
     # Model/data observability (obs/flight.py, obs/modelstats.py,
     # docs/Observability.md): flight_record=<path> writes a JSONL run-event
     # log (manifest + per-iteration evals + per-tree gain/shape records);

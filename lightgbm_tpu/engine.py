@@ -19,6 +19,7 @@ from .obs import registry as obs_registry
 from .obs import sanitize as sanitize_mod
 from .obs import trace as trace_mod
 from .resil import faults
+from .resil import preempt as preempt_mod
 from .utils import timer as timer_mod
 from . import config as config_mod
 from .config import Config
@@ -46,6 +47,8 @@ def train(
     checkpoint_path: Optional[str] = None,
     checkpoint_rounds: int = 0,
     resume_from: Optional[str] = None,
+    checkpoint_keep: int = 0,
+    preempt_exit: Optional[bool] = None,
 ) -> Booster:
     params = dict(params) if params else {}
     params = Config.canonicalize(params)
@@ -66,6 +69,14 @@ def train(
     if "resume_from" in params:
         v = str(params.pop("resume_from"))
         resume_from = resume_from or v
+    if "checkpoint_keep" in params:
+        v = int(params.pop("checkpoint_keep"))
+        checkpoint_keep = checkpoint_keep if checkpoint_keep > 0 else v
+    if "preempt_exit" in params:
+        v = config_mod.coerce_bool(params.pop("preempt_exit"))
+        preempt_exit = v if preempt_exit is None else preempt_exit
+    if preempt_exit is None:
+        preempt_exit = preempt_mod.env_enabled()
     # model/data observability params (docs/Observability.md): POPPED like
     # the resil params so the model's parameters footer stays byte-identical
     # with recording on or off — the bitwise-identity contract the
@@ -193,7 +204,8 @@ def train(
             # boundary checkpoint_rounds iterations in
             ckpt_mod.check_checkpointable(booster._gbdt)
             ckpt_writer = ckpt_mod.CheckpointWriter(
-                checkpoint_path, checkpoint_rounds, cbs_after
+                checkpoint_path, checkpoint_rounds, cbs_after,
+                keep=max(checkpoint_keep, 1),
             )
 
     # Device-resident chunked boosting (GBDT.train_chunk): up to
@@ -249,6 +261,21 @@ def train(
             ),
         )
 
+    # preemption-aware training (resil/preempt.py): SIGTERM latches a flag
+    # the boost loop honors at the next chunk boundary — emergency
+    # checkpoint, then TrainingPreempted (exit code 75 at the process entry
+    # points). Mirrors serve/__main__.py's drain contract for the trainer.
+    preempt_watcher = None
+    if preempt_exit:
+        if ckpt_writer is None:
+            log.warning(
+                "preempt: preempt_exit armed without checkpoint_path — a "
+                "SIGTERM will exit with the preemption code but WITHOUT an "
+                "emergency checkpoint to resume from"
+            )
+        preempt_watcher = preempt_mod.PreemptionWatcher()
+        preempt_watcher.install()
+
     evaluation_result_list: List = []
     try:
         with timer_mod.maybe_profile():
@@ -257,11 +284,14 @@ def train(
                 is_valid_contain_train, train_data_name, init_iteration,
                 num_boost_round, cbs_before, cbs_after, chunk,
                 start_iteration=start_iteration, ckpt_writer=ckpt_writer,
+                preempt_watcher=preempt_watcher,
             )
         return _finish_train(
             booster, evaluation_result_list, flight_rec, model_stats
         )
     finally:
+        if preempt_watcher is not None:
+            preempt_watcher.uninstall()
         # a crashed/interrupted run (anywhere — the loop, the deferred stop
         # readback, the profiler, the harvest) still closes its flight log:
         # the records up to the failure are exactly the evidence wanted,
@@ -331,6 +361,7 @@ def _boost_loop(
     booster, params, fobj, feval, valid_sets, is_valid_contain_train,
     train_data_name, init_iteration, num_boost_round, cbs_before, cbs_after,
     chunk: int = 1, start_iteration: Optional[int] = None, ckpt_writer=None,
+    preempt_watcher=None,
 ):
     """The boosting iteration loop; returns the last evaluation result list.
 
@@ -445,11 +476,13 @@ def _boost_loop(
                     best_iteration=es.best_iteration + 1,
                 )
             break
+        wrote_boundary = False
         if ckpt_writer is not None and ckpt_writer.due(i, done):
             # after the boundary's eval + callbacks, so the early-stopping
             # bests captured are exactly the ones a resumed run needs next
             try:
                 ckpt_writer.write(booster, init_iteration, end)
+                wrote_boundary = True
                 if flight_on:
                     flight_mod.note_event("checkpoint", iteration=i)
             except LightGBMError:
@@ -464,6 +497,68 @@ def _boost_loop(
                     "last good checkpoint is intact"
                     % (type(e).__name__, str(e)[:200])
                 )
+        if (preempt_watcher is not None and preempt_watcher.requested()
+                and i < end and not finished):
+            # a latched SIGTERM is honored HERE, at a chunk boundary — the
+            # one place the full training state is checkpointable — but
+            # NOT when this boundary just finished the run (i == end, or
+            # the deferred no-split stop resolved): the trained model is
+            # complete in memory, and exiting 75 would throw it away just
+            # to retrain it on resume. Fault site train.preempt lets the
+            # crash tests SIGKILL between the signal and the emergency
+            # write (the last periodic checkpoint must carry the resume).
+            faults.maybe_fire("train.preempt")
+            ck_path = None
+            if ckpt_writer is not None:
+                from .obs import dist as dist_mod
+
+                if wrote_boundary:
+                    # this boundary's periodic checkpoint IS the state an
+                    # emergency save would capture — don't publish it twice
+                    ck_path = ckpt_writer.path
+                elif dist_mod.process_info()[1] > 1:
+                    # multi-process world: the emergency save would run the
+                    # coordinated digest barrier, but SIGTERM latch timing
+                    # is per-rank — a peer whose signal landed one boundary
+                    # later is inside its next collective, and waiting for
+                    # it would burn the whole kill grace window. The
+                    # periodic BARRIER checkpoints are the pod-coherent
+                    # recovery points; exit on the last one.
+                    log.warning(
+                        "preempt: multi-process world — skipping the "
+                        "emergency checkpoint (per-rank signal timing "
+                        "cannot run the coordinated save barrier); the "
+                        "last periodic checkpoint is the recovery point"
+                    )
+                else:
+                    try:
+                        ck_path = ckpt_writer.write(
+                            booster, init_iteration, end, emergency=True
+                        )
+                    except Exception as e:
+                        # the grace window is running out either way: exit
+                        # preempted on the last good periodic checkpoint
+                        log.warning(
+                            "preempt: emergency checkpoint failed (%s: %s); "
+                            "exiting on the last periodic checkpoint"
+                            % (type(e).__name__, str(e)[:200])
+                        )
+            if flight_on:
+                flight_mod.note_event(
+                    "preempted", iteration=i - 1, checkpoint=ck_path
+                )
+            log.warning(
+                "preempt: signal %d honored at iteration %d; emergency "
+                "checkpoint %s; exiting with the preemption code (%d)"
+                % (preempt_watcher.signum, i, ck_path or "<none>",
+                   preempt_mod.PREEMPT_EXIT_CODE)
+            )
+            raise preempt_mod.TrainingPreempted(
+                "training preempted by signal %d at iteration %d"
+                % (preempt_watcher.signum, i),
+                checkpoint_path=ck_path, iteration=i,
+                signum=preempt_watcher.signum,
+            )
         if finished:
             # the deferred no-split stop (models/gbdt.py) resolved at this
             # boundary: the splitless iteration was rolled back already

@@ -21,6 +21,7 @@ import sys
 
 import numpy as np
 
+from ..resil.preempt import PREEMPT_EXIT_CODE, TrainingPreempted
 from ..utils import log
 from .controller import (
     HttpDriftSource,
@@ -123,13 +124,26 @@ def main(argv=None) -> int:
         warm_start=not args.no_warm_start,
     )
     ctl = LoopController(cfg)
-    if ctl.ensure_bootstrap() and cfg.replicas:
-        ctl._swap_all(ctl._file_sha(cfg.model_path))
-    if args.once:
-        out = ctl.run_cycle(force=args.force)
-        log.info("loop: cycle outcome: %s" % out)
-        return 0
-    ctl.run_forever(max_cycles=args.max_cycles)
+    try:
+        if ctl.ensure_bootstrap() and cfg.replicas:
+            ctl._swap_all(ctl._file_sha(cfg.model_path))
+        if args.once:
+            out = ctl.run_cycle(force=args.force)
+            log.info("loop: cycle outcome: %s" % out)
+            return 0
+        ctl.run_forever(max_cycles=args.max_cycles)
+    except TrainingPreempted as e:
+        # a SIGTERMed retrain published its emergency checkpoint; exit with
+        # the preemption code so the supervisor restarts this command —
+        # the journal re-enters the cycle and _train resumes from the
+        # cycle's checkpoint instead of retraining from scratch
+        # (docs/FaultTolerance.md §Elastic training)
+        log.warning(
+            "loop: retrain preempted (%s); checkpoint %s — re-run this "
+            "command to resume; exiting %d"
+            % (e, e.checkpoint_path or "<none>", PREEMPT_EXIT_CODE)
+        )
+        return PREEMPT_EXIT_CODE
     return 0
 
 

@@ -504,6 +504,11 @@ class LoopController:
             # checkpoint carries the warm-start trees and the exact score
             # carries). A checkpoint that does not match this cycle's data
             # or config is refused loudly by restore — fall back to fresh.
+            # A SIGTERMed retrain (TrainingPreempted, exit code 75 at the
+            # CLI) re-enters HERE on restart too: its emergency checkpoint
+            # is just another resumable archive — and TrainingPreempted is
+            # deliberately NOT a LightGBMError, so the fallback below can
+            # never swallow a preemption and retrain from scratch.
             try:
                 bst = engine.train(
                     params, Dataset(X, label=y), rounds,

@@ -16,6 +16,7 @@ leaves (gbdt.cpp:308-413) — while the mechanics are TPU-shaped:
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -33,6 +34,8 @@ from ..obs import memwatch, retrace as retrace_mod
 from ..objective import ObjectiveFunction
 from ..ops import grow_native
 from ..ops.grow import grow_tree, grow_tree_scan, spec_batch_slots
+from ..resil import faults as faults_mod
+from ..resil import watchdog as watchdog_mod
 from ..ops.histogram import route_rows_variant as hist_route_rows_variant
 from ..ops.predict import PredictTree, make_predict_tree, tree_predict_value
 from ..ops.split import CegbParams, SplitParams
@@ -822,7 +825,18 @@ class GBDT:
                      fmasks, self._finish_scalar(0)) + tuple(extra),
                     {},
                 )
-            with sanitize_mod.transfer_scope("gbdt.train_chunk"):
+            sharded = self._learner_kind() == "data"
+            guard = (
+                watchdog_mod.collective_deadline("gbdt.train_chunk")
+                if sharded else contextlib.nullcontext()
+            )
+            with guard, sanitize_mod.transfer_scope("gbdt.train_chunk"):
+                if sharded:
+                    # the one fault site on the collective path, INSIDE the
+                    # watchdog scope: a `hang` action here is the
+                    # deadlocked-psum simulation the watchdog tests drive
+                    # (docs/FaultTolerance.md)
+                    faults_mod.maybe_fire("dist.collective")
                 self.scores, self._bag_mask, trees_out, nl_dev = fn(
                     self.scores, self._bag_mask, it_dev, fmasks,
                     self._finish_scalar(0), *extra,
@@ -851,7 +865,12 @@ class GBDT:
         self.iter_ += n
         self._pending_chunk = (nl_dev, n)
         if sync_stop or hasattr(self, "valid_scores"):
-            stopped = self._consume_pending_stop()
+            # the dispatch above is async on real backends: a deadlocked
+            # collective actually blocks HERE, at the first host readback —
+            # so the sharded path bounds this fence with the same deadline
+            with (watchdog_mod.collective_deadline("gbdt.chunk_boundary")
+                  if sharded else contextlib.nullcontext()):
+                stopped = self._consume_pending_stop()
             with timers.phase("valid scores"):
                 # the SURVIVING trees of this chunk (a stop pops its no-split
                 # tail first, so rolled-back trees never touch valid scores;

@@ -205,22 +205,21 @@ def _device_allgather(rows_np: np.ndarray) -> np.ndarray:
     return np.asarray(out.addressable_shards[0].data)
 
 
-def gather_snapshots(snap: Optional[Dict] = None) -> List[Dict]:
-    """Allgather every process's snapshot (host-side, over the
+def gather_payloads(payload: bytes) -> List[bytes]:
+    """Allgather one opaque byte payload per process (host-side, over the
     ``jax.distributed`` runtime): all ranks call this collectively, all
     ranks receive the full process-ordered list. With one process (or no
-    distributed init) the local snapshot is returned alone — the
-    single-host path needs no collective. Variable-length JSON blobs ride
-    a two-phase gather (lengths first, then max-padded bytes), with each
-    process's payload carried by its first local device."""
-    if snap is None:
-        snap = snapshot()
+    distributed init) the local payload is returned alone — the
+    single-host path needs no collective. Variable-length blobs ride a
+    two-phase gather (lengths first, then max-padded bytes), with each
+    process's payload carried by its first local device. Also the
+    transport of the checkpoint digest barrier (resil/coord.py)."""
     import jax
 
     world = int(jax.process_count())
     if world <= 1:
-        return [snap]
-    blob = np.frombuffer(json.dumps(snap).encode("utf-8"), np.uint8)
+        return [payload]
+    blob = np.frombuffer(bytes(payload), np.uint8)
     devices = jax.devices()
     me = int(jax.process_index())
     owner_row: Dict[int, int] = {}
@@ -238,19 +237,30 @@ def gather_snapshots(snap: Optional[Dict] = None) -> List[Dict]:
     lens_all = _device_allgather(lens_local)
     width = int(lens_all.max())
 
-    payload = np.zeros((len(local_rows), width), np.int32)
+    padded = np.zeros((len(local_rows), width), np.int32)
     for j, i in enumerate(local_rows):
         if i == my_row:
-            payload[j, : len(blob)] = blob.astype(np.int32)
-    data_all = _device_allgather(payload)
+            padded[j, : len(blob)] = blob.astype(np.int32)
+    data_all = _device_allgather(padded)
 
-    out: List[Dict] = []
+    out: List[bytes] = []
     for p in range(world):
         row = owner_row[p]
         n = int(lens_all[row, 0])
-        raw = bytes(data_all[row, :n].astype(np.uint8))
-        out.append(json.loads(raw.decode("utf-8")))
+        out.append(bytes(data_all[row, :n].astype(np.uint8)))
     return out
+
+
+def gather_snapshots(snap: Optional[Dict] = None) -> List[Dict]:
+    """Allgather every process's registry snapshot (the JSON round-trip
+    over :func:`gather_payloads`); all ranks receive the full
+    process-ordered list."""
+    if snap is None:
+        snap = snapshot()
+    return [
+        json.loads(raw.decode("utf-8"))
+        for raw in gather_payloads(json.dumps(snap).encode("utf-8"))
+    ]
 
 
 def write_snapshot(path: str,

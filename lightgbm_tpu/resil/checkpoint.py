@@ -28,9 +28,35 @@ One checkpoint file (npz, ``allow_pickle=False``) holds:
     eval history.
 
 Writes go through resil/atomic.py (temp + fsync + rename, fault site
-``checkpoint.write``), so a crash mid-save can never truncate a published
-checkpoint. DART is refused: it re-drops and rescales PAST trees per
-iteration through device arrays a text round-trip cannot reconstruct.
+``checkpoint.write``; emergency preemption saves fire ``ckpt.emergency``),
+so a crash mid-save can never truncate a published checkpoint. DART is
+refused: it re-drops and rescales PAST trees per iteration through device
+arrays a text round-trip cannot reconstruct.
+
+Elastic additions (docs/FaultTolerance.md §Elastic training):
+
+  * **resharded resume** — the archive stores the CANONICAL ``[K, N]``
+    carries (mesh padding dropped), so a checkpoint taken on one mesh
+    re-lands exactly onto any other serial/data-learner mesh: the restore
+    grafts the bit-exact carries and the sharded chunk path re-pads +
+    re-shards them on its next dispatch (parallel/mesh.shard_rows). When
+    the row world size is unchanged (serial <-> data@1, same device
+    count) the resumed run stays BYTE-identical; a world-size change is
+    allowed with a loud warning — the per-shard histogram psum grouping
+    changes, so post-resume leaf values drift at the ulp level while the
+    prefix trees and carries remain exact (docs/DataParallel.md
+    §Checkpoint semantics). Feature/voting learner mesh changes — and
+    num_data/num_class/num_features/boosting/valid-set identity changes —
+    stay loud refusals.
+  * **retention + torn-archive fallback** — ``checkpoint_keep=N`` rotates
+    the previous archive to ``<path>.1..N-1`` before each publish, and
+    :func:`load_checkpoint_any` falls back (loudly) to the newest
+    readable archive when the primary is truncated/corrupt.
+  * **coordinated multi-process checkpointing** — in a jax.distributed
+    world all ranks exchange a state digest (resil/coord.py) and must
+    agree before rank 0 — and only rank 0 — writes; resume verifies all
+    ranks loaded the same archive before any rank grafts. Every rank
+    heartbeats ``<path>.hb.rank<N>.json`` per boundary.
 """
 from __future__ import annotations
 
@@ -38,18 +64,32 @@ import collections
 import hashlib
 import io
 import json
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import dist as dist_mod
 from ..obs import registry as obs_registry
 from ..obs import trace as trace_mod
 from ..utils import log, vfile
 from ..utils.log import LightGBMError
+from . import coord
 from .atomic import atomic_write_bytes
 
 CHECKPOINT_VERSION = 1
 FAULT_SITE_WRITE = "checkpoint.write"
+#: the emergency (preemption) save's own fault site: the crash tests kill
+#: INSIDE the emergency publish's rename window and prove the previous
+#: periodic checkpoint survives for the resume (resil/preempt.py)
+FAULT_SITE_EMERGENCY = "ckpt.emergency"
+#: how many rotated siblings load_checkpoint_any probes (a bound, not a
+#: retention setting — retention is CheckpointWriter's ``keep``)
+MAX_ROTATED = 64
+#: per-path count of resume barriers THIS process has run: pod ranks
+#: resume in lockstep (same program), so the counter is symmetric across
+#: ranks and serves as the load-independent resume round id (see restore)
+_RESUME_SEQ: Dict[str, int] = {}
 
 
 def _json_scalar(obj):
@@ -133,15 +173,25 @@ def _stopper_states(cbs_after) -> List[Dict]:
 
 
 class CheckpointWriter:
-    """Cadence + serialization for engine._boost_loop's boundary hook."""
+    """Cadence + serialization for engine._boost_loop's boundary hook.
 
-    def __init__(self, path: str, rounds: int, cbs_after=None) -> None:
+    ``keep=N`` retains the N newest archives: before each publish the
+    previous ones shift ``<path>.1 -> <path>.2 -> ...`` (atomic renames)
+    and the live archive is COPIED to ``<path>.1`` — copied, not renamed,
+    so ``<path>`` holds a complete archive at every instant and a kill
+    anywhere inside the rotation can cost at most the oldest retained
+    copy. Resume probes the chain via :func:`load_checkpoint_any`.
+    """
+
+    def __init__(self, path: str, rounds: int, cbs_after=None,
+                 keep: int = 1) -> None:
         if rounds < 1:
             raise LightGBMError(
                 "checkpoint_rounds must be >= 1, got %d" % rounds
             )
         self.path = path
         self.rounds = rounds
+        self.keep = max(int(keep), 1)
         self._cbs_after = list(cbs_after or [])
         self.written = 0
 
@@ -151,13 +201,52 @@ class CheckpointWriter:
         step = max(done, 1)
         return iteration // self.rounds > (iteration - step) // self.rounds
 
-    def write(self, booster, begin_iteration: int, end_iteration: int) -> str:
-        with trace_mod.span("resil.checkpoint", cat="resil",
+    def _read_previous(self):
+        """The bytes of the current primary archive, snapshotted BEFORE the
+        new publish replaces it — or None when retention is off, the path
+        is remote (object stores version on their own), this rank is not
+        the chain's writer, or no archive exists yet."""
+        if (self.keep <= 1 or vfile.is_remote(self.path)
+                or not os.path.exists(self.path)
+                or dist_mod.process_info()[0] != 0):
+            # rank 0 is the shared chain's only writer in a multi-process
+            # world — concurrent per-rank rotations would race the renames
+            return None
+        with open(self.path, "rb") as fh:
+            return fh.read()
+
+    def _rotate(self, prev_bytes: bytes) -> None:
+        """Shift the chain and land the snapshotted previous archive at
+        ``.1`` — called only AFTER a successful publish, so a failed save
+        (tolerated by the boost loop) can never consume retention slots
+        and evict distinct history with duplicate copies of an unchanged
+        primary."""
+        for i in range(self.keep - 1, 1, -1):
+            src = "%s.%d" % (self.path, i - 1)
+            if os.path.exists(src):
+                os.replace(src, "%s.%d" % (self.path, i))
+        atomic_write_bytes("%s.1" % self.path, prev_bytes)
+
+    def write(self, booster, begin_iteration: int, end_iteration: int,
+              emergency: bool = False) -> str:
+        span = "resil.ckpt_emergency" if emergency else "resil.checkpoint"
+        with trace_mod.span(span, cat="resil",
                             iteration=booster.current_iteration):
+            prev_bytes = self._read_previous()
             out = save_checkpoint(
                 self.path, booster, begin_iteration, end_iteration,
                 self._cbs_after,
+                fault_site=(
+                    FAULT_SITE_EMERGENCY if emergency else FAULT_SITE_WRITE
+                ),
             )
+            if prev_bytes is not None:
+                self._rotate(prev_bytes)
+        if emergency:
+            obs_registry.REGISTRY.counter(
+                "resil_emergency_checkpoints",
+                "preemption-triggered boundary checkpoints",
+            ).inc()
         self.written += 1
         return out
 
@@ -178,9 +267,13 @@ def check_checkpointable(gbdt) -> None:
 
 def save_checkpoint(
     path: str, booster, begin_iteration: int, end_iteration: int,
-    cbs_after=None,
+    cbs_after=None, fault_site: str = FAULT_SITE_WRITE,
 ) -> str:
-    """Capture the full training state at the current boundary; atomic."""
+    """Capture the full training state at the current boundary; atomic.
+
+    In a multi-process world every rank calls this collectively: all ranks
+    heartbeat, exchange a state digest and must agree (resil/coord.py),
+    then ONLY rank 0 publishes the archive."""
     gbdt = booster._gbdt
     check_checkpointable(gbdt)
     # resolve the deferred no-split check BEFORE capturing: it reads the same
@@ -224,6 +317,13 @@ def save_checkpoint(
     # canonical [K, N] carry: any sharded-chunk row padding is dropped so
     # the artifact bytes do not depend on the mesh that produced them
     arrays: Dict[str, np.ndarray] = {"scores": gbdt.scores_canonical_np()}
+    # the bagging mask CARRY, canonical [N]: with bagging_freq > 1 the mask
+    # drawn at the last redraw iteration persists across the window, so a
+    # resume landing mid-window must restore the exact mask — recomputing
+    # from the fold_in stream would only be possible by replaying the
+    # device permutation draw (found by the elastic smoke: resume at an
+    # unaligned boundary trained the wrong rows otherwise)
+    arrays["bag_mask"] = np.asarray(gbdt._bag_mask)[: gbdt.num_data]
     for i, vs in enumerate(getattr(gbdt, "valid_scores", [])):
         arrays["valid_scores_%d" % i] = np.asarray(vs)
     state = gbdt._feat_rng.get_state()
@@ -232,12 +332,50 @@ def save_checkpoint(
         "has_gauss": int(state[3]), "cached_gaussian": float(state[4]),
     }
     arrays["feat_rng_keys"] = np.asarray(state[1], np.uint32)
+    rank, world = dist_mod.process_info()
+    if not vfile.is_remote(path):
+        # liveness evidence for dead-rank detection: one tiny atomic blob
+        # per rank per boundary (coord.stale_ranks reads the ages)
+        coord.heartbeat(path, int(manifest["iteration"]), rank)
+    it = int(manifest["iteration"])
+    digest = None
+    if world > 1:
+        digest = coord.state_digest(
+            str(manifest["config_digest"]), it,
+            str(manifest["model_text"]), arrays,
+        )
+        coord.verify_consensus(
+            coord.exchange_digests(path, "save:%d" % it, digest, rank, world),
+            "the training state at iteration %d" % it,
+            path,
+        )
+        coord.barrier_counter()
+        if rank != 0:
+            # consensus reached: rank 0's archive is byte-equal to what
+            # this rank would have written, so one archive IS the pod's
+            # checkpoint — no per-rank copies to race or reconcile. The
+            # second exchange is the PUBLISH ACK: rank 0 posts it only
+            # after the atomic rename, so when this returns, code on any
+            # rank (a resume, an operator copy) sees the NEW archive — a
+            # follower racing ahead to load the stale one was observed
+            # deadlocking the resume barrier on skewed round ids.
+            log.info(
+                "checkpoint: rank %d/%d verified consensus at iteration "
+                "%d; rank 0 publishes %s"
+                % (rank, world, it, path)
+            )
+            coord.exchange_digests(
+                path, "saved:%d" % it, digest, rank, world
+            )
+            return path
     arrays["manifest"] = np.frombuffer(
         json.dumps(manifest, default=_json_scalar).encode("utf-8"), np.uint8
     )
     bio = io.BytesIO()
     np.savez(bio, **arrays)
-    atomic_write_bytes(path, bio.getvalue(), fault_site=FAULT_SITE_WRITE)
+    atomic_write_bytes(path, bio.getvalue(), fault_site=fault_site)
+    if world > 1:
+        coord.exchange_digests(path, "saved:%d" % it, digest, rank, world)
     obs_registry.REGISTRY.counter("resil_checkpoints").inc()
     log.info(
         "checkpoint: saved iteration %d to %s"
@@ -275,6 +413,70 @@ def _load_stopper_states(states: List[Dict], stoppers: List) -> None:
                 "(stopping_rounds / first_metric_only differ)"
             )
         stopper.load_state_dict(remaining.pop(idx))
+
+
+def _mesh_world(desc: Optional[Dict]) -> int:
+    """Row world size of a mesh desc: the number of shards the histogram
+    psum combines over — the ONE quantity that decides whether a reshard
+    preserves the f32 accumulation grouping. None (serial) is 1."""
+    if desc is None:
+        return 1
+    size = 1
+    for v in (desc.get("axes") or {}).values():
+        size *= int(v)
+    return size
+
+
+def check_reshard(ck_mesh: Optional[Dict], live_mesh: Optional[Dict]) -> bool:
+    """Classify a checkpoint-vs-live mesh change; returns True when the
+    resumed run stays byte-identical to the original.
+
+    The carries are stored canonically, the trees round-trip exactly and
+    the bagging/feature RNG streams are mesh-independent, so ANY
+    serial/data reshard re-enters cleanly — the only arithmetic that can
+    move is the per-shard histogram (and root) sum grouping, which is a
+    function of the row world size alone. Equal world (serial <-> data@1,
+    or a relabeled same-size mesh): byte-identical, says so. Different
+    world: allowed with a LOUD warning — post-resume leaf values drift at
+    the ulp level against the original mesh's uninterrupted run while
+    split structure and the exact carries are preserved
+    (docs/DataParallel.md §Checkpoint semantics). Feature/voting learner
+    changes refuse: their shard layout changes which features each shard
+    even histograms, not just the sum grouping."""
+    ck_kind = "serial" if ck_mesh is None else str(ck_mesh.get("learner"))
+    live_kind = "serial" if live_mesh is None else str(live_mesh.get("learner"))
+    for kind, side in ((ck_kind, "checkpoint"), (live_kind, "resumed setup")):
+        if kind not in ("serial", "data"):
+            raise LightGBMError(
+                "resharded resume supports the serial and data learners; "
+                "the %s uses the %s-parallel learner, whose shard layout "
+                "decides which features each shard computes — resume on an "
+                "identical mesh (docs/FaultTolerance.md §Elastic training)"
+                % (side, kind)
+            )
+    ck_w, live_w = _mesh_world(ck_mesh), _mesh_world(live_mesh)
+    obs_registry.REGISTRY.counter(
+        "resil_reshards", "checkpoint resumes onto a different mesh",
+    ).inc(**{"from": "%s@%d" % (ck_kind, ck_w),
+             "to": "%s@%d" % (live_kind, live_w)})
+    if ck_w == live_w:
+        log.info(
+            "resume: resharding %s@%d checkpoint onto %s@%d: the row world "
+            "size is unchanged, so the histogram accumulation grouping — "
+            "and the resumed run's bytes — match the original run"
+            % (ck_kind, ck_w, live_kind, live_w)
+        )
+        return True
+    log.warning(
+        "resume: resharding %s@%d checkpoint onto %s@%d: carries and "
+        "prefix trees re-land EXACTLY, but the sharded histogram "
+        "accumulation now groups over %d shard(s) instead of %d — "
+        "post-resume leaf values will drift at the ulp level against the "
+        "original mesh's uninterrupted run (split structure is preserved; "
+        "docs/DataParallel.md §Checkpoint semantics)"
+        % (ck_kind, ck_w, live_kind, live_w, live_w, ck_w)
+    )
+    return False
 
 
 class Checkpoint:
@@ -317,6 +519,54 @@ def load_checkpoint(path: str) -> Checkpoint:
     return Checkpoint(manifest, arrays)
 
 
+def rotated_paths(path: str):
+    """The retention chain resume probes: the primary, then every existing
+    ``<path>.N`` sibling in recency order. Gaps are skipped, not
+    chain-ending: a kill between the post-publish shift and the ``.1``
+    write leaves ``.2`` present with ``.1`` missing, and the older archive
+    must stay reachable."""
+    out = [path]
+    if not vfile.is_remote(path):
+        for i in range(1, MAX_ROTATED + 1):
+            p = "%s.%d" % (path, i)
+            if os.path.exists(p):
+                out.append(p)
+    return out
+
+
+def load_checkpoint_any(path: str):
+    """Load ``path``, falling back LOUDLY to the newest readable rotated
+    archive when it is truncated/corrupt/unreadable (a kill inside an
+    emergency save's publish, an NFS blip, a half-copied restore). Returns
+    ``(checkpoint, used_path)``; raises only when the whole chain is
+    unreadable — today's behavior for an un-rotated single archive."""
+    chain = rotated_paths(path)
+    errors = []
+    for i, p in enumerate(chain):
+        try:
+            ckpt = load_checkpoint(p)
+        except Exception as e:  # torn zip, OSError, version drift: keep probing
+            errors.append((p, "%s: %s" % (type(e).__name__, str(e)[:160])))
+            if i + 1 < len(chain):
+                log.warning(
+                    "resume: checkpoint %s unreadable (%s); falling back to "
+                    "the previous retained archive %s"
+                    % (p, errors[-1][1], chain[i + 1])
+                )
+            continue
+        if errors:
+            obs_registry.REGISTRY.counter(
+                "resil_ckpt_fallbacks",
+                "resumes that fell back past a torn/corrupt archive",
+            ).inc()
+        return ckpt, p
+    raise LightGBMError(
+        "no readable checkpoint at %s (probed %d archive(s): %s)"
+        % (path, len(errors),
+           "; ".join("%s -> %s" % pe for pe in errors))
+    )
+
+
 def restore(booster, path: str, cbs_after=None) -> Checkpoint:
     """Graft a checkpoint into a freshly built training booster.
 
@@ -330,9 +580,33 @@ def restore(booster, path: str, cbs_after=None) -> Checkpoint:
     from ..basic import Booster
 
     with trace_mod.span("resil.resume", cat="resil"):
-        ckpt = load_checkpoint(path)
+        ckpt, used_path = load_checkpoint_any(path)
         m = ckpt.manifest
         gbdt = booster._gbdt
+        rank, world = dist_mod.process_info()
+        if world > 1:
+            # all ranks must have read the SAME archive before any rank
+            # touches its live model: a stale NFS cache (or a torn primary
+            # that only SOME ranks fell back from) would otherwise train
+            # that rank against different trees/carries. The round id is a
+            # process-local resume sequence — deliberately NOT the loaded
+            # iteration, which is part of what is being verified: keying
+            # the round on it would turn the divergence this barrier
+            # exists to catch into a mutual timeout instead of the loud
+            # ranks-disagree error (the digest carries the iteration).
+            _RESUME_SEQ[path] = seq = _RESUME_SEQ.get(path, 0) + 1
+            coord.verify_consensus(
+                coord.exchange_digests(
+                    path, "resume#%d" % seq,
+                    coord.state_digest(
+                        str(m["config_digest"]), ckpt.iteration,
+                        str(m["model_text"]), ckpt.arrays,
+                    ),
+                    rank, world,
+                ),
+                "the loaded checkpoint (iteration %d)" % ckpt.iteration,
+                used_path,
+            )
         if type(gbdt).__name__ != m["boosting"]:
             raise LightGBMError(
                 "checkpoint was taken with boosting %r, resuming with %r"
@@ -371,26 +645,39 @@ def restore(booster, path: str, cbs_after=None) -> Checkpoint:
         live_mesh = _mesh_desc(gbdt)
         if "mesh" not in m:
             # pre-ISSUE-8 checkpoint: no shard layout was recorded, so a
-            # mismatch cannot be DETECTED — warn rather than reject a
-            # checkpoint that may well be on the identical layout
-            if live_mesh is not None:
+            # world-size change cannot be DETECTED — route it through the
+            # reshard path (the canonical carries re-land regardless) and
+            # say exactly what is and is not guaranteed
+            if live_mesh is None:
+                pass
+            elif str(live_mesh.get("learner")) in ("serial", "data"):
+                check_reshard(None, live_mesh)
+                log.warning(
+                    "resume: checkpoint predates mesh recording — the "
+                    "carries resharded onto the current mesh exactly, but "
+                    "the original shard layout is unknown: the resumed run "
+                    "is byte-identical only if the row world size is "
+                    "unchanged (treated as serial@1 above)"
+                )
+            else:
+                # feature/voting live learner: the archive may well have
+                # been taken on the IDENTICAL mesh, which cannot be
+                # verified — keep the PR-8 warn-and-proceed (refusing
+                # would make the legacy checkpoint permanently
+                # unresumable on the very layout that produced it)
                 log.warning(
                     "resume: checkpoint predates mesh recording; cannot "
                     "verify the shard layout matches — the resumed run is "
                     "bit-identical only if the device layout is unchanged"
                 )
         elif m["mesh"] != live_mesh:
-            # never silently re-shard the carries: per-shard histogram
-            # psums make the f32 accumulation grouping part of the model's
-            # arithmetic, so a different device count diverges from the
-            # original run (docs/DataParallel.md §Checkpoint semantics)
-            raise LightGBMError(
-                "checkpoint was taken on mesh %r but the resumed setup is "
-                "%r — the sharded histogram accumulation depends on the "
-                "device layout, so resuming would NOT replay the original "
-                "run; resume on an identical mesh (same tree_learner, same "
-                "device count / num_machines)" % (m["mesh"], live_mesh)
-            )
+            # resharded resume: the canonical [K, N] carries re-land onto
+            # the current mesh exactly (the sharded chunk path re-pads +
+            # re-shards on its next dispatch); check_reshard classifies
+            # whether the histogram accumulation grouping — the one mesh-
+            # dependent arithmetic — is preserved, warns/refuses per the
+            # taxonomy (docs/DataParallel.md §Checkpoint semantics)
+            check_reshard(m["mesh"], live_mesh)
         n_valid = len(getattr(gbdt, "valid_scores", []))
         if int(m["n_valid"]) != n_valid:
             raise LightGBMError(
@@ -429,6 +716,24 @@ def restore(booster, path: str, cbs_after=None) -> Checkpoint:
         # dispatch — padding is zeros there by construction, so the resumed
         # padded carry is byte-identical to the uninterrupted one)
         gbdt.scores = jnp.asarray(ckpt.arrays["scores"])
+        if "bag_mask" in ckpt.arrays:
+            gbdt._bag_mask = jnp.asarray(ckpt.arrays["bag_mask"])
+            if bool(gbdt.config.bagging_freq > 0
+                    and gbdt.config.bagging_fraction < 1.0):
+                gbdt._bagging_active = True
+        elif (gbdt.config.bagging_freq > 1
+              and gbdt.config.bagging_fraction < 1.0
+              and int(m["iter"]) % gbdt.config.bagging_freq != 0):
+            # pre-elastic checkpoint resumed mid-bagging-window: the carry
+            # mask was not recorded, and the first iterations until the
+            # next redraw will bag different rows than the original run
+            log.warning(
+                "resume: checkpoint predates bag-mask recording and the "
+                "resume lands mid-bagging-window (iteration %s, "
+                "bagging_freq=%d) — iterations until the next redraw will "
+                "NOT be bit-identical to the original run"
+                % (m["iter"], gbdt.config.bagging_freq)
+            )
         gbdt._chunk_carries_placed = False
         for i in range(n_valid):
             gbdt.valid_scores[i] = jnp.asarray(ckpt.arrays["valid_scores_%d" % i])
@@ -461,6 +766,6 @@ def restore(booster, path: str, cbs_after=None) -> Checkpoint:
     obs_registry.REGISTRY.counter("resil_resumes").inc()
     log.info(
         "resume: restored iteration %d from %s (end %d)"
-        % (ckpt.iteration, path, int(m["end_iteration"]))
+        % (ckpt.iteration, used_path, int(m["end_iteration"]))
     )
     return ckpt

@@ -1,0 +1,306 @@
+"""Coordinated multi-process checkpointing: one writer, all ranks agree.
+
+In a ``jax.distributed`` world every rank runs engine.train over its own
+row shards; a naive checkpoint would have every rank racing to publish the
+same archive. The coordination contract here (docs/FaultTolerance.md
+§Elastic training):
+
+  * **digest barrier** — at each cadence boundary every rank computes a
+    digest of its would-be checkpoint state (config digest, iteration,
+    canonical carry bytes, model text) and exchanges it with every other
+    rank; any disagreement is a LOUD error naming the ranks (a diverged
+    rank must never be silently checkpointed around), and no archive is
+    written.
+  * **rank-0 writes** — after consensus, only process 0 publishes the
+    archive (resil/atomic as always); the other ranks have verified their
+    state is byte-equal, so one archive IS the pod's checkpoint.
+  * **resume barrier** — before any rank grafts a loaded checkpoint into
+    its live booster, all ranks exchange the digest of what they LOADED;
+    a rank that read a different file (torn NFS cache, stale mount) fails
+    the whole resume loudly instead of training against its peers.
+  * **heartbeats** — every rank writes ``<ckpt>.hb.rank<N>.json`` at each
+    boundary; :func:`stale_ranks` turns their ages into dead-rank
+    evidence for operators and the collective watchdog's diagnostics.
+
+The exchange rides the same host-side allgather obs/dist.py built for
+pod metrics when the backend supports multi-process collectives, and
+falls back to atomic rank files under the checkpoint path otherwise
+(``LIGHTGBM_TPU_CKPT_COORD=collective|files|off`` overrides; ``off`` is
+the documented escape hatch for heterogeneous debugging sessions).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..obs import dist as dist_mod
+from ..obs import registry as obs_registry
+from ..utils import log, vfile
+from ..utils.log import LightGBMError
+from .atomic import atomic_write_text
+
+ENV_COORD = "LIGHTGBM_TPU_CKPT_COORD"
+ENV_COORD_TIMEOUT = "LIGHTGBM_TPU_CKPT_COORD_TIMEOUT_S"
+
+_POLL_S = 0.05
+
+#: non-empty once a collective exchange attempt failed in this process —
+#: every later barrier goes straight to the file transport (see below)
+_COLLECTIVE_BROKEN: List[bool] = []
+
+
+def coord_mode() -> str:
+    """"collective" (try the device allgather first), "files", or "off"."""
+    mode = os.environ.get(ENV_COORD, "collective")
+    if mode not in ("collective", "files", "off"):
+        log.warn_once(
+            "coord-bad-mode",
+            "coord: %s=%r is not collective/files/off; using collective"
+            % (ENV_COORD, mode),
+        )
+        return "collective"
+    return mode
+
+
+def coord_timeout_s() -> float:
+    try:
+        return float(os.environ.get(ENV_COORD_TIMEOUT, "") or 120.0)
+    except ValueError:
+        return 120.0
+
+
+def state_digest(config_digest: str, iteration: int, model_text: str,
+                 arrays: Dict) -> str:
+    """The per-rank checkpoint-state fingerprint the barrier compares.
+
+    Covers exactly what the archive would persist: training identity
+    (config digest + iteration), the model text, and the raw bytes of every
+    carry array — so two ranks agree iff their checkpoints would be
+    byte-interchangeable."""
+    h = hashlib.sha1()
+    h.update(str(config_digest).encode("utf-8"))
+    h.update(b"|%d|" % int(iteration))
+    h.update(hashlib.sha1(model_text.encode("utf-8")).digest())
+    for name in sorted(arrays):
+        h.update(name.encode("utf-8"))
+        h.update(np.ascontiguousarray(arrays[name]).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# the exchange
+# ---------------------------------------------------------------------------
+
+def _rank_file(path: str, rank: int, round_id: str) -> str:
+    # one file PER ROUND (round id hashed into the name): a fast rank must
+    # never overwrite its round-R blob with round R+1 before a slow rank
+    # has read R — the overwrite variant deadlocks exactly that race
+    # (observed: rank 0 posted save:2, saw consensus, advanced and
+    # replaced its file with save:4 while rank 1 was still polling for
+    # rank 0's save:2)
+    tag = hashlib.sha1(round_id.encode("utf-8")).hexdigest()[:10]
+    return "%s.coord.rank%d.%s.json" % (path, rank, tag)
+
+
+#: per-(path, rank): filenames of this process's recent round posts, so
+#: each new post can clean up rounds >= 2 behind. Retaining the PREVIOUS
+#: round is load-bearing: a rank can only advance past round R after every
+#: rank posted R, so peers may still be reading R while we post R+1 — but
+#: never R-1.
+_POSTED: Dict[Tuple[str, int], List[str]] = {}
+
+
+def _exchange_files(path: str, round_id: str, digest: str, rank: int,
+                    world: int, timeout_s: float) -> List[str]:
+    """File-based allgather: each rank atomically publishes its
+    (round, digest) blob next to the checkpoint under a per-round name and
+    polls until every rank has posted THIS round. A rank that never posts
+    is a loud timeout naming it."""
+    own = _rank_file(path, rank, round_id)
+    remote = vfile.is_remote(path)
+    if (path, rank) not in _POSTED and not remote:
+        # first exchange for this path in THIS process: sweep this rank's
+        # files from any previous incarnation — a dead run's posts share
+        # the deterministic round ids ("save:<iteration>") and would
+        # otherwise satisfy (or spuriously fail) a restarted run's barrier
+        # (remote URIs skip the glob sweep; object-store listings are not
+        # worth a per-run dependency — the consensus error names the
+        # cleanup when a stale blob bites)
+        import glob as glob_mod
+
+        for stale in glob_mod.glob("%s.coord.rank%d.*.json" % (path, rank)):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+    atomic_write_text(
+        own,
+        json.dumps({"round": round_id, "digest": digest, "rank": rank,
+                    "pid": os.getpid(), "time": time.time()}),
+        fsync=False,
+    )
+    posted = _POSTED.setdefault((path, rank), [])
+    if own not in posted:
+        posted.append(own)
+    while len(posted) > 2:  # keep current + previous round
+        old = posted.pop(0)
+        if remote:
+            continue
+        try:
+            os.unlink(old)
+        except OSError:
+            pass
+    deadline = time.monotonic() + timeout_s
+    digests: List[Optional[str]] = [None] * world
+    while True:
+        missing = []
+        for r in range(world):
+            if digests[r] is not None:
+                continue
+            try:
+                # the writer (atomic_write_text) is remote-aware, so the
+                # reads must be too: a builtin open() on a URI string
+                # would report every healthy rank "missing" forever
+                with vfile.vopen(_rank_file(path, r, round_id)) as fh:
+                    raw = fh.read()
+                blob = json.loads(
+                    raw.decode("utf-8") if isinstance(raw, bytes) else raw
+                )
+            except (OSError, ValueError):
+                missing.append(r)
+                continue
+            if blob.get("round") == round_id:
+                digests[r] = str(blob.get("digest"))
+            else:
+                missing.append(r)  # hash collision/stale content: wait
+        if not missing:
+            return [d for d in digests if d is not None]
+        if time.monotonic() > deadline:
+            raise LightGBMError(
+                "checkpoint coordination timed out after %.0fs at round %r: "
+                "rank(s) %s never posted — dead or wedged rank(s); see the "
+                "heartbeat files (%s.hb.rank*.json)"
+                % (timeout_s, round_id, missing, path)
+            )
+        time.sleep(_POLL_S)
+
+
+def _exchange_collective(digest: str) -> List[str]:
+    """Digest allgather over the jax.distributed world (obs/dist.py's
+    host-side gather). Raises when the backend cannot run multi-process
+    collectives — the caller falls back to files."""
+    blobs = dist_mod.gather_payloads(digest.encode("utf-8"))
+    return [b.decode("utf-8") for b in blobs]
+
+
+def exchange_digests(path: str, round_id: str, digest: str,
+                     rank: Optional[int] = None,
+                     world: Optional[int] = None,
+                     timeout_s: Optional[float] = None) -> List[str]:
+    """All ranks call this collectively; every rank receives the full
+    rank-ordered digest list. Single-process worlds short-circuit."""
+    if rank is None or world is None:
+        r, w = dist_mod.process_info()
+        rank = r if rank is None else rank
+        world = w if world is None else world
+    if world <= 1:
+        return [digest]
+    mode = coord_mode()
+    if mode == "off":
+        return [digest]
+    if mode == "collective" and not _COLLECTIVE_BROKEN:
+        try:
+            return _exchange_collective(digest)
+        except Exception as e:
+            # pin the fallback for the REST of the process: the barrier
+            # runs every cadence boundary, and re-probing a broken
+            # collective layer per boundary is both wasted work and — on
+            # jaxlibs whose failed multi-process CPU collectives corrupt
+            # client state — a crash risk (observed: a rank surviving its
+            # first failed attempt died on the second)
+            _COLLECTIVE_BROKEN.append(True)
+            log.warn_once(
+                "coord-collective-fallback",
+                "coord: device allgather unavailable (%s: %s); using the "
+                "rank-file exchange for the rest of this process"
+                % (type(e).__name__, str(e)[:160]),
+            )
+    return _exchange_files(
+        path, round_id, digest, rank, world,
+        coord_timeout_s() if timeout_s is None else timeout_s,
+    )
+
+
+def verify_consensus(digests: List[str], what: str, path: str) -> None:
+    """Loud on ANY disagreement, naming the ranks on each side."""
+    if len(set(digests)) <= 1:
+        return
+    groups: Dict[str, List[int]] = {}
+    for r, d in enumerate(digests):
+        groups.setdefault(d, []).append(r)
+    detail = "; ".join(
+        "ranks %s have %s" % (rs, d[:12]) for d, rs in sorted(groups.items())
+    )
+    raise LightGBMError(
+        "checkpoint coordination: ranks disagree on %s at %s (%s) — a "
+        "diverged or stale rank must be fixed, not checkpointed around. "
+        "If this pod was just restarted over the remains of a killed run, "
+        "a leftover %s.coord.rank*.json file from the previous incarnation "
+        "may be the disagreeing side: remove them and re-run"
+        % (what, path, detail, path)
+    )
+
+
+# ---------------------------------------------------------------------------
+# heartbeats / dead-rank evidence
+# ---------------------------------------------------------------------------
+
+def heartbeat_path(path: str, rank: int) -> str:
+    return "%s.hb.rank%d.json" % (path, rank)
+
+
+def heartbeat(path: str, iteration: int, rank: Optional[int] = None) -> str:
+    """One small atomic blob per rank per boundary: alive + where. No
+    fsync — liveness evidence need not survive a power cut, and a cadence
+    boundary must not pay a disk flush for it."""
+    if rank is None:
+        rank, _ = dist_mod.process_info()
+    out = heartbeat_path(path, rank)
+    atomic_write_text(
+        out,
+        json.dumps({"rank": rank, "iteration": int(iteration),
+                    "pid": os.getpid(), "time": time.time()}),
+        fsync=False,
+    )
+    return out
+
+
+def stale_ranks(path: str, world: int, max_age_s: float,
+                now: Optional[float] = None) -> List[Tuple[int, Optional[float]]]:
+    """Ranks whose heartbeat is older than ``max_age_s`` (age) or missing
+    entirely (None) — the dead-rank shortlist a hung-collective warning
+    points operators at."""
+    now = time.time() if now is None else now
+    out: List[Tuple[int, Optional[float]]] = []
+    for r in range(world):
+        try:
+            with open(heartbeat_path(path, r), encoding="utf-8") as fh:
+                blob = json.load(fh)
+            age = now - float(blob.get("time", 0.0))
+            if age > max_age_s:
+                out.append((r, age))
+        except (OSError, ValueError):
+            out.append((r, None))
+    return out
+
+
+def barrier_counter() -> None:
+    obs_registry.REGISTRY.counter(
+        "resil_ckpt_barriers",
+        "multi-process checkpoint digest barriers that reached consensus",
+    ).inc()

@@ -32,6 +32,16 @@ Site catalog (docs/FaultTolerance.md keeps the authoritative table):
                         inside the atomic rename window of each live-model
                         write (the rollback republish fires here too)
   ``loop.swap``         per replica hot-swap (promote AND rollback re-swap)
+  ``train.preempt``     between a latched preemption signal and its
+                        emergency checkpoint (engine._boost_loop; a kill
+                        here proves the last periodic checkpoint carries
+                        the resume — resil/preempt.py)
+  ``ckpt.emergency``    inside the EMERGENCY checkpoint's atomic rename
+                        window (resil/checkpoint.py via resil/atomic.py)
+  ``dist.collective``   before the sharded chunk dispatch (models/gbdt.py
+                        train_chunk, data learner only); the ``hang``
+                        action simulates a deadlocked psum for the
+                        collective watchdog (resil/watchdog.py)
 
 Determinism: occurrence counters are plain per-process integers — the same
 env var against the same workload fires at exactly the same point every run.

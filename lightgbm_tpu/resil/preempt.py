@@ -1,0 +1,120 @@
+"""Preemption-aware training: SIGTERM -> emergency checkpoint -> exit 75.
+
+TPU pods are preemptible: the scheduler sends SIGTERM, waits a grace
+window, then SIGKILLs. The serve stack already honors that contract with a
+graceful drain (serve/__main__.py); this module gives the TRAINING stack
+the matching behavior. When armed (``preempt_exit=true`` param or
+``LIGHTGBM_TPU_PREEMPT=1``), ``engine.train`` installs a SIGTERM handler
+that only sets a flag; the boost loop checks it at each chunk boundary,
+writes an EMERGENCY checkpoint through the ordinary resil/checkpoint
+machinery (atomic publish, fault site ``ckpt.emergency``), and raises
+:class:`TrainingPreempted`. Process entry points (``lightgbm_tpu`` CLI
+task=train, ``python -m lightgbm_tpu.loop``) translate that into exit code
+:data:`PREEMPT_EXIT_CODE`, which orchestrators — ``loop``'s restart
+contract and ``helpers/tpu_bringup.py``'s ``run_with_retry`` — recognize
+as "resume me", NOT "I failed": the re-run resumes from the emergency
+checkpoint instead of restarting the stage from scratch
+(docs/FaultTolerance.md §Elastic training).
+
+This module is deliberately jax-free: the bringup driver imports it by
+FILE path for the exit-code constant, exactly like resil/backoff.py.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional
+
+#: The documented preemption exit code: EX_TEMPFAIL from sysexits.h —
+#: "temporary failure, retry later", which is precisely the contract (the
+#: emergency checkpoint makes the retry a resume). Distinct from 0
+#: (success), 1 (real failure) and -signal codes (crash).
+PREEMPT_EXIT_CODE = 75
+
+ENV_PREEMPT = "LIGHTGBM_TPU_PREEMPT"
+
+
+def env_enabled() -> bool:
+    """Ambient opt-in: ``LIGHTGBM_TPU_PREEMPT=1`` arms preemption handling
+    for every train() in the process (the param form wins when given)."""
+    return os.environ.get(ENV_PREEMPT, "") in ("1", "true")
+
+
+class TrainingPreempted(Exception):
+    """Raised out of engine.train when a preemption signal was honored.
+
+    Deliberately NOT a LightGBMError: config-error handlers (e.g. the loop
+    controller's bad-checkpoint fallback) must never swallow a preemption
+    and retrain from scratch — the whole point is that the emergency
+    checkpoint carries the run.
+    """
+
+    def __init__(self, message: str, checkpoint_path: Optional[str] = None,
+                 iteration: int = -1, signum: int = 0) -> None:
+        super().__init__(message)
+        self.checkpoint_path = checkpoint_path
+        self.iteration = int(iteration)
+        self.signum = int(signum)
+
+
+class PreemptionWatcher:
+    """Latches a SIGTERM until the boost loop reaches a safe boundary.
+
+    The handler itself does nothing but record the signal (async-signal
+    safety: no I/O, no locks, no device calls from the signal frame — the
+    same rule serve's drain handler follows). ``install`` only succeeds on
+    the main thread (CPython restricts ``signal.signal`` to it); elsewhere
+    — e.g. a train() driven from a worker thread — it degrades to a warned
+    no-op and training proceeds un-armed. The previous handler is restored
+    on ``uninstall`` so nesting (a train inside a serve/loop process that
+    has its own SIGTERM contract) never leaks a stale handler.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM,)) -> None:
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self.signum = 0
+        self._previous = {}
+        self.installed = False
+
+    def _handler(self, signum, frame) -> None:
+        self.signum = int(signum)
+        self._event.set()
+
+    def install(self) -> bool:
+        if threading.current_thread() is not threading.main_thread():
+            from ..utils import log
+
+            log.warn_once(
+                "preempt-not-main-thread",
+                "preempt: train() is not on the main thread; SIGTERM "
+                "handling stays un-armed (signal handlers are main-thread "
+                "only)",
+            )
+            return False
+        for s in self.signals:
+            self._previous[s] = signal.signal(s, self._handler)
+        self.installed = True
+        return True
+
+    def uninstall(self) -> None:
+        if not self.installed:
+            return
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, OSError):  # interpreter teardown
+                pass
+        self._previous.clear()
+        self.installed = False
+
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+    def __enter__(self) -> "PreemptionWatcher":
+        self.install()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

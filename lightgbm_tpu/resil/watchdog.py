@@ -1,0 +1,175 @@
+"""Host-side deadline watchdog for sharded collective dispatch.
+
+A deadlocked collective — one pod rank dead while the others sit inside a
+psum — is the one distributed failure that produces NO error: every
+surviving rank blocks forever inside XLA. This watchdog bounds that wait
+from the HOST side: a timer armed around the sharded chunk dispatch (and
+its boundary fences) in ``GBDT.train_chunk``:
+
+  * at ``timeout_s``  — a loud warning naming the scope (the operator's
+    first evidence of a hang, while the process is still inspectable), and
+    ``resil_collective_deadline_total{scope=}`` increments;
+  * at ``timeout_s + grace_s`` — the watchdog raises
+    :class:`CollectiveDeadlineError` in the main thread (a real SIGINT to
+    the process, which interrupts blocking C calls; ``interrupt_main`` is
+    the fallback when a custom SIGINT handler is installed), turning a
+    silent wedge into an ordinary failed run that bringup/loop restart
+    machinery — and the checkpoint on disk — already know how to recover.
+
+Honesty note: the interrupt lands where Python (or an EINTR-aware C call)
+can deliver it. A host blocked INSIDE one native XLA call that retries
+EINTR (the true on-chip hang) sees the raise when the call returns —
+i.e. possibly never. The warning still fires
+(it runs on the watchdog thread), dead-rank heartbeat files
+(resil/coord.py) still age, and ``LIGHTGBM_TPU_COLLECTIVE_ABORT=1``
+escalates to ``os.abort()`` at the hard deadline for orchestrators that
+prefer a crashed rank (restartable) over a wedged one (invisible). On the
+CPU backend — and at the ``dist.collective`` fault site's ``hang`` action,
+which is how the tests exercise this — the interrupt lands immediately.
+
+Enabled via ``LIGHTGBM_TPU_COLLECTIVE_TIMEOUT_S=<seconds>`` (default off;
+one env read per scope when disabled, zero threads).
+"""
+from __future__ import annotations
+
+import _thread
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+ENV_TIMEOUT = "LIGHTGBM_TPU_COLLECTIVE_TIMEOUT_S"
+ENV_ABORT = "LIGHTGBM_TPU_COLLECTIVE_ABORT"
+
+
+class CollectiveDeadlineError(RuntimeError):
+    """A sharded dispatch exceeded its host-side deadline (suspected
+    collective deadlock)."""
+
+
+def env_timeout_s() -> float:
+    """Configured deadline in seconds; 0.0 = watchdog off."""
+    raw = os.environ.get(ENV_TIMEOUT, "")
+    if not raw:
+        return 0.0
+    try:
+        v = float(raw)
+    except ValueError:
+        from ..utils import log
+
+        log.warn_once(
+            "watchdog-bad-timeout",
+            "watchdog: %s=%r is not a number; collective watchdog stays off"
+            % (ENV_TIMEOUT, raw),
+        )
+        return 0.0
+    return max(v, 0.0)
+
+
+@contextmanager
+def collective_deadline(scope: str, timeout_s: Optional[float] = None,
+                        grace_s: Optional[float] = None):
+    """Bound the wall time of ``scope`` (warn at T, raise at T + grace).
+
+    ``timeout_s=None`` reads the env gate; 0 disables (plain passthrough,
+    no timers). ``grace_s`` defaults to ``timeout_s`` — warn at T, raise at
+    2T. Raising from the watchdog thread uses ``interrupt_main``, so the
+    in-scope ``KeyboardInterrupt`` is converted to
+    :class:`CollectiveDeadlineError`; a REAL Ctrl-C inside the scope is
+    re-raised untouched.
+    """
+    t = env_timeout_s() if timeout_s is None else float(timeout_s)
+    if t <= 0:
+        yield
+        return
+    g = t if grace_s is None else float(grace_s)
+    from ..obs import registry as obs_registry
+    from ..utils import log
+
+    state = {"warned": False, "raised": False}
+    in_main = threading.current_thread() is threading.main_thread()
+    if not in_main:
+        # escalation can only interrupt the MAIN thread; off it the
+        # watchdog degrades to warn-only — say so once instead of silently
+        # breaking the documented warn-then-raise contract
+        log.warn_once(
+            "watchdog-not-main-thread",
+            "watchdog: %s armed off the main thread — deadline breaches "
+            "will WARN but cannot raise (escalation interrupts the main "
+            "thread only)" % scope,
+        )
+
+    def _warn():
+        state["warned"] = True
+        obs_registry.REGISTRY.counter(
+            "resil_collective_deadline",
+            "sharded dispatches that exceeded the host-side deadline",
+        ).inc(scope=scope)
+        log.warning(
+            "watchdog: %s exceeded its %.1fs deadline — suspected hung "
+            "collective (dead rank mid-psum?); raising in %.1fs. Check the "
+            "checkpoint heartbeat files for a stale rank "
+            "(docs/FaultTolerance.md §Elastic training)" % (scope, t, g)
+        )
+
+    def _escalate():
+        if state.get("done"):
+            return  # the scope completed as the timer fired: stand down
+        state["raised"] = True
+        log.warning(
+            "watchdog: %s still blocked at the hard deadline (%.1fs); "
+            "raising CollectiveDeadlineError" % (scope, t + g)
+        )
+        if os.environ.get(ENV_ABORT, "") == "1":
+            # the operator prefers a crashed rank (their supervisor
+            # restarts it) over a wedged one a native hang could make
+            # uninterruptible; done re-checked at the last instant — a
+            # scope completing exactly at the deadline must not abort a
+            # healthy process (same guard as the SIGINT branch below)
+            if not state.get("done"):
+                os.abort()
+            return
+        if in_main and not state.get("done"):
+            # done re-checked at the last instant: the scope completing at
+            # exactly the deadline must not eat a stray interrupt later
+            import signal
+
+            if signal.getsignal(signal.SIGINT) is signal.default_int_handler:
+                # a real SIGINT interrupts blocking C calls (time.sleep,
+                # many syscalls) immediately; interrupt_main only sets the
+                # eval-loop flag, which a blocked call never checks
+                os.kill(os.getpid(), signal.SIGINT)
+            else:
+                _thread.interrupt_main()
+            state["fired"] = True
+
+    warn_timer = threading.Timer(t, _warn)
+    raise_timer = threading.Timer(t + g, _escalate)
+    warn_timer.daemon = raise_timer.daemon = True
+    warn_timer.start()
+    raise_timer.start()
+    try:
+        yield
+    except KeyboardInterrupt:
+        if state["raised"]:
+            state["converted"] = True
+            raise CollectiveDeadlineError(
+                "%s exceeded its %.1fs collective deadline (+%.1fs grace) — "
+                "suspected deadlocked collective; the last checkpoint on "
+                "disk is the recovery point" % (scope, t, g)
+            ) from None
+        raise
+    finally:
+        state["done"] = True
+        warn_timer.cancel()
+        raise_timer.cancel()
+        if state.get("fired") and not state.get("converted"):
+            # the scope completed in the instant the escalation fired: its
+            # SIGINT/interrupt may still be in flight toward the main
+            # thread — absorb it here instead of letting a healthy run die
+            # later with an unexplained KeyboardInterrupt
+            try:
+                time.sleep(0.1)
+            except KeyboardInterrupt:
+                pass

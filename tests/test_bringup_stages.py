@@ -28,7 +28,7 @@ def test_stage_table_complete():
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "tune",
         "bench_early", "smoke_pallas", "smoke_xla_radix", "smoke_bf16",
         "smoke_psplit", "bench_chunk", "bench_multichip", "bench_predict",
-        "prof", "devprof", "san", "loop", "bench",
+        "prof", "devprof", "san", "loop", "elastic", "bench",
     }
 
 
@@ -282,3 +282,55 @@ def test_run_loop_invokes_smoke_by_file_path(monkeypatch):
     assert seen["argv"][-1].endswith(
         _os.path.join("helpers", "loop_smoke.py")
     )
+
+
+def test_run_elastic_invokes_smoke_by_file_path(monkeypatch):
+    """The elastic stage (ISSUE 15) executes helpers/elastic_smoke.py by
+    FILE path in a child — the driver stays jax-free; the child spawns its
+    own forced-CPU-device workers."""
+    import os as _os
+
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["stage"] = stage
+        seen["argv"] = argv
+        return {"ok": True}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    r = tb.run_elastic()
+    assert r["ok"] and seen["stage"] == "elastic"
+    assert seen["argv"][-1].endswith(
+        _os.path.join("helpers", "elastic_smoke.py")
+    )
+
+
+def test_preempt_exit_code_is_transient_and_resumable():
+    """run_with_retry must recognize the documented preemption exit code
+    (75, EX_TEMPFAIL — loaded from resil/preempt.py by file path so driver
+    and trainer can never drift apart) as a RESUME signal, while ordinary
+    in-child failures stay deterministic no-retries."""
+    from lightgbm_tpu.resil.preempt import PREEMPT_EXIT_CODE
+
+    assert tb._preempt_exit_code() == PREEMPT_EXIT_CODE == 75
+    assert tb._is_transient({"preempted": True, "error": "preempted (rc=75)"})
+    assert tb._is_transient({"error": "timeout after 180s"})
+    assert not tb._is_transient({"error": "rc=1"})
+
+
+def test_run_child_marks_preempted_exit(monkeypatch, tmp_path):
+    """A stage child exiting with the preemption code is recorded as
+    preempted (so retry resumes it) rather than a plain rc failure."""
+    import sys as _sys
+
+    monkeypatch.setattr(tb, "LOG", str(tmp_path / "bringup.log"))
+    r = tb._run_child(
+        "elastic",
+        [_sys.executable, "-c", "import sys; sys.exit(75)"],
+    )
+    assert r.get("preempted") is True
+    assert r["error"].startswith("preempted")
+    r2 = tb._run_child(
+        "elastic", [_sys.executable, "-c", "import sys; sys.exit(3)"]
+    )
+    assert not r2.get("preempted")

@@ -440,3 +440,77 @@ def test_two_process_data_parallel_training(tmp_path):
         "multi-process voting tree structure differs from serial"
     )
     assert r0["vp_close_ok"] and r1["vp_close_ok"]
+
+
+CKPT_COORD_WORKER = textwrap.dedent(
+    """
+    import os, sys, json, hashlib
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # pin the rank-file transport: this container's jaxlib cannot run
+    # multi-process CPU collectives (the three device-collective tests in
+    # this module skip for the same reason), and its FAILED collective
+    # attempts are unstable on repetition — the production path for that
+    # situation is exactly this documented fallback
+    os.environ["LIGHTGBM_TPU_CKPT_COORD"] = "files"
+    rank, world, port, workdir = (int(sys.argv[1]), int(sys.argv[2]),
+                                  sys.argv[3], sys.argv[4])
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address="127.0.0.1:" + port,
+                               num_processes=world, process_id=rank)
+    sys.path.insert(0, "@REPO@")
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import engine
+    from lightgbm_tpu.obs.registry import REGISTRY
+    from lightgbm_tpu.resil import coord
+
+    # identical data on every rank: the serial learner trains the SAME
+    # model per rank, so the digest barrier must reach consensus and rank 0
+    # alone publishes the archive (resil/coord.py). On jaxlibs without
+    # multi-process CPU collectives the device allgather raises and the
+    # exchange takes the documented rank-file fallback.
+    rng = np.random.RandomState(13)
+    X = rng.randn(200, 4); y = (X[:, 0] > 0).astype(float)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    ck = os.path.join(workdir, "pod.ckpt")
+    bst = engine.train(params, lgb.Dataset(X, label=y), 4,
+                       checkpoint_path=ck, checkpoint_rounds=2,
+                       verbose_eval=False)
+    barriers = REGISTRY.counter("resil_ckpt_barriers").value()
+    # resume: all ranks verify they loaded the same archive before grafting
+    resumed = engine.train(params, lgb.Dataset(X, label=y), 4,
+                           resume_from=ck, verbose_eval=False)
+    print("RESULT " + json.dumps({
+        "rank": rank,
+        "barriers": barriers,
+        "archive_exists": os.path.exists(ck),
+        "hb_self": os.path.exists(coord.heartbeat_path(ck, rank)),
+        "stale": coord.stale_ranks(ck, world, max_age_s=300.0),
+        "digest": hashlib.sha256(
+            resumed.model_to_string().encode()).hexdigest(),
+    }), flush=True)
+    """
+).replace("@REPO@", REPO)
+
+
+def test_two_process_checkpoint_coordination(tmp_path):
+    """Coordinated multi-process checkpointing over a REAL two-process
+    jax.distributed world (resil/coord.py): the per-boundary digest
+    barrier reaches consensus (via the host allgather where the backend
+    supports multi-process computations, else the documented rank-file
+    fallback), rank 0 alone publishes the archive, both ranks heartbeat,
+    and the resume barrier lets both ranks graft the same bytes."""
+    workdir = tmp_path / "ckpt_world"
+    workdir.mkdir()
+    results = _launch_world_retrying(
+        CKPT_COORD_WORKER, workdir, tmp_path, 40, "ckpt_worker.py"
+    )
+    r0, r1 = sorted(results, key=lambda r: r["rank"])
+    assert r0["archive_exists"] and r1["archive_exists"]
+    assert r0["digest"] == r1["digest"], "ranks resumed different models"
+    for r in (r0, r1):
+        assert r["barriers"] >= 1, "digest barrier never ran"
+        assert r["hb_self"], "rank %d wrote no heartbeat" % r["rank"]
+        assert r["stale"] == [], "fresh heartbeats reported stale: %r" % (
+            r["stale"],)

@@ -289,24 +289,37 @@ def test_checkpoint_resume_sharded_bit_identical(tmp_path):
     assert resumed._gbdt.device_chunk_fallback_reason() is None
 
 
-def test_checkpoint_mesh_mismatch_is_loud(tmp_path):
-    from lightgbm_tpu.utils.log import LightGBMError
-
+def test_checkpoint_mesh_change_resharded(tmp_path, capfd):
+    """A mesh change is no longer fatal (ISSUE 15): the canonical carries
+    reshard onto the current mesh — a world-size change proceeds with the
+    LOUD not-bit-identical warning, and a serial resume of a sharded
+    checkpoint re-lands cleanly. The byte-identity/structure matrix lives
+    in tests/test_elastic.py; genuinely incompatible changes (learner
+    kinds beyond serial/data) still refuse."""
     X, y = _data(8)
-    base = dict(_BINARY, verbosity=-1, tree_learner="data",
+    base = dict(_BINARY, verbosity=0, tree_learner="data",
                 device_chunk_size=3)
     ck = str(tmp_path / "mesh.ckpt")
     lgb.train(dict(base, num_machines=2), lgb.Dataset(X, label=y), 6,
               checkpoint_path=ck, checkpoint_rounds=3, verbose_eval=False)
-    # different device count: loud error, never silently re-sharded carries
-    with pytest.raises(LightGBMError, match="mesh"):
-        lgb.train(dict(base, num_machines=4), lgb.Dataset(X, label=y), 6,
-                  resume_from=ck, verbose_eval=False)
-    # different learner (serial) is just as loud
-    with pytest.raises(LightGBMError, match="mesh"):
-        lgb.train(dict(base, tree_learner="serial"),
-                  lgb.Dataset(X, label=y), 6, resume_from=ck,
-                  verbose_eval=False)
+    if len(jax.devices()) < 2:
+        pytest.skip("reshard engages only with a real multi-device mesh")
+    # different device count: resumes, warns, completes the full run
+    capfd.readouterr()
+    resumed = lgb.train(dict(base, num_machines=4), lgb.Dataset(X, label=y),
+                        6, resume_from=ck, verbose_eval=False)
+    err = capfd.readouterr().err
+    assert "resharding data@2" in err and "ulp" in err
+    assert resumed.current_iteration == 6
+    # different learner (serial): reshards too — data@2 -> serial@1 also
+    # changes the world size, so the same loud warning fires
+    capfd.readouterr()
+    resumed = lgb.train(dict(base, tree_learner="serial"),
+                        lgb.Dataset(X, label=y), 6, resume_from=ck,
+                        verbose_eval=False)
+    err = capfd.readouterr().err
+    assert "resharding data@2" in err
+    assert resumed.current_iteration == 6
 
 
 # ---------------------------------------------------------------------------
