@@ -92,6 +92,16 @@
 #                               # structural identity), serial<->data@1
 #                               # byte-identity (docs/FaultTolerance.md
 #                               # §Elastic training)
+#   helpers/check.sh --ir       # lint gate, then the graftir program
+#                               # audit smoke: ONE invocation — seeded
+#                               # violations per IR rule all caught, then
+#                               # the real tree's registered jit entry
+#                               # points traced abstractly over the quick
+#                               # shape lattice and checked against the
+#                               # IR001-IR006 baseline + the checked-in
+#                               # program-fingerprint contract
+#                               # (docs/StaticAnalysis.md §Program-level
+#                               # audit)
 #   helpers/check.sh --bench-diff [CUR BASE]
 #                               # the bench regression gate: golden-fixture
 #                               # self-test (synthetic regression must FAIL,
@@ -110,9 +120,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--elastic|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--elastic|--ir|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof, --elastic or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof, --elastic, --ir or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -209,6 +219,11 @@ fi
 if [ "$MODE" = "--elastic" ]; then
     echo "== elastic smoke (SIGKILL/SIGTERM -> resume byte-identity + 8->2 reshard) =="
     exec python helpers/elastic_smoke.py
+fi
+
+if [ "$MODE" = "--ir" ]; then
+    echo "== irscan smoke (seeded IR violations caught + real-tree scan vs baseline/contract) =="
+    exec python helpers/irscan_smoke.py
 fi
 
 if [ "$MODE" = "--bench-diff" ]; then

@@ -102,6 +102,12 @@ STAGE_TIMEOUTS = {
                     # at the grower's bucket-shape distribution, persisted
                     # as TUNE_HIST.json for bench/training auto-adoption
                     # (obs/tune.py, ISSUE 13)
+    "irscan": 1800,  # graftir program audit: seeded IR001-IR006 violations
+                     # caught + the real tree's jit entry points traced
+                     # abstractly and checked against the baseline +
+                     # fingerprint contract — the traced programs audited
+                     # BEFORE bench spends chip time on them (obs/irscan.py,
+                     # ISSUE 16)
     "bench_early": 3600,  # headline secured before the long tail of stages
     "smoke_pallas": 1800,  # same smoke, pallas histogram impl (routing race)
     "smoke_xla_radix": 1800,  # same smoke, plain-XLA radix factorization
@@ -802,6 +808,24 @@ def run_devprof(stage: str = "devprof") -> dict:
     )
 
 
+def run_irscan(stage: str = "irscan") -> dict:
+    """graftir program-audit smoke (helpers/irscan_smoke.py, ISSUE 16) —
+    executed by FILE path in a child process, driver stays jax-free. The
+    child proves each seeded IR001-IR006 violation is caught, then traces
+    every registered jit entry point abstractly (no program executes) and
+    checks the real tree against the findings baseline and the checked-in
+    program-fingerprint contract — so a hot-path program that drifted
+    (dropped donation, stripped FMA pin, f64 leak, baked constant, rogue
+    collective axis) fails HERE, before bench_early spends chip time
+    compiling and running it. On a TPU env the contract check self-reports
+    as skipped (fingerprints are pinned per environment) while the rules
+    and seeded checks still gate."""
+    return _run_child(
+        stage,
+        [sys.executable, os.path.join(REPO, "helpers", "irscan_smoke.py")],
+    )
+
+
 def run_tune(stage: str = "tune") -> dict:
     """Histogram autotune sweep (obs/tune.py, ISSUE 13) — a child process
     (`python -m lightgbm_tpu.obs.tune`, driver stays jax-free) races every
@@ -956,6 +980,13 @@ def main() -> int:
                        # later training) already routes each bucket shape
                        # to its measured winner (obs/tune.py, ISSUE 13)
                        ("tune", "TUNE"),
+                       # program-level audit BEFORE any bench: the traced
+                       # entry points (incl. the tune-routed histogram
+                       # impls) are linted at the jaxpr/StableHLO level —
+                       # a drifted program fails in seconds here instead
+                       # of poisoning an hour of bench wall-clock
+                       # (obs/irscan.py, ISSUE 16)
+                       ("irscan", "IRSCAN"),
                        # headline FIRST after routing is measured: the
                        # relay has died mid-bringup in three of four
                        # rounds; with smoke+smoke_seq in the summary the
@@ -1003,6 +1034,8 @@ def main() -> int:
                 runner = lambda s=stage: run_tune(s)  # noqa: E731
             elif src == "SAN":
                 runner = lambda s=stage: run_san(s)  # noqa: E731
+            elif src == "IRSCAN":
+                runner = lambda s=stage: run_irscan(s)  # noqa: E731
             elif src == "DEVPROF":
                 runner = lambda s=stage: run_devprof(s)  # noqa: E731
             elif src == "LOOP":

@@ -66,7 +66,14 @@ class RetraceWatchdog:
         # gauge (obs/__init__.py) stays for dashboards that sum anyway
         try:
             registry_mod.REGISTRY.gauge("jit_traces").set(count, name=name)
-        except Exception as e:  # metrics must never break a trace
+        except TypeError as e:
+            # the ONE error this call can actually raise: a metric-kind
+            # collision in MetricsRegistry._get_or_create ("jit_traces"
+            # already registered as a counter/histogram). Gauge.set itself
+            # is float()+dict-store and cannot fail on an int count. Metrics
+            # must never break a trace, so log and continue — but anything
+            # ELSE propagates rather than being silently swallowed (JX008's
+            # own standard, applied to obs code)
             log.debug("retrace: jit_traces gauge update failed: %r" % e)
         if retrace:
             msg = (

@@ -26,9 +26,10 @@ def test_stage_table_complete():
     """Every stage run by main() has a timeout entry, and vice versa."""
     assert set(tb.STAGE_TIMEOUTS) == {
         "matmul", "pallas", "pack4", "smoke", "smoke_seq", "tune",
-        "bench_early", "smoke_pallas", "smoke_xla_radix", "smoke_bf16",
-        "smoke_psplit", "bench_chunk", "bench_multichip", "bench_predict",
-        "prof", "devprof", "san", "loop", "elastic", "bench",
+        "irscan", "bench_early", "smoke_pallas", "smoke_xla_radix",
+        "smoke_bf16", "smoke_psplit", "bench_chunk", "bench_multichip",
+        "bench_predict", "prof", "devprof", "san", "loop", "elastic",
+        "bench",
     }
 
 
@@ -260,6 +261,41 @@ def test_run_devprof_invokes_smoke_by_file_path(monkeypatch):
     assert r["ok"] and seen["stage"] == "devprof"
     assert seen["argv"][-1].endswith(
         _os.path.join("helpers", "devprof_smoke.py")
+    )
+
+
+def test_run_irscan_invokes_smoke_by_file_path(monkeypatch):
+    """The irscan stage (ISSUE 16) executes helpers/irscan_smoke.py by
+    FILE path in a child — the driver never imports the package (stays
+    jax-free); the child proves the seeded IR violations are caught, then
+    scans the real tree's traced programs against baseline + contract
+    BEFORE any bench stage spends chip time on them."""
+    import os as _os
+
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["stage"] = stage
+        seen["argv"] = argv
+        return {"ok": True, "entries": 9}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    r = tb.run_irscan()
+    assert r["ok"] and seen["stage"] == "irscan"
+    assert seen["argv"][-1].endswith(
+        _os.path.join("helpers", "irscan_smoke.py")
+    )
+
+
+def test_irscan_stage_runs_before_bench():
+    """The audit is only worth a stage slot if it actually precedes the
+    bench spends: main()'s ordered stage tuple must run irscan after tune
+    (so the routed impls are what gets audited) and before bench_early."""
+    import inspect
+
+    src = inspect.getsource(tb.main)
+    assert src.index('("tune"') < src.index('("irscan"') < src.index(
+        '("bench_early"'
     )
 
 

@@ -613,3 +613,124 @@ def test_cli_list_rules(capsys):
     assert rc == 0
     for rule_id in ALL_RULES:
         assert rule_id in out
+
+
+def test_prune_baseline_drops_stale_entries(tmp_path, capsys):
+    """--prune-baseline rewrites the baseline dropping suppressions for
+    findings that no longer exist in the scanned set, printing each pruned
+    line — while keeping live suppressions (with their justifications) and
+    entries for files the run never parsed."""
+    src = (
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.float64)\n"
+    )
+    p = tmp_path / "leak.py"
+    p.write_text(src)
+    findings = run_lint([str(p)], root=str(tmp_path))
+    assert findings, "fixture must produce a real finding to keep"
+    live_key = findings[0].key
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        "%s  # live suppression, must survive\n"
+        "JX001:leak.py:ghost:print  # stale, must be pruned\n"
+        "JX004:somewhere/else.py:train:param=callbacks  # unscanned, kept\n"
+        % live_key
+    )
+    rc = cli_main([
+        str(p), "--prune-baseline", "--baseline", str(bl),
+        "--root", str(tmp_path),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pruned stale baseline entry: JX001:leak.py:ghost:print" in out
+    content = bl.read_text()
+    assert "ghost" not in content
+    assert live_key in content and "live suppression" in content
+    assert "somewhere/else.py" in content and "unscanned, kept" in content
+    # a normal gate re-run over the same narrow path set reports ONLY the
+    # intentionally-preserved unscanned-file entry as stale (pre-existing
+    # strictness for partial runs); ghost and the live key are settled
+    rc2 = cli_main([
+        str(p), "--baseline", str(bl), "--root", str(tmp_path),
+    ])
+    out2 = capsys.readouterr().out
+    assert rc2 == 1
+    assert "ghost" not in out2
+    assert "somewhere/else.py" in out2
+    assert "0 new finding(s)" in out2
+
+
+def test_prune_baseline_still_fails_on_new_findings(tmp_path, capsys):
+    """Pruning never launders NEW findings: stale entries are dropped but
+    an unsuppressed finding still exits 1."""
+    src = (
+        "import jax\nimport jax.numpy as jnp\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x.astype(jnp.float64)\n"
+    )
+    p = tmp_path / "leak.py"
+    p.write_text(src)
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("JX001:leak.py:ghost:print  # stale\n")
+    rc = cli_main([
+        str(p), "--prune-baseline", "--baseline", str(bl),
+        "--root", str(tmp_path),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "pruned stale baseline entry" in out
+    assert "ghost" not in bl.read_text()
+
+
+def test_prune_baseline_rejects_select(tmp_path, capsys):
+    """--prune-baseline with --select would see every unselected rule's
+    suppression as stale and mass-delete it — refused as a usage error."""
+    p = tmp_path / "x.py"
+    p.write_text("def f():\n    return 1\n")
+    rc = cli_main([
+        str(p), "--prune-baseline", "--select", "JX001",
+        "--baseline", str(tmp_path / "bl.txt"),
+    ])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "--prune-baseline with --select" in err
+
+
+def test_chip_peaks_ast_view_matches_live_table():
+    """The ONE shared CHIP_PEAKS extraction (engine.chip_peaks_from_ast)
+    must agree with the live obs/costs table — the static JX011 VMEM
+    budget and irscan's runtime costs.chip_peaks() read the same source of
+    truth and cannot drift."""
+    import ast as _ast
+
+    from lightgbm_tpu.obs import costs
+    from tools.graftlint.engine import (
+        FileContext, ProjectContext, chip_peaks_from_ast,
+    )
+
+    path = os.path.join(REPO, "lightgbm_tpu", "obs", "costs.py")
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    got = chip_peaks_from_ast(_ast.parse(src))
+    live_int = {
+        chip: {
+            k: v for k, v in fields.items()
+            if isinstance(v, int) and not isinstance(v, bool)
+        }
+        for chip, fields in costs.CHIP_PEAKS.items()
+    }
+    assert set(got) == set(live_int)
+    for chip in live_int:
+        assert got[chip] == live_int[chip], chip
+        assert "vmem_bytes" in got[chip], chip
+    # the JX011 budget resolves from the REAL table (the pre-refactor
+    # Assign-only walker missed the annotated assignment and silently fell
+    # back to the default forever)
+    ctx = FileContext(path, "lightgbm_tpu/obs/costs.py", src)
+    budget = ProjectContext([ctx]).vmem_budget
+    assert budget == min(
+        f["vmem_bytes"] for f in live_int.values() if "vmem_bytes" in f
+    )

@@ -1,0 +1,286 @@
+"""graftir (lightgbm_tpu/obs/irscan.py) — the jaxpr/StableHLO program
+auditor.
+
+Covers: every seeded IR001-IR006 violation caught (the poisoned-fixture
+contract), the real tree's registered entry points clean on the quick
+lattice, positive evidence the rules engage on real programs (the finish
+step's materialized FMA pin, the chunk closure's device-resident bins
+capture, honored donations), the fingerprint contract's drift/op-diff,
+env-skip and trace-budget semantics, and the baseline round-trip. The full
+bucket-lattice sweep with the data-parallel learner is slow-marked
+(tests/slow_tests.txt) with the quick scan as its named twin; check.sh
+--ir re-runs scan + self-check end to end.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.obs import irscan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    """Serial-learner bootstrap corpus (the data-learner corpus is built
+    only by the slow full-lattice case — a second training)."""
+    return irscan.build_corpus(include_data=False)
+
+
+# ---------------------------------------------------------------------------
+# seeded violations: every rule proves it still bites
+# ---------------------------------------------------------------------------
+def test_every_seeded_violation_is_caught():
+    """One poisoned program per rule (f64 leak, dropped donation, oversized
+    baked constant, undeclared psum axis, stripped FMA pin in BOTH pin
+    modes, a debug callback) — each must be caught by exactly the rule it
+    seeds. A rule that stops seeing its violation fails here, not silently
+    forever."""
+    missed = []
+    for rule, spec in irscan.seeded_specs():
+        audits = irscan.audit_entry(spec)
+        fired = {f.rule for a in audits for f in a.findings}
+        if rule not in fired:
+            missed.append("%s (spec %s, fired: %s)"
+                          % (rule, spec.name, sorted(fired)))
+    assert not missed, "seeded violations NOT caught: %s" % missed
+
+
+def test_selfcheck_covers_all_rules():
+    results = irscan.run_selfcheck()
+    assert set(results) == set(irscan.RULES)
+    assert all(results.values()), results
+
+
+# ---------------------------------------------------------------------------
+# the real tree (quick twin of the slow full-lattice sweep)
+# ---------------------------------------------------------------------------
+def test_real_tree_quick_scan_clean(corpus):
+    """Every serial-side entry point traced over the quick lattice is
+    clean under IR001-IR006, and nothing is skipped silently."""
+    result = irscan.run_scan(corpus=corpus)
+    assert not result.findings, [f.format() for f in result.findings]
+    names = set(result.trace_counts)
+    assert "gbdt.train_chunk[serial]" in names
+    assert "ops.grow_tree" in names
+    assert "gbdt.finish_step" in names
+    assert any(n.startswith("ops.leaf_histogram[") for n in names)
+    assert "serve.packed_predict_values" in names
+    # the data learner is absent ONLY because this corpus declined it —
+    # and the decline is reported loudly, never swallowed
+    assert any("train_chunk[data]" in s for s in result.skipped)
+    for a in result.audits:
+        assert a.digest and a.ops, (a.entry, a.shape)
+
+
+def test_quick_scan_matches_checked_in_contract(corpus):
+    """The checked-in fingerprint contract recognizes today's programs
+    (quick subset; the full sweep re-pins with --write-contract)."""
+    contract = irscan.load_contract(irscan.DEFAULT_CONTRACT)
+    assert contract is not None, "irscan_contract.json must be checked in"
+    result = irscan.run_scan(corpus=corpus)
+    problems, skip = irscan.check_contract(
+        contract, result.audits, result.trace_counts
+    )
+    if skip is not None:
+        pytest.skip("contract pinned for another environment: %s" % skip)
+    assert problems == []
+
+
+def test_finish_step_pin_and_donation_survive_lowering(corpus):
+    """Positive evidence on the REAL program (not just the absence of
+    findings): the finish step's score update is a scatter-add whose
+    addend is a materialized program output (the PR-8 exactness fence),
+    and its declared donation lowers to an input/output alias."""
+    spec = irscan._spec_finish_step(corpus)
+    assert spec.pin == "materialized"
+    (audit,) = irscan.audit_entry(spec)
+    assert audit.findings == [], [f.format() for f in audit.findings]
+    assert audit.donation_aliases >= 1
+    assert any("scatter" in op for op in audit.ops)
+
+
+def test_serial_chunk_closure_consts_are_device_resident(corpus):
+    """IR003's accounting engages on the real program: the serial chunk fn
+    closes over the binned matrix as a device-resident jax.Array (recorded,
+    intentional), NOT as a host numpy constant re-folded per trace."""
+    spec = irscan._spec_serial_chunk(corpus)
+    (audit,) = irscan.audit_entry(spec)
+    assert audit.device_const_bytes > 0
+    assert audit.np_const_bytes <= irscan.NP_CONST_LIMIT
+    assert audit.donation_aliases >= 2  # scores + bag mask
+
+
+# ---------------------------------------------------------------------------
+# fingerprint contract: drift, env skip, trace budget
+# ---------------------------------------------------------------------------
+def _toy_audit(body, label="t"):
+    import jax
+
+    spec = irscan.EntrySpec(
+        name="toy.entry", hot=False,
+        variants=[(label, jax.jit(body),
+                   (jax.ShapeDtypeStruct((8,), np.float32),), {})],
+    )
+    return irscan.audit_entry(spec)
+
+
+def test_contract_detects_perturbed_program(tmp_path):
+    """A deliberately perturbed program fails the contract loudly, with an
+    op-level diff naming what changed."""
+    path = str(tmp_path / "contract.json")
+    audits = _toy_audit(lambda x: x + 1.0)
+    contract = irscan.write_contract(path, audits, {"toy.entry": 1})
+    # same program -> clean
+    ok, skip = irscan.check_contract(contract, audits, {"toy.entry": 1})
+    assert skip is None and ok == []
+    # perturbed program (an extra multiply) -> drift with op diff
+    perturbed = _toy_audit(lambda x: (x + 1.0) * 2.0)
+    problems, skip = irscan.check_contract(
+        irscan.load_contract(path), perturbed, {"toy.entry": 1}
+    )
+    assert skip is None
+    assert len(problems) == 1
+    assert "program drift at toy.entry[t]" in problems[0]
+    assert "op diff" in problems[0]
+    assert "multiply" in problems[0]  # the op-level evidence
+
+
+def test_contract_env_mismatch_skips_loudly():
+    """Fingerprints are environment-pinned: a contract from another
+    backend/jax/device-count never rubber-stamps NOR false-fails — it
+    skips with the reason surfaced."""
+    audits = _toy_audit(lambda x: x * 2.0)
+    env = irscan.contract_env()
+    foreign = {
+        "env": dict(env, devices=env["devices"] + 1),
+        "entries": {},
+    }
+    problems, skip = irscan.check_contract(foreign, audits, {})
+    assert problems == []
+    assert skip is not None and "not comparable" in skip
+
+
+def test_contract_flags_unpinned_shape_and_trace_budget(tmp_path):
+    path = str(tmp_path / "contract.json")
+    audits = _toy_audit(lambda x: x - 1.0)
+    irscan.write_contract(path, audits, {"toy.entry": 1})
+    contract = irscan.load_contract(path)
+    # a shape class the contract never saw is drift, not a silent pass
+    novel = list(audits)
+    novel_audit = irscan.Audit(
+        entry="toy.entry", shape="rows=512", digest="beef", ops={"x": 1}
+    )
+    problems, _ = irscan.check_contract(
+        contract, novel + [novel_audit], {"toy.entry": 1}
+    )
+    assert any("unpinned shape class toy.entry[rows=512]" in p
+               for p in problems)
+    # exceeding the static trace budget is the compile-time retrace alarm
+    problems, _ = irscan.check_contract(contract, audits, {"toy.entry": 3})
+    assert any("trace-count budget exceeded" in p for p in problems)
+
+
+def test_checked_in_contract_is_valid_json_with_budgets():
+    doc = json.load(open(irscan.DEFAULT_CONTRACT))
+    assert set(doc) == {"env", "entries"}
+    assert doc["entries"], "contract must pin at least one entry"
+    for name, ent in doc["entries"].items():
+        assert ent["trace_budget"] >= 1, name
+        assert ent["shapes"], name
+        for shape, rec in ent["shapes"].items():
+            assert rec["digest"] and rec["ops"], (name, shape)
+
+
+# ---------------------------------------------------------------------------
+# baseline workflow (graftlint semantics, program-scoped keys)
+# ---------------------------------------------------------------------------
+def test_baseline_roundtrip_and_stale_detection(tmp_path):
+    path = str(tmp_path / "bl.txt")
+    f1 = irscan.Finding("IR002", "e", "s", "f64=sin", "msg")
+    f2 = irscan.Finding("IR004", "e", "s", "aliases=0<1", "msg")
+    irscan.write_baseline(path, [f1, f1, f2], {f1.key: "why"})
+    keys, notes = irscan.load_baseline(path)
+    assert keys[f1.key] == 2 and keys[f2.key] == 1
+    assert notes[f1.key] == "why"
+    # one f1 fixed -> its second suppression is stale; a new finding is new
+    f3 = irscan.Finding("IR001", "e", "s", "prim=pure_callback", "msg")
+    new, stale = irscan.compare_to_baseline([f1, f2, f3], keys)
+    assert [f.key for f in new] == [f3.key]
+    assert stale == {f1.key: 1}
+
+
+def test_ir_rules_documented_in_docs():
+    """Every IR rule id appears in docs/StaticAnalysis.md §Program-level
+    audit and in the Observability env table's companion doc (the graftlint
+    test_rules_documented_in_docs discipline, applied to graftir)."""
+    doc = open(os.path.join(REPO, "docs", "StaticAnalysis.md")).read()
+    for rule_id in irscan.RULES:
+        assert rule_id in doc, "%s missing from docs/StaticAnalysis.md" % rule_id
+    assert "Program-level audit" in doc
+    obs_doc = open(os.path.join(REPO, "docs", "Observability.md")).read()
+    assert irscan.ENV_ROWS in obs_doc
+
+
+def test_checked_in_baseline_has_no_unjustified_entries():
+    keys, notes = irscan.load_baseline(irscan.DEFAULT_BASELINE)
+    for key in keys:
+        assert "TODO" not in notes.get(key, ""), key
+
+
+# ---------------------------------------------------------------------------
+# satellite: the retrace gauge swallow is narrowed to the real error
+# ---------------------------------------------------------------------------
+def test_retrace_gauge_swallow_is_narrow(monkeypatch):
+    """obs/retrace.note_trace tolerates exactly the one failure its gauge
+    call can produce — a metric-kind collision (TypeError from
+    MetricsRegistry._get_or_create) — and no longer hides arbitrary
+    registry bugs behind a debug line (JX008's standard applied to obs)."""
+    from lightgbm_tpu.obs import retrace as retrace_mod
+
+    class KindCollision:
+        def gauge(self, name):
+            raise TypeError("metric %r already registered as counter" % name)
+
+    class RegistryBug:
+        def gauge(self, name):
+            raise ValueError("boom")
+
+    wd = retrace_mod.RetraceWatchdog()
+    monkeypatch.setattr(
+        retrace_mod.registry_mod, "REGISTRY", KindCollision()
+    )
+    wd.note_trace("irscan.test")  # swallowed: metrics never break a trace
+    assert wd.counts()["irscan.test"] == 1
+    monkeypatch.setattr(
+        retrace_mod.registry_mod, "REGISTRY", RegistryBug()
+    )
+    with pytest.raises(ValueError):
+        wd.note_trace("irscan.test")
+
+
+# ---------------------------------------------------------------------------
+# the full lattice + data learner (slow; quick twin above)
+# ---------------------------------------------------------------------------
+def test_full_lattice_scan_with_data_learner():
+    """The whole bucket lattice x routed impls x serve ladder, with the
+    sharded data-parallel chunk program (psum axis + payload + donation
+    audited), clean end to end — the exact sweep --write-contract pins.
+    Quick twin: test_real_tree_quick_scan_clean."""
+    full_corpus = irscan.build_corpus(include_data=True)
+    result = irscan.run_scan(corpus=full_corpus, full=True)
+    assert not result.findings, [f.format() for f in result.findings]
+    assert "gbdt.train_chunk[data]" in result.trace_counts
+    assert result.skipped == []
+    data_audits = [
+        a for a in result.audits if a.entry == "gbdt.train_chunk[data]"
+    ]
+    assert data_audits and data_audits[0].collectives  # psum really seen
+    contract = irscan.load_contract(irscan.DEFAULT_CONTRACT)
+    problems, skip = irscan.check_contract(
+        contract, result.audits, result.trace_counts
+    )
+    if skip is None:
+        assert problems == []

@@ -60,6 +60,12 @@ def main(argv: Optional[List[str]] = None) -> int:
              "(existing justifications are preserved)",
     )
     parser.add_argument(
+        "--prune-baseline", action="store_true",
+        help="rewrite the baseline dropping stale entries (fixed findings "
+             "whose suppression is no longer needed), printing each pruned "
+             "line; exit 1 only if NEW findings remain",
+    )
+    parser.add_argument(
         "--select", action="append", metavar="JX00N",
         help="run only these rule ids (repeatable)",
     )
@@ -90,6 +96,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(
                 "error: --write-baseline with --select would record a "
                 "partial rule set; run it over all rules",
+                file=sys.stderr,
+            )
+            return 2
+        if args.prune_baseline:
+            print(
+                "error: --prune-baseline with --select would see every "
+                "unselected rule's suppression as stale and delete it; "
+                "run it over all rules",
                 file=sys.stderr,
             )
             return 2
@@ -128,10 +142,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("graftlint: %d finding(s)" % len(findings))
         return 1 if findings else 0
 
-    baseline, _ = load_baseline(args.baseline)
+    baseline, notes = load_baseline(args.baseline)
     new, stale = compare_to_baseline(findings, baseline)
     for f in new:
         print(f.format())
+    if args.prune_baseline:
+        # stale entries are suppressions for findings that no longer exist
+        # ONLY within the scanned file set — entries keyed to files this
+        # run never parsed are kept, exactly like --write-baseline
+        scanned_set = set(scanned)
+        prunable = Counter(
+            {
+                k: n
+                for k, n in stale.items()
+                if (k.split(":", 2) + ["", ""])[1] in scanned_set
+            }
+        )
+        for key, n in sorted(prunable.items()):
+            for _ in range(n):
+                print("pruned stale baseline entry: %s" % key)
+        kept = Counter(baseline)
+        kept.subtract(prunable)
+        kept = Counter({k: n for k, n in kept.items() if n > 0})
+        write_baseline(args.baseline, [], notes, preserved=kept)
+        print(
+            "graftlint: pruned %d stale entr%s from %s (%d kept)"
+            % (
+                sum(prunable.values()),
+                "y" if sum(prunable.values()) == 1 else "ies",
+                args.baseline, sum(kept.values()),
+            )
+        )
+        if new:
+            print("graftlint: %d new finding(s)" % len(new))
+            return 1
+        return 0
     for key, n in sorted(stale.items()):
         print(
             "stale baseline entry (finding no longer present x%d): %s"

@@ -177,6 +177,59 @@ def _int_elems(node: ast.AST) -> List[int]:
     return []
 
 
+def chip_peaks_from_ast(
+    tree: ast.AST, env: Optional[Dict[str, int]] = None
+) -> Dict[str, Dict[str, int]]:
+    """Extract every ``CHIP_PEAKS`` table literal in ``tree`` as
+    {chip_name: {field: int}} — integer-valued fields only, evaluated with
+    :func:`const_int` against ``env``.
+
+    The ONE AST view of obs/costs.py's chip table, shared by JX011's VMEM
+    budget (:meth:`ProjectContext._collect_vmem_budget`) and pinned equal
+    to the live ``costs.CHIP_PEAKS`` by tests/test_graftlint.py, so the
+    static and runtime views of per-chip capability cannot drift."""
+    out: Dict[str, Dict[str, int]] = {}
+    for node in ast.walk(tree):
+        # the real table is annotated (`CHIP_PEAKS: Dict[...] = {...}`),
+        # an AnnAssign — the pre-refactor JX011 walker only matched plain
+        # Assign and silently fell back to DEFAULT_VMEM_BYTES forever
+        if isinstance(node, ast.Assign):
+            if not (
+                len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "CHIP_PEAKS"
+            ):
+                continue
+        elif isinstance(node, ast.AnnAssign):
+            if not (
+                isinstance(node.target, ast.Name)
+                and node.target.id == "CHIP_PEAKS"
+            ):
+                continue
+        else:
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for chip_key, chip_val in zip(node.value.keys, node.value.values):
+            if not (
+                isinstance(chip_key, ast.Constant)
+                and isinstance(chip_key.value, str)
+                and isinstance(chip_val, ast.Dict)
+            ):
+                continue
+            fields: Dict[str, int] = {}
+            for k, v in zip(chip_val.keys, chip_val.values):
+                if not (
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                ):
+                    continue
+                n = const_int(v, env)
+                if n is not None:
+                    fields[k.value] = n
+            out[chip_key.value] = fields
+    return out
+
+
 def const_int(node: ast.AST, env: Optional[Dict[str, int]] = None) -> Optional[int]:
     """Evaluate a compile-time integer expression: int literals, +/-/*///**
     arithmetic, unary +/-, and names bound to module-level int constants
@@ -422,26 +475,12 @@ class ProjectContext:
         to support. Falls back to :data:`DEFAULT_VMEM_BYTES`."""
         budgets: List[int] = []
         for ctx in self.files:
-            for node in ast.walk(ctx.tree):
-                if not (
-                    isinstance(node, ast.Assign)
-                    and len(node.targets) == 1
-                    and isinstance(node.targets[0], ast.Name)
-                    and node.targets[0].id == "CHIP_PEAKS"
-                    and isinstance(node.value, ast.Dict)
-                ):
-                    continue
-                for chip_val in node.value.values:
-                    if not isinstance(chip_val, ast.Dict):
-                        continue
-                    for k, v in zip(chip_val.keys, chip_val.values):
-                        if (
-                            isinstance(k, ast.Constant)
-                            and k.value == "vmem_bytes"
-                        ):
-                            n = const_int(v, ctx.module_int_consts)
-                            if n is not None and n > 0:
-                                budgets.append(n)
+            for fields in chip_peaks_from_ast(
+                ctx.tree, ctx.module_int_consts
+            ).values():
+                n = fields.get("vmem_bytes")
+                if n is not None and n > 0:
+                    budgets.append(n)
         return min(budgets) if budgets else DEFAULT_VMEM_BYTES
 
     def _collect_axes(self) -> FrozenSet[str]:
