@@ -829,9 +829,11 @@ def run_irscan(stage: str = "irscan") -> dict:
 def run_tune(stage: str = "tune") -> dict:
     """Histogram autotune sweep (obs/tune.py, ISSUE 13) — a child process
     (`python -m lightgbm_tpu.obs.tune`, driver stays jax-free) races every
-    supported histogram impl (xla / xla_radix / scatter / pallas /
-    pallas_packed4, gated by impl_supported + the chip's CHIP_PEAKS
-    vmem_bytes) at the bucket-shape distribution the grower emits for the
+    supported histogram impl (the full IMPLS vocabulary — xla family,
+    scatter, and the Pallas kernels incl. the ISSUE 17 wide-bin
+    pallas_onehot / pallas_bitplane — gated by impl_supported + the chip's
+    CHIP_PEAKS vmem_bytes; new impls enter with zero wiring here) at the
+    bucket-shape distribution the grower emits for the
     1M bench geometry, and atomically persists TUNE_HIST.json. Running it
     BEFORE bench_early means the very next bench worker — and every
     training that adopts LIGHTGBM_TPU_HIST_TUNE — routes each shape class

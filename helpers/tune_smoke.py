@@ -51,6 +51,12 @@ PARAMS = {
 # strict-win threshold: the observed inversions are 1.3x-9x, so 1.1x keeps
 # the gate meaningful while riding above scheduler noise
 STRICT_WIN = 1.1
+# both bin widths the wide-bin kernel family (ISSUE 17) targets enter the
+# sweep so the new contenders are raced at 63 AND 255 on CPU
+SWEEP_BINS = [MAX_BIN, 255]
+# the routed vocabulary as of PR 12 — the baseline for the "new contenders
+# made nothing slower" gate below
+PR12_IMPLS = ("xla", "xla_radix", "scatter", "pallas", "pallas_packed4")
 
 
 def _data():
@@ -100,6 +106,65 @@ def _perf_gate(table):
     return ok, worst, best, details
 
 
+def _pr12_gate(table):
+    """(ok, details): the tuned route with the enlarged impl vocabulary
+    (ISSUE 17: xla_onehot / pallas_onehot / pallas_bitplane) is no slower
+    than the PR-12 winner at EVERY swept shape — enlarging the candidate
+    set must never degrade a shape the old vocabulary already served — and
+    the new CPU-measurable contender was actually raced everywhere."""
+    ok = True
+    details = []
+    for e in table["entries"]:
+        times = e.get("times_ms") or {}
+        if "xla_onehot" not in times:
+            ok = False
+            details.append(
+                "B=%d rows=%d: xla_onehot missing from the race"
+                % (e["B"], e["rows_bucket"])
+            )
+            continue
+        old = {i: times[i] for i in PR12_IMPLS if i in times}
+        if not old:
+            ok = False
+            details.append(
+                "B=%d rows=%d: no PR-12 impl measured" % (e["B"],
+                                                          e["rows_bucket"])
+            )
+            continue
+        old_best = min(old, key=old.get)
+        ratio = old[old_best] / times[e["impl"]]
+        if ratio < 1.0:
+            ok = False
+        details.append(
+            "B=%d rows=%d: routed %s %.3fms vs PR-12 winner %s %.3fms "
+            "(%.2fx)" % (e["B"], e["rows_bucket"], e["impl"],
+                         times[e["impl"]], old_best, old[old_best], ratio)
+        )
+    return ok, details
+
+
+def _eligibility_gate():
+    """The capability/candidate layers record the new impls as eligible at
+    the wide-bin widths: xla_onehot races on CPU, the Pallas twins are
+    supported at B=63/255 on TPU (adoption happens unattended in the next
+    bringup window's tune stage)."""
+    for b in (63, 255):
+        cands = tune.candidate_impls(b, "cpu")
+        assert "xla_onehot" in cands, (
+            "xla_onehot not a CPU sweep candidate at B=%d: %s" % (b, cands)
+        )
+        for impl in ("pallas_onehot", "pallas_bitplane"):
+            assert hist_mod.impl_supported(impl, b, "tpu"), (
+                "%s must be eligible at B=%d on TPU" % (impl, b)
+            )
+            assert impl in tune.candidate_impls(b, "tpu"), (
+                "%s missing from the TPU candidate race at B=%d" % (impl, b)
+            )
+    assert not hist_mod.impl_supported("pallas_onehot", 257, "tpu"), (
+        "pallas_onehot capability must cap at the 256-bin family"
+    )
+
+
 def main() -> int:
     X, y = _data()
     with tempfile.TemporaryDirectory(prefix="tune_smoke_") as td:
@@ -107,7 +172,7 @@ def main() -> int:
         pinned_path = os.path.join(td, "TUNE_PINNED.json")
 
         # ---- 1. sweep + persist + reload -------------------------------
-        shapes = tune.sweep_shapes(N_ROWS, [MAX_BIN], N_FEAT)
+        shapes = tune.sweep_shapes(N_ROWS, SWEEP_BINS, N_FEAT)
         # two attempts absorb a noisy first measurement pass on a loaded box
         for attempt in range(2):
             table = tune.sweep(shapes, repeats=3)
@@ -128,6 +193,19 @@ def main() -> int:
         )
         print("tune-smoke: PERF GATE ok (worst %.2fx, best %.2fx vs "
               "default %r)" % (worst, best_ratio, hist_mod.default_impl()))
+
+        # ---- 1b. enlarged vocabulary gates (ISSUE 17) ------------------
+        pr12_ok, pr12_details = _pr12_gate(table)
+        for line in pr12_details:
+            print("tune-smoke:   " + line)
+        assert pr12_ok, (
+            "PR-12 gate failed: the route with the enlarged vocabulary "
+            "must be no slower than the PR-12 winner at every swept shape"
+        )
+        _eligibility_gate()
+        print("tune-smoke: NEW-CONTENDER GATE ok (xla_onehot raced "
+              "everywhere; pallas_onehot/pallas_bitplane eligible at "
+              "B=63/255 on TPU)")
 
         # ---- 2. routing machinery is bit-transparent -------------------
         default = hist_mod.default_impl()
@@ -177,7 +255,8 @@ def main() -> int:
             "perf_worst_ratio": round(worst, 3),
             "perf_best_ratio": round(best_ratio, 3),
             "route_engaged": bool(routed),
-            "winners": {str(e["rows_bucket"]): e["impl"]
+            "pr12_gate": bool(pr12_ok),
+            "winners": {"%d:%d" % (e["B"], e["rows_bucket"]): e["impl"]
                         for e in table["entries"]},
         }), flush=True)
         print("TUNE-SMOKE PASS")

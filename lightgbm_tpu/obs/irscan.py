@@ -890,6 +890,13 @@ def _spec_leaf_histograms(c: Corpus, full: bool) -> List[EntrySpec]:
         impls |= g._hist_route.effective_impls(
             default, B, 3, cfg.tpu_hist_dtype, buckets
         )
+    # every routing contender this backend can serve at the corpus width is
+    # pinned (ISSUE 17): a tune table written later can route to any of
+    # them without first widening the contract, and an IR drift in a
+    # not-currently-routed kernel still trips the scan
+    impls |= {
+        i for i in hist_mod.IMPLS if hist_mod.impl_supported(i, B)
+    }
     specs = []
     for impl in sorted(impls):
         if not hist_mod.impl_supported(impl, B):

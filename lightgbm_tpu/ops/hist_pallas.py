@@ -34,6 +34,19 @@ Grid: (F, N/C). The output block index map pins each feature's accumulator to
 the same VMEM block across all row chunks, so partial histograms never round-
 trip through HBM (pallas revisiting semantics). Inputs stream: bins [1, C]
 int8 and the shared values [K, C] f32 per step.
+
+ISSUE 17 adds two wide-bin siblings, both feature-batched like the v2 radix
+kernel and registered as first-class routing contenders:
+
+- ``histogram_pallas_onehot``: the dense formulation, B-tiled — grid
+  (F/FB, B/BT, N/C) with BT=128, one [C, 128] one-hot slab per bin tile, so
+  the MXU runs full-lane-width passes at any B up to 256.
+- ``histogram_pallas_bitplane``: bin = hi*lob + lo with power-of-two factor
+  widths from ``bitplane_split`` (16x16 at B=255); each one-hot factor is
+  the AND-product of log2(width) bit-plane equality masks, keeping VMEM
+  intermediates narrow where the dense 256-wide one-hot tile is marginal.
+
+``KERNEL_CAPS`` is the single capability table gating all four kernels.
 """
 from __future__ import annotations
 
@@ -54,6 +67,32 @@ LO = 8  # low-radix width: RHS one-hot lanes
 # and _max_chunk caps C so the estimate stays under this budget with a
 # ~6MB margin for Mosaic's own stack.
 _VMEM_BUDGET = 10 * 1024 * 1024
+
+# The measured Mosaic overhead margin behind _VMEM_BUDGET: 16MiB chip VMEM
+# minus the 10MiB scoped budget above. The wide-bin kernels (onehot /
+# bitplane, ISSUE 17) derive their budget from THIS chip's vmem_bytes in
+# obs/costs.CHIP_PEAKS instead of hardcoding the 16MiB floor, so a v6e
+# (32MiB) gets double the chunk depth while v4/v5 reproduce _VMEM_BUDGET.
+_VMEM_MARGIN = 6 * 1024 * 1024
+
+
+def _vmem_budget() -> int:
+    """Per-grid-step scoped-VMEM budget from this chip's ``vmem_bytes``
+    (obs/costs.CHIP_PEAKS — the same table graftlint JX011 bounds static
+    blocks against and obs/tune gates Pallas contenders on), less the
+    measured Mosaic margin. Never below the proven 16MiB-chip budget."""
+    try:
+        import jax as _jax
+
+        kind = _jax.devices()[0].device_kind
+        platform = "tpu" if _jax.default_backend() == "tpu" else None
+    except Exception:
+        kind, platform = None, None
+    from ..obs import costs as costs_mod
+
+    peaks = costs_mod.chip_peaks(kind, platform=platform)
+    vmem = int(peaks.get("vmem_bytes", 16 * 2 ** 20))
+    return max(vmem - _VMEM_MARGIN, _VMEM_BUDGET)
 
 
 def _max_chunk(hi_n: int, k_n: int, dtype) -> int:
@@ -421,20 +460,285 @@ def histogram_pallas_packed4(
     return out[:F].transpose(0, 2, 1)  # [F, B, K]
 
 
-def supported(
-    num_bins: int, backend: Optional[str] = None, ignore_backend: bool = False
-) -> bool:
-    """True when the pallas kernel can serve this shape on this backend.
+BT = 128  # bin-tile width for the dense one-hot kernel: one MXU lane tile
 
-    Pure shape+backend predicate — the ``LIGHTGBM_TPU_HIST_IMPL`` escape
-    hatch acts only in the routing layer (``histogram._ENV_IMPL``, frozen at
-    import), never here, so differential tests that force ``impl="pallas"``
-    really exercise the kernel. ``ignore_backend`` checks only the shape
-    constraints — the gate for a forced pallas, which may legitimately
-    target interpret mode off-TPU.
-    """
-    # must match _hi_for's constraint: ceil(B/LO) * 3 rows <= 128
-    if -(-num_bins // LO) * 3 > 128:
+
+def _max_chunk_onehot(k_n: int, dtype) -> int:
+    """Chunk cap for the dense one-hot kernel: [FB, C] bins + [K, C] values
+    blocks per step, one [C, BT] one-hot tile reused across the feature
+    unroll; budgeted against this chip's CHIP_PEAKS vmem_bytes."""
+    d = jnp.dtype(dtype).itemsize
+    per_col = (
+        2 * FB  # double-buffered [FB, C] u8 bins block
+        + 2 * 4 * k_n  # double-buffered [K, C] f32 values block
+        + 4 * FB  # b_all int32 [FB, C]
+        + 4 * BT  # global-bin iota [C, BT] i32
+        + d * (BT + k_n)  # one-hot tile, vt cast
+    )
+    if d == 4:
+        per_col += 2 * 2 * (BT + k_n)  # HIGHEST bf16 operand shadows
+    c = _vmem_budget() // per_col
+    return max(512, (c // 512) * 512)
+
+
+def _kernel_onehot(bins_ref, vt_ref, out_ref, *, bt: int, dtype):
+    """Dense one-hot tile kernel body (ISSUE 17): grid (F/FB, B/BT, N/C).
+    Each step builds the [C, BT] one-hot slab for ONE bin tile in VMEM and
+    contracts it against the shared [K, C] stat block — the direct MXU
+    transcription of hist[f] = onehot(bins_f) @ values, B-tiled so the
+    one-hot never exceeds one 128-lane tile regardless of B. The output
+    block revisits across the row-chunk axis (innermost grid dim) so each
+    (feature-batch, bin-tile) accumulator stays VMEM-resident."""
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    vt = vt_ref[:].astype(dtype)  # [K, C]
+    k_n, C = vt.shape
+    b_all = bins_ref[:, :].astype(jnp.int32)  # [FB, C]
+    # global bin ids covered by this tile: tile_start + [0, bt)
+    iota = (
+        jax.lax.broadcasted_iota(jnp.int32, (C, bt), 1)
+        + pl.program_id(1) * bt
+    )
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+    for j in range(FB):  # static unroll: register slices, no dynamic u8 rows
+        oh = (b_all[j][:, None] == iota).astype(dtype)  # [C, BT]
+        out_ref[j] += jax.lax.dot_general(
+            vt, oh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "chunk", "dtype_name", "interpret")
+)
+def histogram_pallas_onehot(
+    bins: jax.Array,  # [F, N] uint8/int32
+    values: jax.Array,  # [N, K] f32 (mask pre-applied; out-of-leaf rows are 0)
+    num_bins: int,
+    chunk: int = 8192,
+    dtype_name: str = "float32",
+    interpret: bool = False,
+) -> jax.Array:
+    """[F, B, K] f32 histogram via the dense one-hot-tile MXU kernel."""
+    F, N = bins.shape
+    K = values.shape[1]
+    B = num_bins
+    Bp = -(-B // BT) * BT
+    dtype = jnp.dtype(dtype_name)
+
+    C = min(max(chunk, 512), max(512, N), _max_chunk_onehot(K, dtype))
+    C = max(512, (C // 512) * 512)
+    if N % C != 0:
+        pad = (-N) % C
+        # zero values contribute nothing; padded rows land in bin 0 with v=0
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        values = jnp.pad(values, ((0, pad), (0, 0)))
+        N += pad
+    n_chunks = N // C
+    Fp = -(-F // FB) * FB
+    if Fp != F:
+        bins = jnp.pad(bins, ((0, Fp - F), (0, 0)))
+
+    vt = values.T  # [K, N]
+    kernel = functools.partial(_kernel_onehot, bt=BT, dtype=dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Fp // FB, Bp // BT, n_chunks),
+        in_specs=[
+            pl.BlockSpec(
+                (FB, C), lambda f8, b, c: (f8, c), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (K, C), lambda f8, b, c: (0, c), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (FB, K, BT), lambda f8, b, c: (f8, 0, b), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((Fp, K, Bp), jnp.float32),
+        interpret=interpret,
+    )(bins, vt)
+    return out[:F].transpose(0, 2, 1)[:, :B, :]  # [F, B, K]
+
+
+def bitplane_split(num_bins: int):
+    """(lob, hib): power-of-two factor widths for the bit-plane kernel.
+
+    ``bin = hi * lob + lo`` where lo is the low ``log2(lob)`` bits of the
+    index and hi the remaining high bits — an even split of
+    ``ceil(log2(B))`` planes, so B=255 factors 16x16 and B=63 factors 8x8.
+    ``lob * hib >= num_bins`` always holds (out-of-range slots stay zero and
+    are sliced off)."""
+    p = max((num_bins - 1).bit_length(), 2)
+    lob = 1 << (p // 2)
+    hib = 1 << (p - p // 2)
+    return lob, hib
+
+
+def _max_chunk_bitplane(lob: int, hib: int, k_n: int, dtype) -> int:
+    """Chunk cap for the bit-plane kernel: like :func:`_max_chunk_fb` but
+    with the split factor widths, budgeted against CHIP_PEAKS vmem_bytes."""
+    d = jnp.dtype(dtype).itemsize
+    per_col = (
+        2 * FB  # double-buffered [FB, C] u8 bins block
+        + 2 * 4 * k_n  # double-buffered [K, C] f32 values block
+        + 4 * FB  # b_all int32 [FB, C]
+        + 4 * lob + 4 * hib  # hoisted factor iotas (i32)
+        + d * (lob + lob * k_n + hib + k_n)  # oh_lo, lhs, oh_hi, vt cast
+    )
+    if d == 4:
+        per_col += 2 * 2 * (lob * k_n + hib)  # HIGHEST bf16 operand shadows
+    c = _vmem_budget() // per_col
+    return max(512, (c // 512) * 512)
+
+
+def _kernel_bitplane(bins_ref, vt_ref, out_ref, *, lob: int, hib: int, dtype):
+    """Bit-plane kernel body (ISSUE 17): the u8 bin index is decomposed into
+    bit planes and each one-hot factor is built as the 0/1 AND-product of
+    one equality mask per plane — ``log2(B)`` vector compares total, never a
+    full-B-wide compare, so the widest VMEM intermediate is the [lob*K, C]
+    LHS (48 rows at B=255/K=3) instead of a dense 256-wide one-hot slab.
+    The matmul shape matches the radix kernel: lhs = onehot_lo (x) values,
+    rhs = onehot_hi, OUT [lob*K, hib] accumulated f32 per feature."""
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    vt = vt_ref[:].astype(dtype)  # [K, C]
+    k_n, C = vt.shape
+    b_all = bins_ref[:, :].astype(jnp.int32)  # [FB, C]
+    lo_bits = lob.bit_length() - 1
+    hi_bits = hib.bit_length() - 1
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (lob, C), 0)
+    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (C, hib), 1)
+    prec = (
+        jax.lax.Precision.HIGHEST
+        if dtype == jnp.float32
+        else jax.lax.Precision.DEFAULT
+    )
+    for j in range(FB):  # static unroll: register slices, no dynamic u8 rows
+        b = b_all[j]
+        oh_lo = ((lo_iota & 1) == (b & 1)[None, :]).astype(dtype)
+        for p in range(1, lo_bits):
+            oh_lo = oh_lo * (
+                ((lo_iota >> p) & 1) == ((b >> p) & 1)[None, :]
+            ).astype(dtype)
+        oh_hi = ((hi_iota & 1) == ((b >> lo_bits) & 1)[:, None]).astype(dtype)
+        for p in range(1, hi_bits):
+            oh_hi = oh_hi * (
+                ((hi_iota >> p) & 1) == ((b >> (lo_bits + p)) & 1)[:, None]
+            ).astype(dtype)
+        lhs = (oh_lo[:, None, :] * vt[None, :, :]).reshape(lob * k_n, C)
+        out_ref[j] += jax.lax.dot_general(
+            lhs, oh_hi,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=prec,
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_bins", "chunk", "dtype_name", "interpret")
+)
+def histogram_pallas_bitplane(
+    bins: jax.Array,  # [F, N] uint8/int32
+    values: jax.Array,  # [N, K] f32 (mask pre-applied; out-of-leaf rows are 0)
+    num_bins: int,
+    chunk: int = 8192,
+    dtype_name: str = "float32",
+    interpret: bool = False,
+) -> jax.Array:
+    """[F, B, K] f32 histogram via the bit-plane-factored MXU kernel."""
+    F, N = bins.shape
+    K = values.shape[1]
+    B = num_bins
+    lob, hib = bitplane_split(B)
+    dtype = jnp.dtype(dtype_name)
+
+    C = min(max(chunk, 512), max(512, N), _max_chunk_bitplane(lob, hib, K, dtype))
+    C = max(512, (C // 512) * 512)
+    if N % C != 0:
+        pad = (-N) % C
+        bins = jnp.pad(bins, ((0, 0), (0, pad)))
+        values = jnp.pad(values, ((0, pad), (0, 0)))
+        N += pad
+    n_chunks = N // C
+    Fp = -(-F // FB) * FB
+    if Fp != F:
+        bins = jnp.pad(bins, ((0, Fp - F), (0, 0)))
+
+    vt = values.T  # [K, N]
+    kernel = functools.partial(_kernel_bitplane, lob=lob, hib=hib, dtype=dtype)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Fp // FB, n_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f8, c: (f8, c), memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, C), lambda f8, c: (0, c), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (FB, lob * K, hib), lambda f8, c: (f8, 0, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((Fp, lob * K, hib), jnp.float32),
+        interpret=interpret,
+    )(bins, vt)
+
+    # out[f, lo*K + k, hi] -> hist[f, hi*lob + lo, k]
+    hist = (
+        out.reshape(Fp, lob, K, hib)
+        .transpose(0, 3, 1, 2)
+        .reshape(Fp, hib * lob, K)
+    )
+    return hist[:F, :B, :]
+
+
+# ---------------------------------------------------------------------------
+# Capability table (ISSUE 17 satellite): the ONE place that says which bin
+# widths each Pallas kernel serves. histogram.impl_supported() consults this
+# instead of special-casing impl names, the leaf_histogram unsupported-B
+# fallback (warn_once + hist_impl_fallback_total counter) covers every impl
+# listed here, and obs/tune's candidate filter inherits both for free.
+KERNEL_CAPS = {
+    # radix kernel: ceil(B/LO) * 3 LHS rows must fit the 128-row MXU pass
+    "pallas": lambda b: -(-b // LO) * 3 <= 128,
+    # nibble-packed: two 4-bit bins per byte (dense_nbits_bin.hpp question)
+    "pallas_packed4": lambda b: b <= 16,
+    # dense one-hot tile: B-tiled at BT=128; capped at the 256-bin family
+    "pallas_onehot": lambda b: 2 <= b <= 256,
+    # bit-plane factorization: power-of-two factor widths up to 16x16
+    "pallas_bitplane": lambda b: 2 <= b <= 256,
+}
+
+
+def kernel_supported(
+    impl: str,
+    num_bins: int,
+    backend: Optional[str] = None,
+    ignore_backend: bool = False,
+) -> bool:
+    """True when Pallas kernel ``impl`` can serve this shape on this backend.
+
+    Pure shape+backend predicate over :data:`KERNEL_CAPS` — the
+    ``LIGHTGBM_TPU_HIST_IMPL`` escape hatch acts only in the routing layer
+    (``histogram._ENV_IMPL``, frozen at import), never here, so differential
+    tests that force a Pallas impl really exercise the kernel.
+    ``ignore_backend`` checks only the shape constraints — the gate for a
+    forced Pallas impl, which may legitimately target interpret mode
+    off-TPU. Unknown impls are unsupported."""
+    cap = KERNEL_CAPS.get(impl)
+    if cap is None or not cap(num_bins):
         return False
     if ignore_backend:
         return True
@@ -444,21 +748,18 @@ def supported(
         except Exception:
             return False
     return backend == "tpu"
+
+
+def supported(
+    num_bins: int, backend: Optional[str] = None, ignore_backend: bool = False
+) -> bool:
+    """:func:`kernel_supported` delegate for the radix kernel (kept for the
+    original call sites and tests)."""
+    return kernel_supported("pallas", num_bins, backend, ignore_backend)
 
 
 def supported_packed4(
     num_bins: int, backend: Optional[str] = None, ignore_backend: bool = False
 ) -> bool:
-    """:func:`supported` twin for the nibble-packed kernel: B <= 16 (two
-    4-bit bins per byte — dense_nbits_bin.hpp's packing question), TPU
-    backend unless ``ignore_backend`` (forced interpret-mode runs)."""
-    if num_bins > 16:
-        return False
-    if ignore_backend:
-        return True
-    if backend is None:
-        try:
-            backend = jax.default_backend()
-        except Exception:
-            return False
-    return backend == "tpu"
+    """:func:`kernel_supported` delegate for the nibble-packed kernel."""
+    return kernel_supported("pallas_packed4", num_bins, backend, ignore_backend)

@@ -170,7 +170,21 @@ def _default_backend() -> str:
 # The full impl vocabulary leaf_histogram can route among. "pallas_packed4"
 # is the nibble-packed (two 4-bit bins per byte) MXU kernel — promoted from
 # measurement-only into the routed set for <=16-bin shapes (ISSUE 13).
-IMPLS = ("xla", "xla_radix", "scatter", "pallas", "pallas_packed4")
+# ISSUE 17 adds the wide-bin MXU family: "xla_onehot" (the one-hot-as-LHS
+# pure-XLA contraction, CPU-measurable and the differential oracle for the
+# Pallas twins), "pallas_onehot" (dense one-hot tile, B-tiled at 128), and
+# "pallas_bitplane" (bit-plane-factored one-hots, the low-VMEM contender at
+# B=255).
+IMPLS = (
+    "xla", "xla_onehot", "xla_radix", "scatter",
+    "pallas", "pallas_onehot", "pallas_bitplane", "pallas_packed4",
+)
+
+# The impls that lower everywhere at any B: plain XLA programs with no
+# kernel shape constraints. Everything else is a Pallas kernel whose bounds
+# live in hist_pallas.KERNEL_CAPS — impl_supported() below is the union of
+# the two tables and never special-cases an individual kernel name.
+_XLA_IMPLS = frozenset(("xla", "xla_onehot", "xla_radix", "scatter"))
 
 # Resolved ONCE at import so routing is deterministic per process: leaf_histogram
 # is jitted with impl as a static arg, and an env var read at trace time would
@@ -201,13 +215,15 @@ def impl_supported(
 
     The ONE supported() vocabulary the router, the tune sweep (obs/tune.py)
     and the table-load filter (:func:`resolve_route`) share, so a table can
-    never route a shape to a kernel that cannot lower there."""
-    if impl in ("xla", "xla_radix", "scatter"):
+    never route a shape to a kernel that cannot lower there. Pure-XLA impls
+    lower everywhere; Pallas impls consult the hist_pallas.KERNEL_CAPS
+    capability table — no per-kernel special cases here."""
+    if impl in _XLA_IMPLS:
         return True
-    if impl == "pallas":
-        return hist_pallas.supported(num_bins, backend, ignore_backend)
-    if impl == "pallas_packed4":
-        return hist_pallas.supported_packed4(num_bins, backend, ignore_backend)
+    if impl in hist_pallas.KERNEL_CAPS:
+        return hist_pallas.kernel_supported(
+            impl, num_bins, backend, ignore_backend
+        )
     return False
 
 
@@ -513,10 +529,13 @@ def leaf_histogram(
         ReduceScatter path of data_parallel_tree_learner.cpp:161 collapsed into
         one XLA collective).
       impl: "auto" (env override -> frozen ``route`` -> the backend default,
-        see the module banner), "pallas", "pallas_packed4" (nibble-packed
-        MXU kernel, B <= 16), "scatter", "xla" (the one-hot contraction —
-        also the differential oracle for the others), or "xla_radix" (the
-        radix factorization in plain XLA).
+        see the module banner), "pallas", "pallas_onehot" (dense one-hot
+        tile, B <= 256), "pallas_bitplane" (bit-plane-factored one-hots,
+        B <= 256), "pallas_packed4" (nibble-packed MXU kernel, B <= 16),
+        "scatter", "xla" (the one-hot contraction — also the differential
+        oracle for the others), "xla_onehot" (the one-hot-as-LHS
+        contraction, the pure-XLA twin of pallas_onehot), or "xla_radix"
+        (the radix factorization in plain XLA).
       hist_dtype: MXU operand dtype for the pallas kernels and the XLA
         one-hot/radix contractions — "float32" (exact) or "bfloat16"
         (rounds grad/hess operands; the one-hot side and the count channel
@@ -540,16 +559,30 @@ def leaf_histogram(
         )
         if picked is not None:
             impl = picked
-    if impl in ("pallas", "pallas_packed4") and not impl_supported(
+    if impl in hist_pallas.KERNEL_CAPS and not impl_supported(
         impl, num_bins, ignore_backend=True
     ):
         # A forced pallas impl must still satisfy the kernel's shape
         # constraints (num_bins bound from the VMEM block rules / nibble
-        # width) or it would mis-lower instead of falling back.
+        # width / bin-tile caps) or it would mis-lower instead of falling
+        # back. One generic gate over the capability table — every Pallas
+        # impl gets the warn_once + fallback-counter path.
         _note_impl_fallback(impl, num_bins)
         impl = "xla"
     if impl == "pallas":
         hist = hist_pallas.histogram_pallas(
+            bins, values, num_bins, chunk=max(chunk, 512),
+            dtype_name=hist_dtype, interpret=interpret,
+        )
+        return _combine(hist, axis_name)
+    if impl == "pallas_onehot":
+        hist = hist_pallas.histogram_pallas_onehot(
+            bins, values, num_bins, chunk=max(chunk, 512),
+            dtype_name=hist_dtype, interpret=interpret,
+        )
+        return _combine(hist, axis_name)
+    if impl == "pallas_bitplane":
+        hist = hist_pallas.histogram_pallas_bitplane(
             bins, values, num_bins, chunk=max(chunk, 512),
             dtype_name=hist_dtype, interpret=interpret,
         )
@@ -672,6 +705,46 @@ def leaf_histogram(
             .transpose(0, 3, 1, 2)
             .reshape(F, HI * LO, K)[:, :B, :]
         )
+        return _combine(hist, axis_name)
+
+    if impl == "xla_onehot":
+        # The one-hot-as-LHS formulation (ISSUE 17): hist[f] =
+        # onehot(bins_f) @ values — [B, C] one-hot tiles contracted against
+        # the shared [C, K] stat matrix, scanned feature-by-feature (and
+        # chunk-by-chunk within a feature). The transposed twin of the
+        # batched [F, C, B] contraction below: one 2-D MXU matmul per
+        # (feature, chunk) with the one-hot as the streamed operand, the
+        # same dataflow the pallas_onehot kernel tiles in VMEM — this branch
+        # is its CPU-measurable differential oracle.
+        C = _pick_chunk(1, B, chunk, N)
+        if N % C != 0:
+            pad = (-N) % C
+            bins = jnp.pad(bins, ((0, 0), (0, pad)))
+            values = jnp.pad(values, ((0, pad), (0, 0)))
+            N += pad
+        n_chunks = N // C
+        bins_c = bins.reshape(F, n_chunks, C)  # [F, n, C]
+        vals_c = values.reshape(n_chunks, C, K)  # [n, C, K]
+        iota = jnp.arange(B, dtype=jnp.int32)
+
+        def body_oh(carry, b_f):  # b_f: [n, C]
+            def chunk_oh(acc, inputs):
+                b, v = inputs  # [C], [C, K]
+                oh = (iota[:, None] == b.astype(jnp.int32)[None, :]).astype(
+                    op_dtype
+                )  # [B, C]
+                return acc + jax.lax.dot_general(
+                    oh, v.astype(op_dtype),
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ), None
+
+            h, _ = jax.lax.scan(
+                chunk_oh, jnp.zeros((B, K), jnp.float32), (b_f, vals_c)
+            )
+            return carry, h
+
+        _, hist = jax.lax.scan(body_oh, 0, bins_c)  # [F, B, K]
         return _combine(hist, axis_name)
 
     C = _pick_chunk(F, B, chunk, N)

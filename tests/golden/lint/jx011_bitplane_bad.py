@@ -1,0 +1,48 @@
+"""JX011 bad fixture: the bit-plane histogram call shape (ISSUE 17) with
+seeded contract violations — proof the lint gate sees the
+``histogram_pallas_bitplane`` idiom (mask-product one-hot factors, radix-
+style [lob*K, hib] accumulator), with a violation mix distinct from the
+onehot/packed4 fixtures: SECOND in_spec arity, out index_map rank, and a
+ShapeDtypeStruct missing its dtype."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+FB = 8
+LOB = 16
+HIB = 16
+
+
+def _kernel_bitplane(bins_ref, vt_ref, out_ref, *, lob, hib, dtype):
+    c = pl.program_id(2)  # grid below is rank 2: axis 2 out of range
+    b = bins_ref[:, :].astype(jnp.int32)
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (lob, b.shape[1]), 0)
+    oh_lo = ((lo_iota & 1) == (b[0] & 1)[None, :]).astype(dtype)
+    out_ref[0] += (oh_lo[:, None, :] * vt_ref[:][None, :, :]).sum(2)
+
+
+def bad_bitplane_call(bins, vt, fp8, n_chunks, C, K):
+    kernel = functools.partial(
+        _kernel_bitplane, lob=LOB, hib=HIB, dtype=jnp.float32
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(fp8, n_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f8, c: (f8, c),
+                         memory_space=pltpu.VMEM),
+            # index_map takes ONE coordinate against the rank-2 grid
+            pl.BlockSpec((K, C), lambda f8: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        # index_map returns TWO coordinates for the rank-3 block
+        out_specs=pl.BlockSpec(
+            (FB, LOB * 3, HIB), lambda f8, c: (f8, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        # ShapeDtypeStruct without a dtype
+        out_shape=jax.ShapeDtypeStruct((32, LOB * 3, HIB)),
+    )(bins, vt)

@@ -1,0 +1,69 @@
+"""JX011 good fixture: a faithful mirror of the bit-plane call
+(ops/hist_pallas.histogram_pallas_bitplane, ISSUE 17) — one-hot factors
+built as AND-products of bit-plane equality masks, radix-style [lob*K, hib]
+accumulator pinned across the chunk grid. Every contract satisfied; the
+lint gate must stay silent."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+FB = 8
+LOB = 16
+HIB = 16
+
+
+def _kernel_bitplane(bins_ref, vt_ref, out_ref, *, lob, hib, dtype):
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    vt = vt_ref[:].astype(dtype)  # [K, C]
+    k_n, C = vt.shape
+    b_all = bins_ref[:, :].astype(jnp.int32)  # [FB, C]
+    lo_bits = 4
+    hi_bits = 4
+    lo_iota = jax.lax.broadcasted_iota(jnp.int32, (lob, C), 0)
+    hi_iota = jax.lax.broadcasted_iota(jnp.int32, (C, hib), 1)
+    for j in range(FB):
+        b = b_all[j]
+        oh_lo = ((lo_iota & 1) == (b & 1)[None, :]).astype(dtype)
+        for p in range(1, lo_bits):
+            oh_lo = oh_lo * (
+                ((lo_iota >> p) & 1) == ((b >> p) & 1)[None, :]
+            ).astype(dtype)
+        oh_hi = ((hi_iota & 1) == ((b >> lo_bits) & 1)[:, None]).astype(dtype)
+        for p in range(1, hi_bits):
+            oh_hi = oh_hi * (
+                ((hi_iota >> p) & 1) == ((b >> (lo_bits + p)) & 1)[:, None]
+            ).astype(dtype)
+        lhs = (oh_lo[:, None, :] * vt[None, :, :]).reshape(lob * k_n, C)
+        out_ref[j] += jax.lax.dot_general(
+            lhs, oh_hi, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def good_bitplane_call(bins, vt, fp8, n_chunks, C, K, Fp):
+    kernel = functools.partial(
+        _kernel_bitplane, lob=LOB, hib=HIB, dtype=jnp.float32
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(fp8, n_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f8, c: (f8, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, C), lambda f8, c: (0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (FB, LOB * K, HIB), lambda f8, c: (f8, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((Fp, LOB * K, HIB), jnp.float32),
+    )(bins, vt)

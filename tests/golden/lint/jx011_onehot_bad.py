@@ -1,0 +1,42 @@
+"""JX011 bad fixture: the dense one-hot-tile histogram call shape (ISSUE 17)
+with one contract violation per check — proof the lint gate sees the
+``histogram_pallas_onehot`` idiom's rank-3 (feature, bin-tile, chunk) grid,
+not just the rank-2 radix/packed4 kernels."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+FB = 8
+BT = 128
+
+
+def _kernel_onehot(bins_ref, vt_ref, out_ref, *, bt, dtype):
+    c = pl.program_id(3)  # grid below is rank 3: axis 3 out of range
+    b = bins_ref[:, :].astype(jnp.int32)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (b.shape[1], bt), 1)
+    oh = (b[0][:, None] == iota).astype(dtype)
+    # out_shape declares float32; this stores the operand dtype instead
+    out_ref[0] += (vt_ref[:][:, :, None] * oh[None, :, :]).sum(1).astype(
+        jnp.bfloat16
+    )
+
+
+def bad_onehot_call(bins, vt, fp8, n_bt, n_chunks, C, K):
+    kernel = functools.partial(_kernel_onehot, bt=BT, dtype=jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid=(fp8, n_bt, n_chunks),
+        in_specs=[
+            # index_map takes TWO coordinates against the rank-3 grid
+            pl.BlockSpec((FB, C), lambda f8, b: (f8, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        # rank-2 block for the rank-3 out_shape entry
+        out_specs=pl.BlockSpec(
+            (FB, BT), lambda f8, b, c: (f8, b), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((32, 3, 256), jnp.float32),
+    )(bins, vt)  # 1 in_spec, 2 operands

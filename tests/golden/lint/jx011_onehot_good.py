@@ -1,0 +1,55 @@
+"""JX011 good fixture: a faithful mirror of the dense one-hot-tile call
+(ops/hist_pallas.histogram_pallas_onehot, ISSUE 17) — rank-3 grid
+(feature-batch, bin-tile, row-chunk), the [C, BT] one-hot slab built in
+VMEM per bin tile, accumulator block revisited across the innermost chunk
+axis. Every contract satisfied; the lint gate must stay silent."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+FB = 8
+BT = 128
+
+
+def _kernel_onehot(bins_ref, vt_ref, out_ref, *, bt, dtype):
+    c = pl.program_id(2)
+
+    @pl.when(c == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    vt = vt_ref[:].astype(dtype)  # [K, C]
+    k_n, C = vt.shape
+    b_all = bins_ref[:, :].astype(jnp.int32)  # [FB, C]
+    iota = (
+        jax.lax.broadcasted_iota(jnp.int32, (C, bt), 1)
+        + pl.program_id(1) * bt
+    )
+    for j in range(FB):
+        oh = (b_all[j][:, None] == iota).astype(dtype)  # [C, BT]
+        out_ref[j] += jax.lax.dot_general(
+            vt, oh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+
+def good_onehot_call(bins, vt, fp8, n_bt, n_chunks, C, K, Fp, Bp):
+    kernel = functools.partial(_kernel_onehot, bt=BT, dtype=jnp.float32)
+    return pl.pallas_call(
+        kernel,
+        grid=(fp8, n_bt, n_chunks),
+        in_specs=[
+            pl.BlockSpec((FB, C), lambda f8, b, c: (f8, c),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((K, C), lambda f8, b, c: (0, c),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (FB, K, BT), lambda f8, b, c: (f8, 0, b),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((Fp, K, Bp), jnp.float32),
+    )(bins, vt)

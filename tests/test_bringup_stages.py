@@ -223,6 +223,37 @@ def test_run_tune_invokes_module_sweep(monkeypatch):
     assert not tb.run_tune()["ok"]
 
 
+def test_run_tune_vocabulary_agnostic(monkeypatch):
+    """The ISSUE 17 wide-bin impls reach the bringup tune stage with ZERO
+    driver wiring: run_tune passes no impl list (the child's
+    candidate_impls derives contenders from ops IMPLS + impl_supported),
+    its swept bin widths already include the wide-bin territory (63, 255
+    <= the 256-bin kernel cap), and on the tpu backend the candidate set
+    contains both new Pallas kernels at those widths."""
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["argv"] = argv
+        return {"digest": "abc123", "entries": 24}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    assert tb.run_tune()["ok"]
+    assert not any(a.startswith("--impl") for a in seen["argv"]), (
+        "tune stage must stay vocabulary-agnostic: impls are derived by "
+        "the child from ops IMPLS, never pinned by the driver"
+    )
+    bins = seen["argv"][seen["argv"].index("--bins") + 1]
+    swept = {int(b) for b in bins.split(",")}
+    assert {63, 255} <= swept
+    from lightgbm_tpu.obs import tune as tune_mod
+
+    for b in (63, 255):
+        cands = tune_mod.candidate_impls(b, "tpu")
+        assert {"pallas_onehot", "pallas_bitplane", "xla_onehot"} <= set(
+            cands
+        ), (b, cands)
+
+
 def test_run_san_invokes_smoke_by_file_path(monkeypatch):
     """The san stage (ISSUE 11) must execute helpers/san_smoke.py by FILE
     path in a child — the driver never imports the package (stays jax-free)

@@ -419,9 +419,45 @@ def test_jx011_packed4_fixture():
                  "JX011") == []
 
 
+def test_jx011_onehot_fixture():
+    """The dense one-hot-tile idiom (ISSUE 17) is provably inside the lint
+    gate's sight — including its rank-3 (feature, bin-tile, chunk) grid: a
+    seeded call is flagged per contract, and the faithful mirror of the
+    real ``histogram_pallas_onehot`` invocation is clean."""
+    findings = _lint(os.path.join(LINT_DIR, "jx011_onehot_bad.py"), "JX011")
+    details = sorted(f.detail for f in findings)
+    assert details == sorted([
+        "_kernel_onehot:program_id=3",   # axis 3 against the rank-3 grid
+        "_kernel_onehot:store_dtype",    # bf16 store into a f32 out ref
+        "in_specs[0]:index_map_arity",   # 2-arg lambda, rank-3 grid
+        "in_specs_count",                # 1 spec, 2 operands
+        "out[0]:block_rank",             # rank-2 block, rank-3 out_shape
+    ]), [f.format() for f in findings]
+    assert _lint(os.path.join(LINT_DIR, "jx011_onehot_good.py"),
+                 "JX011") == []
+
+
+def test_jx011_bitplane_fixture():
+    """The bit-plane idiom (ISSUE 17) is provably inside the lint gate's
+    sight, with a violation mix the other histogram fixtures don't cover
+    (second in_spec arity, out index_map rank, missing out dtype)."""
+    findings = _lint(os.path.join(LINT_DIR, "jx011_bitplane_bad.py"),
+                     "JX011")
+    details = sorted(f.detail for f in findings)
+    assert details == sorted([
+        "_kernel_bitplane:program_id=2",  # axis 2 against the rank-2 grid
+        "in_specs[1]:index_map_arity",    # 1-arg lambda, rank-2 grid
+        "out_specs[0]:index_map_rank",    # 2 coords, 3-dim block
+        "out[0]:dtype_missing",           # ShapeDtypeStruct without dtype
+    ]), [f.format() for f in findings]
+    assert _lint(os.path.join(LINT_DIR, "jx011_bitplane_good.py"),
+                 "JX011") == []
+
+
 def test_jx011_real_pallas_seams_clean():
     """The shipped kernels must satisfy their own hygiene rule — the Pallas
-    PR grows from these seams under JX011's gate."""
+    PR grows from these seams under JX011's gate (including the ISSUE 17
+    onehot/bitplane kernels in hist_pallas.py)."""
     for mod in ("hist_pallas.py", "split_pallas.py"):
         path = os.path.join(REPO, "lightgbm_tpu", "ops", mod)
         assert _lint(path, "JX011") == [], mod
