@@ -1007,6 +1007,17 @@ def _run() -> None:
         )
     except Exception as e:
         print("bench: obs report failed: %s" % e, file=sys.stderr)
+    # fleet-telemetry stamp (obs/podwatch.py): when this bench ran with
+    # LIGHTGBM_TPU_TELEMETRY armed, fold the pod view + verdicts into the
+    # record so bench_diff can WARN on straggler/skew drift across rounds
+    try:
+        from lightgbm_tpu.obs import podwatch as _podwatch
+
+        _tdir = _podwatch.env_dir()
+        if _tdir:
+            extra["podwatch"] = _podwatch.pod_summary(_tdir)
+    except Exception as e:
+        print("bench: podwatch stamp failed: %s" % e, file=sys.stderr)
     if adopt_record is not None:
         extra["bakeoff_adopted"] = adopt_record
     if platform not in ("tpu", "axon"):

@@ -24,6 +24,10 @@ apply per-metric thresholds and emit a markdown verdict table:
     ``transfer_seconds`` > 2x (obs/devprof.py)          -> WARN
     (the bound-ness of the run moved — a pointer into the record's
     device_timeline section, never gated as a code regression)
+  * ``podwatch`` verdicts present (straggler/stall/dead)
+    or iteration spread grew (obs/podwatch.py)          -> WARN
+    (fleet-telemetry signals name sick RANKS, not code — a straggling
+    host invalidates the throughput comparison but must never FAIL it)
 
 Throughput comparisons apply only between records from the SAME platform —
 a CPU-fallback capture vs an on-chip record is apples-to-oranges and every
@@ -65,6 +69,7 @@ THRESHOLDS = {
     "segment_share_shift_pts": 10.0,
     "scaling_eff_drop": 0.10,
     "busy_fraction_drop": 0.15,
+    "podwatch_spread_growth": 2.0,  # iteration-spread growth factor
 }
 
 PASS, WARN, FAIL, SKIP = "PASS", "WARN", "FAIL", "SKIP"
@@ -326,6 +331,38 @@ def compare(
             "shift<=%g pts" % th["segment_share_shift_pts"], status,
             "max shift %+.1f pts (%s)" % (worst_shift, worst),
         ))
+
+    # fleet-telemetry drift (obs/podwatch.py): sick-rank verdicts and an
+    # iteration spread that grew name HOST conditions — they invalidate a
+    # throughput comparison but are never a code regression, so WARN only
+    cpw = current.get("podwatch") or {}
+    if cpw:
+        bpw = baseline.get("podwatch") or {}
+        bad = [v for v in (cpw.get("verdicts") or [])
+               if v.get("verdict") in ("straggler", "stall", "dead")]
+        if bad:
+            first = bad[0]
+            rows.append(_row(
+                "podwatch.verdicts",
+                len([v for v in (bpw.get("verdicts") or [])
+                     if v.get("verdict") in ("straggler", "stall", "dead")]),
+                len(bad), "0", WARN,
+                "%s rank %s — %s" % (first.get("verdict"),
+                                     first.get("rank"),
+                                     str(first.get("why", ""))[:120]),
+            ))
+        bsp = bpw.get("iteration_spread")
+        csp = cpw.get("iteration_spread")
+        if bsp is not None and csp is not None:
+            grew = (float(csp)
+                    > max(float(bsp) * th["podwatch_spread_growth"], 1.0))
+            rows.append(_row(
+                "podwatch.iteration_spread", bsp, csp,
+                "<=%gx" % th["podwatch_spread_growth"],
+                WARN if grew else PASS,
+                "pod ranks drifting apart — see the record's podwatch "
+                "block" if grew else "",
+            ))
 
     failed = any(r["status"] == FAIL for r in rows)
     return rows, failed

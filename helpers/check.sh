@@ -92,6 +92,16 @@
 #                               # structural identity), serial<->data@1
 #                               # byte-identity (docs/FaultTolerance.md
 #                               # §Elastic training)
+#   helpers/check.sh --podwatch # lint gate, then the fleet-telemetry
+#                               # smoke: ONE invocation — a real 2-process
+#                               # CPU training run with the telemetry ring
+#                               # + scrape endpoint armed and rank 1 seeded
+#                               # slow, scraped live mid-run (/metrics +
+#                               # /health + /timeline), then aggregated
+#                               # (python -m lightgbm_tpu.obs.podwatch)
+#                               # with the seeded straggler named in the
+#                               # verdict + telemetry-off byte-identity
+#                               # (docs/Observability.md §Fleet telemetry)
 #   helpers/check.sh --ir       # lint gate, then the graftir program
 #                               # audit smoke: ONE invocation — seeded
 #                               # violations per IR rule all caught, then
@@ -120,9 +130,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--elastic|--ir|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--elastic|--podwatch|--ir|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof, --elastic, --ir or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof, --elastic, --podwatch, --ir or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -219,6 +229,11 @@ fi
 if [ "$MODE" = "--elastic" ]; then
     echo "== elastic smoke (SIGKILL/SIGTERM -> resume byte-identity + 8->2 reshard) =="
     exec python helpers/elastic_smoke.py
+fi
+
+if [ "$MODE" = "--podwatch" ]; then
+    echo "== podwatch smoke (2-proc train + live scrape + straggler verdict) =="
+    exec python helpers/podwatch_smoke.py
 fi
 
 if [ "$MODE" = "--ir" ]; then

@@ -130,6 +130,11 @@ STAGE_TIMEOUTS = {
                       # same-mesh resume byte-identity, SIGTERM -> exit-75
                       # emergency checkpoint -> auto-resume, 8->2 reshard
                       # structural identity (resil/, ISSUE 15)
+    "podwatch": 1800,  # fleet-telemetry smoke: real 2-process training
+                       # scraped live mid-run (/metrics /health /timeline),
+                       # shards aggregated, seeded straggler rank named in
+                       # the verdict + telemetry-off byte-identity
+                       # (obs/podwatch.py, ISSUE 19)
     "bench": 3600,
 }
 
@@ -794,6 +799,21 @@ def run_elastic(stage: str = "elastic") -> dict:
     )
 
 
+def run_podwatch(stage: str = "podwatch") -> dict:
+    """Fleet-telemetry smoke (helpers/podwatch_smoke.py, ISSUE 19) —
+    executed by FILE path in a child process, driver stays jax-free. The
+    child runs a real 2-process training world with the telemetry ring +
+    scrape endpoint armed and rank 1 seeded slow, scrapes /metrics +
+    /health + /timeline live mid-run, aggregates the shards and requires
+    the straggler verdict to name the seeded rank — plus the telemetry-off
+    byte-identity of the trained model. On silicon this is the proof a pod
+    can be watched (and a sick rank named) while the chips are busy."""
+    return _run_child(
+        stage,
+        [sys.executable, os.path.join(REPO, "helpers", "podwatch_smoke.py")],
+    )
+
+
 def run_devprof(stage: str = "devprof") -> dict:
     """Device-timeline audit smoke (helpers/devprof_smoke.py, ISSUE 14) —
     executed by FILE path in a child process, driver stays jax-free. The
@@ -1027,6 +1047,10 @@ def main() -> int:
                        # elastic preemption tolerance: SIGKILL/SIGTERM ->
                        # resume byte-identity + reshard chain (ISSUE 15)
                        ("elastic", "ELASTIC"),
+                       # fleet telemetry: live mid-run scrape + aggregated
+                       # straggler verdict on a real 2-process world
+                       # (ISSUE 19)
+                       ("podwatch", "PODWATCH"),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         with _stage_span(stage):
@@ -1044,6 +1068,8 @@ def main() -> int:
                 runner = lambda s=stage: run_loop(s)  # noqa: E731
             elif src == "ELASTIC":
                 runner = lambda s=stage: run_elastic(s)  # noqa: E731
+            elif src == "PODWATCH":
+                runner = lambda s=stage: run_podwatch(s)  # noqa: E731
             elif src is None:
                 runner = lambda s=stage: run_bench(s)  # noqa: E731
             else:

@@ -15,6 +15,7 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset
 from .obs import flight as flight_mod
+from .obs import podwatch as podwatch_mod
 from .obs import registry as obs_registry
 from .obs import sanitize as sanitize_mod
 from .obs import trace as trace_mod
@@ -276,6 +277,13 @@ def train(
         preempt_watcher = preempt_mod.PreemptionWatcher()
         preempt_watcher.install()
 
+    # live fleet telemetry (obs/podwatch.py): per-rank boundary recorder
+    # (LIGHTGBM_TPU_TELEMETRY=<dir>) + opt-in scrape endpoint
+    # (LIGHTGBM_TPU_TELEMETRY_PORT). Both unset costs one env read per
+    # gate here and nothing in the loop; the trained model is bitwise
+    # independent of telemetry either way (host-side sampling only).
+    telemetry_rec = podwatch_mod.maybe_start(preempt_watcher=preempt_watcher)
+
     evaluation_result_list: List = []
     try:
         with timer_mod.maybe_profile():
@@ -300,6 +308,11 @@ def train(
         if flight_rec is not None and flight_mod.active() is flight_rec:
             flight_mod.note_event("aborted")
             flight_mod.stop()
+        # same leak rule for the telemetry recorder; the scrape listener
+        # (if armed) deliberately stays up across train() calls
+        if (telemetry_rec is not None
+                and podwatch_mod.active() is telemetry_rec):
+            podwatch_mod.stop()
 
 
 def _finish_train(booster, evaluation_result_list, flight_rec, model_stats):
@@ -390,6 +403,7 @@ def _boost_loop(
     import time as _time
 
     flight_on = flight_mod.active() is not None
+    telemetry_on = podwatch_mod.active() is not None
     t_boundary = _time.perf_counter()
     while i < end:
         # named fault site: the crash tests SIGKILL here mid-run and prove
@@ -445,15 +459,23 @@ def _boost_loop(
             hist = booster._gbdt._eval_history
             for (dname, mname, val, _) in evaluation_result_list:
                 hist.setdefault(dname, {}).setdefault(mname, []).append(val)
-        if flight_on:
-            # one flight record per boundary: the eval-history values plus
-            # the boundary's wall time (host clock only — the dispatch is
-            # async either way, so this is dispatch+eval time, not a fence)
+        if flight_on or telemetry_on:
+            # one record per boundary: the boundary's wall time (host clock
+            # only — the dispatch is async either way, so this is
+            # dispatch+eval time, not a fence), shared by the flight
+            # recorder and the telemetry ring so both attribute the SAME
+            # seconds to the same boundary
             now = _time.perf_counter()
-            flight_mod.note_boundary(
-                i - 1, done, now - t_boundary, evaluation_result_list
-            )
+            dt_boundary = now - t_boundary
             t_boundary = now
+            if flight_on:
+                flight_mod.note_boundary(
+                    i - 1, done, dt_boundary, evaluation_result_list
+                )
+            if telemetry_on:
+                podwatch_mod.note_boundary(
+                    i - 1, done, dt_boundary, gbdt=booster._gbdt
+                )
         try:
             for cb in cbs_after:
                 cb(

@@ -38,6 +38,14 @@ report); the serve-side drift monitor lives in serve/drift.py. One spine:
    the TraceAnnotation segment vocabulary, and classifies the run
    host- / device- / transfer-bound (docs/Observability.md §Device
    timeline). Stdlib-only parsing; imported lazily by its callers.
+ * :mod:`~lightgbm_tpu.obs.podwatch` — the live fleet telemetry plane
+   (``python -m lightgbm_tpu.obs.podwatch``): per-rank chunk-boundary
+   time-series ring (``LIGHTGBM_TPU_TELEMETRY=<dir>``), the opt-in
+   training-side scrape endpoint (``LIGHTGBM_TPU_TELEMETRY_PORT``:
+   /metrics /health /timeline), and the cross-rank aggregator issuing
+   straggler/stall/skew/dead verdicts from the shards + heartbeats
+   (docs/Observability.md §Fleet telemetry). Not imported by this
+   package's init; the aggregator half never imports jax.
 
 Importing this package never touches a jax backend.
 """

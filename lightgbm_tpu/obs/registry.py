@@ -316,19 +316,23 @@ class MetricsRegistry:
         """Prometheus text exposition (format version 0.0.4) of everything
         registered: counters as ``counter`` (``_total`` suffix enforced),
         gauges and rates as ``gauge``, histograms as ``summary`` with exact
-        ring quantiles + all-time _count/_sum."""
+        ring quantiles + all-time _count/_sum. ``# HELP`` lines carry each
+        instrument's help string (escaped per the format: backslash and
+        newline only — HELP values are not quoted, so ``"`` stays raw)."""
         lines: List[str] = []
         for name, m in self._items():
             if isinstance(m, Counter):
                 pname = _prom_name(name)
                 if not pname.endswith("_total"):
                     pname += "_total"
+                _help_line(lines, pname, m.help)
                 lines.append("# TYPE %s counter" % pname)
                 vals = m.values() or {(): 0.0}
                 for labels, v in sorted(vals.items()):
                     lines.append("%s%s %s" % (pname, _prom_labels(labels), _num(v)))
             elif isinstance(m, Gauge):
                 pname = _prom_name(name)
+                _help_line(lines, pname, m.help)
                 lines.append("# TYPE %s gauge" % pname)
                 vals = m.values() or {(): 0.0}
                 for labels, v in sorted(vals.items()):
@@ -395,9 +399,31 @@ class MetricsRegistry:
 
 
 def _num(v: float) -> str:
-    """Prometheus number formatting: integers bare, floats via repr."""
+    """Prometheus number formatting: integers bare, floats via repr,
+    non-finite values as the format's ``NaN``/``+Inf``/``-Inf`` tokens.
+    The finiteness check must come FIRST: ``int(nan)`` raises ValueError
+    and ``int(inf)`` OverflowError, and either would have taken the whole
+    /metrics scrape down with it (a pull gauge can legitimately yield
+    inf — e.g. a rate denominator of zero upstream)."""
     f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
     return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def _help_line(lines: List[str], pname: str, help_text: str) -> None:
+    """Append the ``# HELP`` line for ``pname`` when a help string exists.
+    HELP values are raw (not quoted), so only backslash and newline need
+    escaping — escaping ``"`` here would render literal backslashes in
+    scrape UIs."""
+    if help_text:
+        lines.append(
+            "# HELP %s %s"
+            % (pname,
+               str(help_text).replace("\\", "\\\\").replace("\n", "\\n"))
+        )
 
 
 def _report_key(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:

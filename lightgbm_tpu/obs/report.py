@@ -647,6 +647,46 @@ def _section_bench(bench_records: List[Tuple[str, Dict]]) -> str:
     return "".join(out)
 
 
+def _section_fleet(metrics: Dict) -> str:
+    """§Fleet telemetry (obs/podwatch.py): the pod view — per-rank
+    progress/rate table plus the evidence-backed straggler/stall/skew/dead
+    verdict list, each sentence citing the threshold it tripped."""
+    rec = metrics.get("fleet_telemetry")
+    if not isinstance(rec, dict) or not rec.get("ranks"):
+        return ""
+    out = ["<h2>Fleet telemetry</h2>"]
+    out.append(
+        '<div class="small">world %s · iteration spread %s · '
+        "podwatch over %s</div>"
+        % (_esc(rec.get("world", "?")), _esc(rec.get("iteration_spread", 0)),
+           _esc(rec.get("dir", "?")))
+    )
+    rows = []
+    for r, info in sorted(rec["ranks"].items(), key=lambda kv: int(kv[0])):
+        rows.append((
+            r,
+            _esc(info.get("iteration", "-")),
+            _esc(info.get("it_per_s", "-")),
+            _esc(info.get("chunk_s", "-")),
+            _esc(info.get("samples", 0)),
+        ))
+    out.append(_table(
+        ("rank", "iteration", "it/s", "chunk s", "samples"), rows
+    ))
+    verdicts = rec.get("verdicts") or []
+    if not verdicts:
+        out.append('<div><span class="ok">no verdicts</span> — '
+                   '<span class="small">pod looks healthy</span></div>')
+    for v in verdicts:
+        out.append(
+            '<div><span class="alert">%s rank %s</span> — '
+            '<span class="small">%s</span></div>'
+            % (_esc(v.get("verdict")), _esc(v.get("rank")),
+               _esc(v.get("why", "")))
+        )
+    return "".join(out)
+
+
 def _section_registry_digest(metrics: Dict, limit: int = 40) -> str:
     rows: List[Tuple[str, str]] = []
     for kind in ("counters", "gauges", "rates"):
@@ -702,6 +742,7 @@ def render(
         _section_importance_evolution(flight),
         _section_segments(mblock),
         _section_device_timeline(mblock),
+        _section_fleet(mblock),
         _section_drift(mblock, drift),
         _section_bench(bench_records or []),
         _section_multichip(bench_records or []),

@@ -264,27 +264,73 @@ def heartbeat_path(path: str, rank: int) -> str:
     return "%s.hb.rank%d.json" % (path, rank)
 
 
-def heartbeat(path: str, iteration: int, rank: Optional[int] = None) -> str:
+def heartbeat(path: str, iteration: int, rank: Optional[int] = None,
+              extra: Optional[Dict] = None) -> str:
     """One small atomic blob per rank per boundary: alive + where. No
     fsync — liveness evidence need not survive a power cut, and a cadence
-    boundary must not pay a disk flush for it."""
+    boundary must not pay a disk flush for it.
+
+    The payload always carries the PR 14 core (``rank``, ``iteration``,
+    ``pid``, ``time``) plus a ``mono`` monotonic stamp; ``extra`` merges
+    additional per-boundary evidence (podwatch rides ``last_chunk_s`` and
+    ``it_per_s`` here) without displacing the core keys — old readers only
+    look at the keys they know, so enriched blobs and PR 14 archives stay
+    mutually readable."""
     if rank is None:
         rank, _ = dist_mod.process_info()
     out = heartbeat_path(path, rank)
-    atomic_write_text(
-        out,
-        json.dumps({"rank": rank, "iteration": int(iteration),
-                    "pid": os.getpid(), "time": time.time()}),
-        fsync=False,
-    )
+    blob = dict(extra or {})
+    blob.update({"rank": rank, "iteration": int(iteration),
+                 "pid": os.getpid(), "time": time.time(),
+                 "mono": time.monotonic()})
+    atomic_write_text(out, json.dumps(blob), fsync=False)
     return out
+
+
+def read_heartbeats(path: str, world: int) -> Dict[int, Dict]:
+    """{rank: heartbeat blob} for every rank whose file parses — the raw
+    evidence podwatch's aggregator folds; missing/torn files are simply
+    absent (stale_ranks is the liveness judgement, this is the data)."""
+    out: Dict[int, Dict] = {}
+    for r in range(world):
+        try:
+            with open(heartbeat_path(path, r), encoding="utf-8") as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(blob, dict):
+            out[r] = blob
+    return out
+
+
+class RankStaleness(tuple):
+    """A ``(rank, age)`` pair — unpacks, compares and reprs exactly like
+    the plain tuples PR 14 callers match against — additionally carrying
+    the heartbeat blob it was judged from as ``.evidence`` ({} when the
+    file was missing or torn) so podwatch's *dead* verdict can cite the
+    last known iteration/pid without re-reading the file."""
+
+    def __new__(cls, rank: int, age: Optional[float],
+                evidence: Optional[Dict] = None) -> "RankStaleness":
+        self = tuple.__new__(cls, (rank, age))
+        self.evidence = evidence or {}
+        return self
+
+    @property
+    def rank(self) -> int:
+        return self[0]
+
+    @property
+    def age(self) -> Optional[float]:
+        return self[1]
 
 
 def stale_ranks(path: str, world: int, max_age_s: float,
                 now: Optional[float] = None) -> List[Tuple[int, Optional[float]]]:
     """Ranks whose heartbeat is older than ``max_age_s`` (age) or missing
     entirely (None) — the dead-rank shortlist a hung-collective warning
-    points operators at."""
+    points operators at. Entries are :class:`RankStaleness` — tuple-equal
+    to the historical ``(rank, age)`` shape, with ``.evidence`` on top."""
     now = time.time() if now is None else now
     out: List[Tuple[int, Optional[float]]] = []
     for r in range(world):
@@ -293,9 +339,9 @@ def stale_ranks(path: str, world: int, max_age_s: float,
                 blob = json.load(fh)
             age = now - float(blob.get("time", 0.0))
             if age > max_age_s:
-                out.append((r, age))
+                out.append(RankStaleness(r, age, blob))
         except (OSError, ValueError):
-            out.append((r, None))
+            out.append(RankStaleness(r, None))
     return out
 
 

@@ -1,0 +1,53 @@
+"""Shared stdlib HTTP plumbing for every in-process listener.
+
+Two endpoints in this codebase speak HTTP: the serving tier
+(serve/server.py) and the training-side telemetry scrape listener
+(obs/podwatch.py). Both need the same three mechanics — JSON/text response
+writing with correct Content-Length, http.server log chatter routed to the
+debug log instead of stderr, and a threaded daemon server whose handler
+threads can never block interpreter exit. This module is that common base,
+deliberately stdlib-only and jax-free: obs/podwatch imports it from inside
+a training process where pulling the serving stack (numpy model packing,
+batcher, dispatch caches) would be both heavy and circular.
+"""
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict
+
+from ..utils import log
+
+#: the /metrics content type every scrape endpoint advertises
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class JsonHandler(BaseHTTPRequestHandler):
+    """BaseHTTPRequestHandler with the response/logging mechanics shared by
+    the serve and podwatch listeners; subclasses add routes (do_GET/do_POST)
+    and set ``server_version`` + ``log_prefix``."""
+
+    server_version = "lightgbm-tpu/1.0"
+    #: prefix for routed log lines ("serve", "podwatch", ...)
+    log_prefix = "http"
+
+    def log_message(self, fmt, *args):  # route http.server chatter to debug
+        log.debug("%s: %s" % (self.log_prefix, fmt % args))
+
+    def _json(self, code: int, payload: Dict) -> None:
+        self._text(code, json.dumps(payload), "application/json")
+
+    def _text(self, code: int, text: str, ctype: str) -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class DaemonHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer whose handler threads are daemons: neither a
+    wedged scrape nor a slow client can hold the process open at exit."""
+
+    daemon_threads = True

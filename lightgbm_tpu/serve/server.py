@@ -43,7 +43,6 @@ import threading
 import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FuturesTimeout
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -58,6 +57,7 @@ from ..utils import log
 from ..utils.log import LightGBMError
 from ..utils.vfile import vopen
 from . import drift as drift_mod
+from . import httpbase
 from .batcher import BatcherClosed, MicroBatcher
 from .cache import BucketedDispatcher
 from .metrics import ServeMetrics
@@ -766,26 +766,13 @@ class ServeApp:
             self.batcher.close()
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(httpbase.JsonHandler):
     server_version = "lightgbm-tpu-serve/1.0"
+    log_prefix = "serve"
 
     @property
     def app(self) -> ServeApp:
         return self.server.app  # type: ignore[attr-defined]
-
-    def log_message(self, fmt, *args):  # route http.server chatter to debug
-        log.debug("serve: " + fmt % args)
-
-    def _json(self, code: int, payload: Dict) -> None:
-        self._text(code, json.dumps(payload), "application/json")
-
-    def _text(self, code: int, text: str, ctype: str) -> None:
-        body = text.encode("utf-8")
-        self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
 
     def _retryable_503(self, error: str, reason: str, retry_after_s: int) -> None:
         raw = json.dumps({"error": error, "reason": reason}).encode("utf-8")
@@ -829,8 +816,7 @@ class _Handler(BaseHTTPRequestHandler):
             # config example); the pre-obs JSON snapshot moved to
             # /metrics.json
             self._text(
-                200, app.prometheus_metrics(),
-                "text/plain; version=0.0.4; charset=utf-8",
+                200, app.prometheus_metrics(), httpbase.PROM_CONTENT_TYPE,
             )
         elif path == "/metrics.json":
             self._json(200, app.metrics.snapshot(app.dispatcher_stats()))
@@ -928,9 +914,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(500, {"error": "%s: %s" % (type(e).__name__, e)})
 
 
-class ServeHTTPServer(ThreadingHTTPServer):
-    daemon_threads = True
-
+class ServeHTTPServer(httpbase.DaemonHTTPServer):
     def __init__(self, addr, app: ServeApp) -> None:
         super().__init__(addr, _Handler)
         self.app = app

@@ -63,8 +63,8 @@ _QUICK_MODULES = {
     "test_hist_modes", "test_irscan", "test_loop", "test_metric_alias",
     "test_micro_exact", "test_model_io", "test_model_obs", "test_native",
     "test_obs",
-    "test_ops", "test_parallel_chunk", "test_param_docs", "test_prof",
-    "test_resil", "test_sanitize",
+    "test_ops", "test_parallel_chunk", "test_param_docs", "test_podwatch",
+    "test_prof", "test_resil", "test_sanitize",
     "test_serve_drift", "test_serve_packed",
     "test_serve_resil", "test_serve_server", "test_snapshot_timers",
     "test_tune", "test_vfile", "test_warmstart",

@@ -29,7 +29,7 @@ def test_stage_table_complete():
         "irscan", "bench_early", "smoke_pallas", "smoke_xla_radix",
         "smoke_bf16", "smoke_psplit", "bench_chunk", "bench_multichip",
         "bench_predict", "prof", "devprof", "san", "loop", "elastic",
-        "bench",
+        "podwatch", "bench",
     }
 
 
@@ -271,6 +271,26 @@ def test_run_san_invokes_smoke_by_file_path(monkeypatch):
     r = tb.run_san()
     assert r["ok"] and seen["stage"] == "san"
     assert seen["argv"][-1].endswith(_os.path.join("helpers", "san_smoke.py"))
+
+
+def test_run_podwatch_invokes_smoke_by_file_path(monkeypatch):
+    """The podwatch stage (ISSUE 19) executes helpers/podwatch_smoke.py by
+    FILE path in a child — the parent driver stays jax-free while the smoke
+    launches its own 2-process jax.distributed world."""
+    import os as _os
+
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["stage"] = stage
+        seen["argv"] = argv
+        return {"ok": True}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    r = tb.run_podwatch()
+    assert r["ok"] and seen["stage"] == "podwatch"
+    assert seen["argv"][-1].endswith(
+        _os.path.join("helpers", "podwatch_smoke.py"))
 
 
 def test_run_devprof_invokes_smoke_by_file_path(monkeypatch):

@@ -347,6 +347,67 @@ def test_registry_prometheus_roundtrip():
     assert report["summaries"]["latency_seconds"]["count"] == 4
 
 
+def test_prometheus_label_value_escaping_roundtrip():
+    """Label values carrying the three characters the text exposition
+    escapes (backslash, double-quote, newline) must render per the 0.0.4
+    format — backslash FIRST, then quote, then newline — and decode back
+    to the original value (the podwatch aggregator and any real scraper
+    both rely on this)."""
+    reg = MetricsRegistry()
+    nasty = 'C:\\tmp\\x "quoted"\nline2'
+    reg.gauge("paths").set(1.0, path=nasty)
+    expo = reg.prometheus_text()
+    line = next(l for l in expo.splitlines() if l.startswith("lgbtpu_paths{"))
+    assert line == (
+        'lgbtpu_paths{path="C:\\\\tmp\\\\x \\"quoted\\"\\nline2"} 1'
+    )
+    # decode exactly as a scraper would: the escaped body is one line
+    body = line[len('lgbtpu_paths{path="'):-len('"} 1')]
+    assert "\n" not in body
+    decoded = (
+        body.replace("\\n", "\n").replace('\\"', '"').replace("\\\\", "\\")
+    )
+    assert decoded == nasty
+
+
+def test_prometheus_nonfinite_values_render_as_tokens():
+    """NaN/Inf must render as the format's tokens — int(nan) raises
+    ValueError and int(inf) OverflowError, and before the podwatch PR
+    either took the WHOLE /metrics scrape down with it."""
+    reg = MetricsRegistry()
+    reg.gauge("weird").set(float("nan"), kind="nan")
+    reg.gauge("weird").set(float("inf"), kind="pinf")
+    reg.gauge("weird").set(float("-inf"), kind="ninf")
+    reg.gauge("fine").set(3.5)
+    expo = reg.prometheus_text()
+    assert 'lgbtpu_weird{kind="nan"} NaN' in expo
+    assert 'lgbtpu_weird{kind="pinf"} +Inf' in expo
+    assert 'lgbtpu_weird{kind="ninf"} -Inf' in expo
+    # the finite neighbours still scrape
+    assert "lgbtpu_fine 3.5" in expo
+
+
+def test_prometheus_help_lines_escaped_and_parseable():
+    """# HELP rides each instrument's help string, with backslash/newline
+    escaped (HELP values are unquoted, so a raw `\"` stays raw) — and the
+    standard parser helpers above must keep skipping them."""
+    reg = MetricsRegistry()
+    reg.counter("jobs", 'help with \\ and\nnewline and "quote"').inc(2)
+    reg.gauge("depth", "queue depth").set(4)
+    expo = reg.prometheus_text()
+    assert ('# HELP lgbtpu_jobs_total help with \\\\ and\\nnewline '
+            'and "quote"') in expo
+    assert "# HELP lgbtpu_depth queue depth" in expo
+    # HELP precedes TYPE for the same family (textfile-collector ordering)
+    lines = expo.splitlines()
+    assert lines.index("# HELP lgbtpu_depth queue depth") < lines.index(
+        "# TYPE lgbtpu_depth gauge"
+    )
+    samples, types = _parse_prom(expo)
+    assert samples[("lgbtpu_jobs_total", "")] == 2
+    assert types["lgbtpu_depth"] == "gauge"
+
+
 def test_serve_metrics_exposition_has_required_families(clean_obs, tmp_path):
     """/metrics acceptance: latency quantiles, QPS, retrace count and peak
     device bytes all present and parseable."""
