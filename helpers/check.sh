@@ -102,6 +102,16 @@
 #                               # with the seeded straggler named in the
 #                               # verdict + telemetry-off byte-identity
 #                               # (docs/Observability.md §Fleet telemetry)
+#   helpers/check.sh --flex     # lint gate, then the flexctl chaos
+#                               # smoke: ONE invocation — a scripted
+#                               # capacity storm on forced-multi-CPU
+#                               # children (shrink 8->2 at a boundary,
+#                               # grow back, SIGKILL one launch mid-
+#                               # chunk) supervised end-to-end, gated
+#                               # on flex_reshards labels matching the
+#                               # script and the exactness taxonomy
+#                               # (docs/FaultTolerance.md §Fleet
+#                               # orchestrator)
 #   helpers/check.sh --ir       # lint gate, then the graftir program
 #                               # audit smoke: ONE invocation — seeded
 #                               # violations per IR rule all caught, then
@@ -130,9 +140,9 @@ cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
 case "$MODE" in
-    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--elastic|--podwatch|--ir|--bench-diff) ;;
+    full|--quick|--lint|--serve|--obs|--resil|--prof|--drift|--multichip|--dist-obs|--san|--loop|--tune|--devprof|--elastic|--podwatch|--flex|--ir|--bench-diff) ;;
     *)
-        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof, --elastic, --podwatch, --ir or --bench-diff)" >&2
+        echo "check.sh: unknown mode '$MODE' (expected --quick, --lint, --serve, --obs, --resil, --prof, --drift, --multichip, --dist-obs, --san, --loop, --tune, --devprof, --elastic, --podwatch, --flex, --ir or --bench-diff)" >&2
         exit 2
         ;;
 esac
@@ -234,6 +244,11 @@ fi
 if [ "$MODE" = "--podwatch" ]; then
     echo "== podwatch smoke (2-proc train + live scrape + straggler verdict) =="
     exec python helpers/podwatch_smoke.py
+fi
+
+if [ "$MODE" = "--flex" ]; then
+    echo "== flex smoke (capacity storm: shrink/grow drains + mid-chunk SIGKILL under flexctl) =="
+    exec python helpers/flex_smoke.py
 fi
 
 if [ "$MODE" = "--ir" ]; then

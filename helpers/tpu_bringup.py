@@ -130,6 +130,12 @@ STAGE_TIMEOUTS = {
                       # same-mesh resume byte-identity, SIGTERM -> exit-75
                       # emergency checkpoint -> auto-resume, 8->2 reshard
                       # structural identity (resil/, ISSUE 15)
+    "flex": 1800,   # flexctl chaos smoke: scripted capacity storm
+                    # (shrink 8->2 at a boundary, grow back, SIGKILL one
+                    # launch mid-chunk) supervised end-to-end — reshard
+                    # counters must match the script and the final model
+                    # must match the uninterrupted reference per the
+                    # exactness taxonomy (flex/, ISSUE 20)
     "podwatch": 1800,  # fleet-telemetry smoke: real 2-process training
                        # scraped live mid-run (/metrics /health /timeline),
                        # shards aggregated, seeded straggler rank named in
@@ -799,6 +805,22 @@ def run_elastic(stage: str = "elastic") -> dict:
     )
 
 
+def run_flex(stage: str = "flex") -> dict:
+    """Flexctl chaos smoke (helpers/flex_smoke.py, ISSUE 20) — executed by
+    FILE path in a child process, driver stays jax-free. The child scripts
+    a capacity storm over forced-multi-CPU trainer children: a planned
+    shrink drains at a chunk boundary and exits with the reshard code, the
+    grow-back drains again, a SIGKILLed launch restarts at the same world,
+    and the final model matches the uninterrupted reference per the
+    exactness taxonomy (docs/FaultTolerance.md §Fleet orchestrator). On
+    silicon this is the evidence a capacity change costs one boundary
+    drain, not the run."""
+    return _run_child(
+        stage,
+        [sys.executable, os.path.join(REPO, "helpers", "flex_smoke.py")],
+    )
+
+
 def run_podwatch(stage: str = "podwatch") -> dict:
     """Fleet-telemetry smoke (helpers/podwatch_smoke.py, ISSUE 19) —
     executed by FILE path in a child process, driver stays jax-free. The
@@ -1051,6 +1073,10 @@ def main() -> int:
                        # straggler verdict on a real 2-process world
                        # (ISSUE 19)
                        ("podwatch", "PODWATCH"),
+                       # elastic fleet orchestration: scripted capacity
+                       # storm (shrink/grow drains + mid-chunk SIGKILL)
+                       # supervised by flexctl (ISSUE 20)
+                       ("flex", "FLEX"),
                        ("pack4", PACK4)):
         print("bringup: stage %s ..." % stage, flush=True)
         with _stage_span(stage):
@@ -1070,6 +1096,8 @@ def main() -> int:
                 runner = lambda s=stage: run_elastic(s)  # noqa: E731
             elif src == "PODWATCH":
                 runner = lambda s=stage: run_podwatch(s)  # noqa: E731
+            elif src == "FLEX":
+                runner = lambda s=stage: run_flex(s)  # noqa: E731
             elif src is None:
                 runner = lambda s=stage: run_bench(s)  # noqa: E731
             else:

@@ -244,11 +244,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             else:
                 log.fatal("Unknown task: %s" % config.task)
     except TrainingPreempted as e:
-        # the preemption contract (docs/FaultTolerance.md §Elastic
-        # training): a durable emergency checkpoint was published at the
-        # last boundary, and the DISTINCT exit code tells orchestrators
-        # (loop restart, tpu_bringup run_with_retry) "resume me" instead
-        # of "I failed"
+        # the boundary-latch contracts (docs/FaultTolerance.md): a durable
+        # checkpoint was published at the last boundary, and the DISTINCT
+        # exit code tells orchestrators what kind of relaunch is wanted —
+        # 75 "resume me as I was" (preempt; loop restart, tpu_bringup
+        # run_with_retry), 76 "relaunch me at current capacity" (flexctl
+        # drain, §Fleet orchestrator)
+        if getattr(e, "reason", "preempt") == "drain":
+            log.warning(
+                "train drained for reshard (%s); checkpoint: %s — the "
+                "flex controller relaunches at the new capacity; exiting %d"
+                % (e, e.checkpoint_path or "<none>", e.exit_code)
+            )
+            return e.exit_code
         log.warning(
             "train preempted (%s); emergency checkpoint: %s — re-run with "
             "resume_from to continue; exiting %d"

@@ -339,6 +339,32 @@ class Config:
     convert_model_language: str = ""
     convert_model: str = "gbdt_prediction.cpp"
 
+    # --- flex ---
+    # Elastic fleet orchestration (lightgbm_tpu/flex/,
+    # docs/FaultTolerance.md §Fleet orchestrator). flex_plan=<plan.json>
+    # arms the in-train capacity watcher: a plan change drains at a chunk
+    # boundary (checkpoint + exit 76) so `python -m lightgbm_tpu.flex` can
+    # relaunch at the new world. Unset is provably inert (one env read;
+    # LIGHTGBM_TPU_FLEX_PLAN is the env spelling). All flex_* params are
+    # POPPED by engine.train so the model footer never depends on how a
+    # run was orchestrated.
+    flex_plan: str = ""
+    # Heartbeat age (seconds) past which a silent rank counts as dead and
+    # the survivors drain to reshard without it.
+    flex_dead_after_s: float = 60.0
+    # Controller knobs (consumed by `python -m lightgbm_tpu.flex`, ignored
+    # by a plain train): initial world, the floor a reshard may shrink to,
+    # the consecutive-rapid-restart cap, and the decorrelated-jitter
+    # backoff window (resil/backoff.decorrelated) pacing relaunches.
+    flex_world: int = 0
+    flex_min_world: int = 1
+    flex_max_restarts: int = 5
+    flex_backoff_base_s: float = 0.5
+    flex_backoff_max_s: float = 30.0
+    # Forced-CPU worlds for the chaos smoke: each relaunch gets
+    # XLA_FLAGS=--xla_force_host_platform_device_count=<world>.
+    flex_force_cpu: bool = False
+
     # --- objective ---
     num_class: int = 1
     is_unbalance: bool = False

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import collections
 import copy
+import os
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -50,6 +51,7 @@ def train(
     resume_from: Optional[str] = None,
     checkpoint_keep: int = 0,
     preempt_exit: Optional[bool] = None,
+    flex_plan: Optional[str] = None,
 ) -> Booster:
     params = dict(params) if params else {}
     params = Config.canonicalize(params)
@@ -78,6 +80,26 @@ def train(
         preempt_exit = v if preempt_exit is None else preempt_exit
     if preempt_exit is None:
         preempt_exit = preempt_mod.env_enabled()
+    # fleet orchestration (lightgbm_tpu/flex/): same pop discipline. An
+    # EXPLICIT flex_plan="" disarms the env, mirroring preempt_exit=false.
+    if "flex_plan" in params:
+        v = str(params.pop("flex_plan"))
+        flex_plan = v if flex_plan is None else flex_plan
+    flex_dead_after_s = 60.0
+    if "flex_dead_after_s" in params:
+        flex_dead_after_s = float(params.pop("flex_dead_after_s"))
+    # controller-only flex knobs ride along when the flex CLI passes its
+    # whole argv to the child; pop them so the model footer stays clean
+    for _k in ("flex_world", "flex_min_world", "flex_max_restarts",
+               "flex_backoff_base_s", "flex_backoff_max_s",
+               "flex_force_cpu", "flex_seed", "flex_max_launches",
+               "flex_journal"):
+        params.pop(_k, None)
+    if flex_plan is None:
+        # the ONE env read flexctl costs when off (the inertness contract
+        # tests/test_flex.py pins); the name mirrors flex/capacity.ENV_PLAN
+        flex_plan = os.environ.get("LIGHTGBM_TPU_FLEX_PLAN")
+    flex_plan = flex_plan or None
     # model/data observability params (docs/Observability.md): POPPED like
     # the resil params so the model's parameters footer stays byte-identical
     # with recording on or off — the bitwise-identity contract the
@@ -284,16 +306,76 @@ def train(
     # independent of telemetry either way (host-side sampling only).
     telemetry_rec = podwatch_mod.maybe_start(preempt_watcher=preempt_watcher)
 
+    # fleet orchestration (lightgbm_tpu/flex/): a capacity plan arms a
+    # boundary-driven watcher that latches the SAME chunk-boundary latch
+    # preemption uses, with reason "drain" (exit RESHARD_EXIT_CODE so the
+    # flexctl controller relaunches at the new capacity). Threadless: its
+    # whole runtime cost is one check_boundary call per chunk boundary.
+    # flex_plan unset costs exactly the one env read above — no import, no
+    # latch, no objects (the inertness contract).
+    latch = preempt_watcher
+    flex_watcher = None
+    if flex_plan:
+        from .flex import watch as flexwatch_mod
+        from .obs import dist as dist_mod
+        from .resil import checkpoint as ckpt_mod
+
+        if ckpt_writer is None:
+            log.warning(
+                "flex: flex_plan armed without checkpoint_path — a drain "
+                "will exit with the reshard code but WITHOUT a checkpoint "
+                "for the relaunch to resume from"
+            )
+        rank, procs = dist_mod.process_info()
+        hb_base = None
+        if procs > 1:
+            # dead-rank evidence: the telemetry heartbeats refresh every
+            # boundary when podwatch is armed; the checkpoint-side ones
+            # only at checkpoint cadence (still usable, just coarser)
+            hb_base = (podwatch_mod.heartbeat_base(telemetry_rec.out_dir)
+                       if telemetry_rec is not None else checkpoint_path)
+        if latch is None:
+            latch = preempt_mod.BoundaryLatch()
+        flex_watcher = flexwatch_mod.maybe_watch(
+            flex_plan, latch,
+            checkpoint_path=checkpoint_path or flex_plan,
+            live_world=ckpt_mod.mesh_world_of(booster._gbdt),
+            procs=procs, rank=rank, hb_base=hb_base,
+            dead_after_s=flex_dead_after_s,
+        )
+
     evaluation_result_list: List = []
     try:
         with timer_mod.maybe_profile():
-            evaluation_result_list = _boost_loop(
-                booster, params, fobj, feval, valid_sets,
-                is_valid_contain_train, train_data_name, init_iteration,
-                num_boost_round, cbs_before, cbs_after, chunk,
-                start_iteration=start_iteration, ckpt_writer=ckpt_writer,
-                preempt_watcher=preempt_watcher,
-            )
+            try:
+                evaluation_result_list = _boost_loop(
+                    booster, params, fobj, feval, valid_sets,
+                    is_valid_contain_train, train_data_name, init_iteration,
+                    num_boost_round, cbs_before, cbs_after, chunk,
+                    start_iteration=start_iteration, ckpt_writer=ckpt_writer,
+                    preempt_watcher=latch, flex_watcher=flex_watcher,
+                )
+            except Exception as e:
+                # compose with the collective watchdog instead of racing
+                # it: when flex is armed, a named collective deadline is a
+                # capacity event (a peer is gone) — drain so the
+                # controller reshards onto the survivors
+                detail = (flex_watcher.drain_reason_for(e)
+                          if flex_watcher is not None else None)
+                if detail is None:
+                    raise
+                flex_watcher.note_failure_drain(detail)
+                log.warning(
+                    "flex: %s — draining so the orchestrator reshards "
+                    "onto the survivors (exiting with the reshard code, "
+                    "%d); the last periodic checkpoint is the recovery "
+                    "point" % (detail, preempt_mod.RESHARD_EXIT_CODE)
+                )
+                raise preempt_mod.TrainingDrained(
+                    "training drained after %s" % detail,
+                    checkpoint_path=getattr(ckpt_writer, "path", None),
+                    detail=detail,
+                ) from e
         return _finish_train(
             booster, evaluation_result_list, flight_rec, model_stats
         )
@@ -374,7 +456,7 @@ def _boost_loop(
     booster, params, fobj, feval, valid_sets, is_valid_contain_train,
     train_data_name, init_iteration, num_boost_round, cbs_before, cbs_after,
     chunk: int = 1, start_iteration: Optional[int] = None, ckpt_writer=None,
-    preempt_watcher=None,
+    preempt_watcher=None, flex_watcher=None,
 ):
     """The boosting iteration loop; returns the last evaluation result list.
 
@@ -519,38 +601,61 @@ def _boost_loop(
                     "last good checkpoint is intact"
                     % (type(e).__name__, str(e)[:200])
                 )
+        if flex_watcher is not None:
+            # the flex capacity watcher runs at the same boundary the
+            # latch is honored at, so a plan change seen NOW drains NOW
+            # (single-process; a pod takes one more boundary to reach
+            # marker consensus — flex/watch.py documents the protocol)
+            flex_watcher.check_boundary(i)
         if (preempt_watcher is not None and preempt_watcher.requested()
                 and i < end and not finished):
-            # a latched SIGTERM is honored HERE, at a chunk boundary — the
-            # one place the full training state is checkpointable — but
-            # NOT when this boundary just finished the run (i == end, or
-            # the deferred no-split stop resolved): the trained model is
-            # complete in memory, and exiting 75 would throw it away just
-            # to retrain it on resume. Fault site train.preempt lets the
-            # crash tests SIGKILL between the signal and the emergency
+            # a latched SIGTERM (reason "preempt") or flex drain (reason
+            # "drain") is honored HERE, at a chunk boundary — the one
+            # place the full training state is checkpointable — but NOT
+            # when this boundary just finished the run (i == end, or the
+            # deferred no-split stop resolved): the trained model is
+            # complete in memory, and exiting 75/76 would throw it away
+            # just to retrain it on resume. Fault site train.preempt lets
+            # the crash tests SIGKILL between the signal and the emergency
             # write (the last periodic checkpoint must carry the resume).
+            reason = getattr(preempt_watcher, "reason", "preempt")
+            no_barrier = getattr(preempt_watcher, "no_barrier", False)
             faults.maybe_fire("train.preempt")
             ck_path = None
             if ckpt_writer is not None:
                 from .obs import dist as dist_mod
 
+                multiproc = dist_mod.process_info()[1] > 1
                 if wrote_boundary:
                     # this boundary's periodic checkpoint IS the state an
                     # emergency save would capture — don't publish it twice
                     ck_path = ckpt_writer.path
-                elif dist_mod.process_info()[1] > 1:
+                elif multiproc and reason == "preempt":
                     # multi-process world: the emergency save would run the
                     # coordinated digest barrier, but SIGTERM latch timing
                     # is per-rank — a peer whose signal landed one boundary
                     # later is inside its next collective, and waiting for
                     # it would burn the whole kill grace window. The
                     # periodic BARRIER checkpoints are the pod-coherent
-                    # recovery points; exit on the last one.
+                    # recovery points; exit on the last one. (A planned
+                    # DRAIN is different: the marker protocol latches every
+                    # rank at the same boundary, so its coordinated save
+                    # below CAN barrier.)
                     log.warning(
                         "preempt: multi-process world — skipping the "
                         "emergency checkpoint (per-rank signal timing "
                         "cannot run the coordinated save barrier); the "
                         "last periodic checkpoint is the recovery point"
+                    )
+                elif multiproc and no_barrier:
+                    # dead-rank drain: the digest barrier can never reach
+                    # consensus with a participant gone — survivors exit
+                    # on the last periodic checkpoint
+                    log.warning(
+                        "flex: drain without barrier (%s) — skipping the "
+                        "coordinated emergency checkpoint; the last "
+                        "periodic checkpoint is the recovery point"
+                        % (getattr(preempt_watcher, "detail", "") or reason)
                     )
                 else:
                     try:
@@ -565,6 +670,23 @@ def _boost_loop(
                             "exiting on the last periodic checkpoint"
                             % (type(e).__name__, str(e)[:200])
                         )
+            if reason == "drain":
+                detail = getattr(preempt_watcher, "detail", "") or "drain"
+                if flight_on:
+                    flight_mod.note_event(
+                        "drained", iteration=i - 1, checkpoint=ck_path
+                    )
+                log.warning(
+                    "flex: drain (%s) honored at iteration %d; checkpoint "
+                    "%s; exiting with the reshard code (%d)"
+                    % (detail, i, ck_path or "<none>",
+                       preempt_mod.RESHARD_EXIT_CODE)
+                )
+                raise preempt_mod.TrainingDrained(
+                    "training drained for reshard (%s) at iteration %d"
+                    % (detail, i),
+                    checkpoint_path=ck_path, iteration=i, detail=detail,
+                )
             if flight_on:
                 flight_mod.note_event(
                     "preempted", iteration=i - 1, checkpoint=ck_path

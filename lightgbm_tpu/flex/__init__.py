@@ -1,0 +1,23 @@
+"""flexctl: the elastic fleet orchestrator (docs/FaultTolerance.md §Fleet
+orchestrator).
+
+World size as a runtime variable: a capacity plan (or dead-rank evidence)
+latches a chunk-boundary drain inside the trainer (flex/watch), the run
+checkpoints and exits :data:`RESHARD_EXIT_CODE`, and the supervising
+controller (flex/controller) relaunches onto whatever devices exist now,
+counting ``flex_reshards{from,to,reason}`` and logging the exactness
+class. Inert unless a plan is named (``flex_plan=`` param or
+``LIGHTGBM_TPU_FLEX_PLAN``): the off-path is one env read in
+engine.train — no threads, no latch, no files.
+"""
+from ..resil.preempt import RESHARD_EXIT_CODE, TrainingDrained
+from .capacity import ENV_PLAN, CapacityPlan, PlanStep, dead_ranks, env_plan
+from .controller import FlexController, FlexJournal, FlexStateError
+from .watch import BoundaryWatch, marker_path, maybe_watch, read_marker
+
+__all__ = [
+    "RESHARD_EXIT_CODE", "TrainingDrained",
+    "ENV_PLAN", "CapacityPlan", "PlanStep", "dead_ranks", "env_plan",
+    "FlexController", "FlexJournal", "FlexStateError",
+    "BoundaryWatch", "marker_path", "maybe_watch", "read_marker",
+]

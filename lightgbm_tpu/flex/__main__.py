@@ -1,0 +1,154 @@
+"""flexctl: the elastic fleet orchestrator CLI.
+
+Usage::
+
+    python -m lightgbm_tpu.flex flex_plan=plan.json checkpoint_path=ck.npz \\
+        task=train data=train.tsv tree_learner=data [key=value ...]
+
+Every ``key=value`` token that is not a ``flex_*`` controller knob is
+passed through verbatim to the child trainer (``python -m lightgbm_tpu``),
+plus three managed ones: ``flex_plan`` (so the in-train watcher arms),
+``resume_from=<checkpoint>`` once a checkpoint exists, and — under
+``flex_force_cpu=true`` — a per-launch
+``XLA_FLAGS=--xla_force_host_platform_device_count=<world>`` with
+``JAX_PLATFORMS=cpu``, which is how the chaos smoke gives each relaunch a
+different device count on one CPU host. On real hardware the controller
+sets no backend flags at all: the child builds its mesh from whatever
+devices exist when it starts (SNIPPETS mesh-from-available-devices), and
+this process NEVER imports jax — an orchestrator that initialized the TPU
+client would steal the chips from its own children.
+
+Controller knobs (all optional except ``flex_plan``; documented in
+docs/Parameters.md §flex): ``flex_world`` (initial world; default: the
+plan's top-level ``world``), ``flex_min_world``, ``flex_max_restarts``,
+``flex_backoff_base_s``, ``flex_backoff_max_s``, ``flex_dead_after_s``,
+``flex_force_cpu``, ``flex_seed``, ``flex_max_launches``,
+``flex_journal`` (default ``<checkpoint_path>.flex.journal.json``).
+
+The last stdout line is a JSON summary (launches/reshards/restarts/
+reshard_log) for the bringup driver and the chaos smoke to parse.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from ..utils import log
+from . import capacity as capacity_mod
+from . import watch as watch_mod
+from .controller import FlexController
+
+#: argv keys the controller consumes (everything else goes to the child)
+_CONTROLLER_KEYS = (
+    "flex_world", "flex_min_world", "flex_max_restarts",
+    "flex_backoff_base_s", "flex_backoff_max_s", "flex_dead_after_s",
+    "flex_force_cpu", "flex_seed", "flex_max_launches", "flex_journal",
+)
+
+_DEVCOUNT_RE = re.compile(r"--xla_force_host_platform_device_count=\d+\s*")
+
+
+def _parse(argv: List[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for tok in argv:
+        if "=" not in tok:
+            raise SystemExit("flex: arguments are key=value tokens "
+                             "(got %r)" % tok)
+        k, v = tok.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def child_env(base: Dict[str, str], world: int,
+              force_cpu: bool) -> Dict[str, str]:
+    """The per-launch environment: under forced CPU the device count IS
+    the world knob; otherwise the environment passes through untouched."""
+    env = dict(base)
+    if force_cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = _DEVCOUNT_RE.sub("", env.get("XLA_FLAGS", "")).strip()
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % world
+        ).strip()
+    return env
+
+
+def build_launch(passthrough: Dict[str, str], plan_path: str,
+                 checkpoint_path: str, force_cpu: bool,
+                 env: Optional[Dict[str, str]] = None):
+    """The ``launch(world, attempt)`` callable: one trainer subprocess per
+    launch, resuming from the checkpoint once it exists."""
+    base_env = dict(os.environ if env is None else env)
+
+    def launch(world: int, attempt: int):
+        kv = dict(passthrough)
+        kv.setdefault("task", "train")
+        kv["flex_plan"] = plan_path
+        kv["checkpoint_path"] = checkpoint_path
+        if os.path.exists(checkpoint_path):
+            kv["resume_from"] = checkpoint_path
+        argv = [sys.executable, "-m", "lightgbm_tpu"]
+        argv += ["%s=%s" % (k, v) for k, v in kv.items()]
+        return subprocess.Popen(
+            argv, env=child_env(base_env, world, force_cpu))
+
+    return launch
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    kv = _parse(sys.argv[1:] if argv is None else list(argv))
+    knobs = {k: kv.pop(k) for k in list(kv) if k in _CONTROLLER_KEYS}
+
+    plan_path = kv.get("flex_plan") or capacity_mod.env_plan()
+    if not plan_path:
+        raise SystemExit("flex: flex_plan=<plan.json> is required (or "
+                         "set %s)" % capacity_mod.ENV_PLAN)
+    kv["flex_plan"] = plan_path
+    checkpoint_path = kv.get("checkpoint_path", "")
+    if not checkpoint_path:
+        raise SystemExit("flex: checkpoint_path=... is required — the "
+                         "drain/reshard cycle IS checkpoint/resume")
+
+    plan = capacity_mod.CapacityPlan(plan_path)
+    world = int(knobs.get("flex_world", 0) or 0) or plan.initial_world()
+    if world < 1:
+        raise SystemExit(
+            "flex: no initial world — pass flex_world=N or give the plan "
+            "a top-level \"world\" (the controller never probes jax "
+            "devices itself: on TPU that would claim the chips its "
+            "children need)")
+
+    force_cpu = str(knobs.get("flex_force_cpu", "")).lower() in (
+        "1", "true", "yes")
+    telemetry_dir = os.environ.get("LIGHTGBM_TPU_TELEMETRY") or None
+    ctl = FlexController(
+        build_launch(kv, plan_path, checkpoint_path, force_cpu),
+        plan,
+        knobs.get("flex_journal") or checkpoint_path + ".flex.journal.json",
+        marker=watch_mod.marker_path(checkpoint_path),
+        initial_world=world,
+        min_world=int(knobs.get("flex_min_world", 1) or 1),
+        max_rapid_restarts=int(knobs.get("flex_max_restarts", 5) or 5),
+        backoff_base_s=float(knobs.get("flex_backoff_base_s", 0.5) or 0.5),
+        backoff_max_s=float(knobs.get("flex_backoff_max_s", 30.0) or 30.0),
+        seed=int(knobs["flex_seed"]) if knobs.get("flex_seed") else None,
+        dead_after_s=float(knobs.get("flex_dead_after_s", 60.0) or 60.0),
+        telemetry_dir=telemetry_dir,
+        hb_base=checkpoint_path,
+    )
+    max_launches = int(knobs.get("flex_max_launches", 0) or 0) or None
+    try:
+        rc = ctl.run(max_launches=max_launches)
+    except KeyboardInterrupt:
+        log.warning("flex: interrupted")
+        rc = 130
+    print(json.dumps(dict(ctl.summary(), ok=(rc == 0), rc=rc)))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,324 @@
+"""The supervising half of flexctl: launch, watch the exit code, reshard,
+relaunch (docs/FaultTolerance.md §Fleet orchestrator).
+
+The controller never touches jax — it is pure process supervision over
+the exit-code contract:
+
+  ====  =================================================================
+  rc    meaning / action
+  ====  =================================================================
+  0     training finished; record and stop.
+  75    preempted (resil/preempt): relaunch at the SAME world; the child
+        resumes from its emergency checkpoint.
+  76    drained for reshard (flex/watch posted ``<ckpt>.flex.drain.json``
+        before exiting): relaunch at the marker's world, count
+        ``flex_reshards{from,to,reason}``, log the exactness class.
+  else  crash: consult the liveness evidence (podwatch verdicts when a
+        telemetry dir is known, else checkpoint heartbeats) — dead ranks
+        shrink the relaunch world to the survivors; a plain crash
+        relaunches as-is. Either way the restart is paced by
+        ``resil/backoff.decorrelated`` with a hard cap on consecutive
+        rapid restarts, so neither a crash loop NOR a flapping capacity
+        plan can busy-loop the controller.
+  ====  =================================================================
+
+State lives in a :class:`FlexJournal` — the same atomic-write journal
+machinery as the continuous-training loop (loop/state.StateJournal), so a
+SIGKILLed controller re-enters at the world it last recorded.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..loop.state import JournalError, StateJournal
+from ..obs import registry as obs_registry
+from ..resil import backoff
+from ..resil.preempt import PREEMPT_EXIT_CODE, RESHARD_EXIT_CODE
+from ..utils import log
+from . import capacity as capacity_mod
+from . import watch as watch_mod
+
+
+class FlexStateError(JournalError):
+    """The flex journal's flavor of a structurally unusable journal or an
+    illegal transition."""
+
+
+class FlexJournal(StateJournal):
+    """Where the fleet is: one atomic JSON record per transition."""
+
+    WHAT = "flex"
+    VERSION = 1
+    STATES = ("idle", "running", "resharding", "backoff", "done", "failed")
+    EDGES = {
+        "idle": ("running",),
+        "running": ("resharding", "backoff", "done", "failed"),
+        "resharding": ("running", "failed"),
+        "backoff": ("running", "failed"),
+        # terminal states: a NEW controller run starts a fresh record
+        "done": (),
+        "failed": (),
+    }
+    ERROR = FlexStateError
+
+    @classmethod
+    def fresh_record(cls) -> Dict[str, Any]:
+        rec = super().fresh_record()
+        rec.update({
+            "world": 0,
+            "launches": 0,
+            "restarts": 0,
+            "reshards": 0,
+            "last_exit": None,
+            "last_reason": None,
+            "fail_reason": None,
+            "backoff_s": None,
+            "reshard_log": [],
+        })
+        return rec
+
+
+def _reshard_counter():
+    return obs_registry.REGISTRY.counter(
+        "flex_reshards",
+        "fleet reshards driven by flexctl (world-size changes across a "
+        "drain/relaunch)",
+    )
+
+
+def _restart_counter():
+    return obs_registry.REGISTRY.counter(
+        "flex_restarts", "flexctl child relaunches that were NOT reshards"
+    )
+
+
+class FlexController:
+    """Drives ``launch(world, attempt) -> child`` (anything with
+    ``wait() -> returncode``; subprocess.Popen qualifies) until the run
+    finishes or the flap guard trips. ``sleep``/``clock`` are injectable
+    so the flap-guard tests run in virtual time."""
+
+    def __init__(
+        self,
+        launch: Callable[[int, int], Any],
+        plan: capacity_mod.CapacityPlan,
+        journal_path: str,
+        *,
+        marker: str,
+        initial_world: int,
+        min_world: int = 1,
+        max_rapid_restarts: int = 5,
+        min_healthy_s: float = 5.0,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        seed: Optional[int] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        clock: Callable[[], float] = time.monotonic,
+        telemetry_dir: Optional[str] = None,
+        hb_base: Optional[str] = None,
+        dead_after_s: float = 60.0,
+    ) -> None:
+        self.launch = launch
+        self.plan = plan
+        self.journal_path = journal_path
+        self.marker = marker
+        self.initial_world = int(initial_world)
+        self.min_world = max(1, int(min_world))
+        self.max_rapid_restarts = int(max_rapid_restarts)
+        self.min_healthy_s = float(min_healthy_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.seed = seed
+        self.sleep = sleep
+        self.clock = clock
+        self.telemetry_dir = telemetry_dir
+        self.hb_base = hb_base
+        self.dead_after_s = float(dead_after_s)
+        self.journal: Optional[FlexJournal] = None
+
+    # -- evidence ----------------------------------------------------------
+
+    def _clamp(self, world: int) -> int:
+        return max(self.min_world, int(world))
+
+    def _dead_ranks(self, world: int) -> List[int]:
+        """Ranks the liveness evidence says are gone: podwatch's verdict
+        plane when a telemetry dir is known (its *dead* verdicts carry the
+        heartbeat evidence and map to the drain_survivors action), else
+        the raw checkpoint-side heartbeats."""
+        if self.telemetry_dir:
+            try:
+                from ..obs import podwatch
+
+                summary = podwatch.pod_summary(
+                    self.telemetry_dir, max_age_s=self.dead_after_s
+                )
+                dead = []
+                for act in podwatch.actions_for(summary):
+                    log.warning(
+                        "flex: podwatch verdict %s on rank %s -> action %s"
+                        " (%s)" % (act["verdict"], act["rank"],
+                                   act["action"], act["why"]))
+                    if act["action"] == "drain_survivors":
+                        dead.append(int(act["rank"]))
+                return dead
+            except Exception as e:
+                log.warning("flex: podwatch evidence unavailable (%s: %s)"
+                            % (type(e).__name__, str(e)[:200]))
+        if self.hb_base:
+            try:
+                return [d.rank for d in capacity_mod.dead_ranks(
+                    self.hb_base, world, self.dead_after_s)]
+            except Exception as e:
+                log.warning("flex: heartbeat evidence unavailable (%s: %s)"
+                            % (type(e).__name__, str(e)[:200]))
+        return []
+
+    def _note_reshard(self, from_w: int, to_w: int, reason: str) -> None:
+        _reshard_counter().inc(**{"from": str(from_w), "to": str(to_w),
+                                  "reason": reason})
+        exact = (to_w == from_w)
+        if exact:
+            log.info(
+                "flex: reshard %d -> %d (%s): row world size unchanged — "
+                "the resumed run is byte-identical to an uninterrupted one"
+                % (from_w, to_w, reason)
+            )
+        else:
+            log.warning(
+                "flex: reshard %d -> %d (%s): row world size CHANGED — "
+                "resumed leaf values drift at the ulp level (reduction "
+                "order changes; docs/FaultTolerance.md §Exactness classes)"
+                % (from_w, to_w, reason)
+            )
+        j = self.journal
+        rl = list(j.get("reshard_log") or [])
+        rl.append({"from": from_w, "to": to_w, "reason": reason,
+                   "exact": exact})
+        j.update(reshards=int(j.get("reshards") or 0) + 1,
+                 reshard_log=rl[-32:], last_reason=reason)
+
+    # -- the supervision loop ----------------------------------------------
+
+    def run(self, max_launches: Optional[int] = None) -> int:
+        j = FlexJournal.load(self.journal_path)
+        if j.state in ("done", "failed"):
+            # a finished fleet run is terminal; a re-invoked controller is
+            # a NEW run with a fresh record (the old one was its receipt)
+            j = FlexJournal(self.journal_path)
+        self.journal = j
+        world = self._clamp(int(j.get("world") or 0) or self.initial_world)
+        j.transition("running", world=world)
+        pacer = backoff.decorrelated(self.backoff_base_s, self.backoff_max_s,
+                                     seed=self.seed)
+        rapid = 0
+        launches = int(j.get("launches") or 0)
+        while True:
+            launches += 1
+            j.update(world=world, launches=launches)
+            log.info("flex: launch #%d at world %d" % (launches, world))
+            t0 = self.clock()
+            child = self.launch(world, launches)
+            rc = int(child.wait())
+            lifetime = self.clock() - t0
+            j.update(last_exit=rc)
+
+            if rc == 0:
+                j.transition("done")
+                log.info("flex: training finished (%d launches, %d "
+                         "reshards, %d restarts)"
+                         % (launches, int(j.get("reshards") or 0),
+                            int(j.get("restarts") or 0)))
+                return 0
+
+            if rc == RESHARD_EXIT_CODE:
+                m = watch_mod.read_marker(self.marker) or {}
+                watch_mod.clear_marker(self.marker)
+                reason = str(m.get("reason") or "plan")
+                to_world = int(m.get("world") or 0)
+                if to_world < 1:
+                    # a failure-path drain (collective deadline) posts
+                    # world 0 = "unknown": the survivors ARE the target
+                    dead = self._dead_ranks(world)
+                    to_world = world - len(dead)
+                to_world = self._clamp(to_world or world)
+                j.transition("resharding", last_reason=reason)
+                self._note_reshard(world, to_world, reason)
+                world = to_world
+                j.transition("running", world=world)
+            elif rc == PREEMPT_EXIT_CODE:
+                log.warning("flex: child preempted; relaunching at the "
+                            "same world (%d) to resume" % world)
+                _restart_counter().inc(reason="preempt")
+                j.update(restarts=int(j.get("restarts") or 0) + 1,
+                         last_reason="preempt")
+            else:
+                dead = self._dead_ranks(world)
+                reason = "dead_rank" if dead else "crash"
+                _restart_counter().inc(reason=reason)
+                j.update(restarts=int(j.get("restarts") or 0) + 1,
+                         last_reason=reason)
+                if dead:
+                    to_world = self._clamp(world - len(dead))
+                    log.warning(
+                        "flex: child exited %d with dead rank(s) %s — "
+                        "resharding onto the %d survivor(s)"
+                        % (rc, dead, to_world))
+                    if to_world != world:
+                        self._note_reshard(world, to_world, "dead_rank")
+                        world = to_world
+                else:
+                    log.warning("flex: child exited %d (crash); "
+                                "relaunching at world %d" % (rc, world))
+
+            # flap guard: EVERY relaunch — reshard, preempt or crash —
+            # counts against the rapid-restart budget when the child died
+            # young, so a flapping plan (grow/shrink at every boundary)
+            # backs off exactly like a crash loop and then stops
+            if lifetime < self.min_healthy_s:
+                rapid += 1
+                if rapid > self.max_rapid_restarts:
+                    j.transition(
+                        "failed",
+                        fail_reason="flapping: %d consecutive restarts "
+                        "under %.1fs" % (rapid, self.min_healthy_s))
+                    log.warning(
+                        "flex: %d consecutive children died within %.1fs "
+                        "(last rc %d) — a flapping plan or a crash loop; "
+                        "refusing to relaunch. Fix the plan/cluster and "
+                        "re-run." % (rapid, self.min_healthy_s, rc))
+                    return 1
+                d = next(pacer)
+                j.transition("backoff", backoff_s=round(d, 3))
+                log.info("flex: rapid exit #%d (%.2fs < %.1fs); backing "
+                         "off %.2fs" % (rapid, lifetime,
+                                        self.min_healthy_s, d))
+                self.sleep(d)
+                j.transition("running")
+            else:
+                rapid = 0
+                pacer = backoff.decorrelated(
+                    self.backoff_base_s, self.backoff_max_s, seed=self.seed)
+
+            if max_launches is not None and launches >= max_launches:
+                j.transition("failed",
+                             fail_reason="launch budget (%d) exhausted"
+                             % max_launches)
+                log.warning("flex: launch budget (%d) exhausted without a "
+                          "clean finish (last rc %d)" % (max_launches, rc))
+                return 1
+
+    def summary(self) -> Dict[str, Any]:
+        j = self.journal
+        if j is None:
+            return {}
+        return {
+            "state": j.state,
+            "world": j.get("world"),
+            "launches": j.get("launches"),
+            "restarts": j.get("restarts"),
+            "reshards": j.get("reshards"),
+            "reshard_log": j.get("reshard_log"),
+            "last_exit": j.get("last_exit"),
+        }

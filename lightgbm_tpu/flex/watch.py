@@ -1,0 +1,222 @@
+"""The in-train half of flexctl: a chunk-boundary capacity watcher.
+
+Threadless by design — the boost loop calls :meth:`BoundaryWatch.
+check_boundary` at each chunk boundary (the only place the full training
+state is checkpointable, so also the only place a capacity decision can
+be acted on), and the watcher latches the SAME reason-carrying
+:class:`~lightgbm_tpu.resil.preempt.BoundaryLatch` that SIGTERM
+preemption uses. The existing latch-honor block in engine._boost_loop
+then does the rest: checkpoint, raise, exit
+:data:`~lightgbm_tpu.resil.preempt.RESHARD_EXIT_CODE`.
+
+Drain consensus on a multi-process pod uses a two-phase marker protocol:
+the first rank to see a plan change at boundary ``I`` atomically posts
+``<ckpt>.flex.drain.json`` with ``drain_after = I``; every rank — the
+poster included — latches at its first boundary with ``iteration > I``.
+Ranks advance in lockstep through the training collectives, so a peer
+cannot complete the chunk past ``I`` before the poster (who posted
+BEFORE entering it) — by the time any rank latches, every rank either
+has latched or will at this same boundary, and the coordinated emergency
+save's digest barrier has all its participants. A DEAD-rank drain skips
+that barrier (``no_barrier``): the barrier could never complete, so the
+survivors exit on the last periodic checkpoint instead.
+
+The marker file outlives the exit on purpose: it is how the relaunching
+controller learns the target world and reason without re-deriving them.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+from ..obs import registry as obs_registry
+from ..resil.atomic import atomic_write_text
+from ..utils import log
+from . import capacity as capacity_mod
+
+#: boundaries between dead-rank sweeps — a sweep stats ``procs`` files, so
+#: a little throttling keeps the boundary cost flat on wide pods
+DEAD_CHECK_EVERY = 4
+
+
+def marker_path(checkpoint_path: str) -> str:
+    return "%s.flex.drain.json" % checkpoint_path
+
+
+def read_marker(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            body = json.load(fh)
+        return body if isinstance(body, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def clear_marker(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def _drain_counter():
+    return obs_registry.REGISTRY.counter(
+        "flex_drains", "boundary drains latched by the flex watcher"
+    )
+
+
+class BoundaryWatch:
+    """Watches the capacity plan (and, on a pod, rank liveness) from
+    inside the boost loop. Holds no thread, no socket, no timer — its
+    whole existence is ``check_boundary`` calls."""
+
+    def __init__(self, latch, plan: capacity_mod.CapacityPlan,
+                 live_world: int, *, marker: str, procs: int = 1,
+                 rank: int = 0, hb_base: Optional[str] = None,
+                 dead_after_s: float = 60.0) -> None:
+        self.latch = latch
+        self.plan = plan
+        self.live_world = int(live_world)
+        self.marker = marker
+        self.procs = int(procs)
+        self.rank = int(rank)
+        self.hb_base = hb_base
+        self.dead_after_s = float(dead_after_s)
+        self._drain_after: Optional[int] = None
+        self._pending: Optional[capacity_mod.PlanStep] = None
+        self._boundaries = 0
+
+    # -- the drain initiations --------------------------------------------
+
+    def _post_marker(self, iteration: int, world: int, reason: str) -> None:
+        atomic_write_text(
+            self.marker,
+            json.dumps({
+                "drain_after": int(iteration),
+                "world": int(world),
+                "from_world": self.live_world,
+                "reason": reason,
+                "posted_by": self.rank,
+                "time": time.time(),
+            }),
+            fsync=False,
+        )
+
+    def _request(self, reason: str, detail: str,
+                 no_barrier: bool = False) -> None:
+        if self.latch.request("drain", detail=detail,
+                              no_barrier=no_barrier):
+            _drain_counter().inc(reason=reason)
+
+    # -- the per-boundary hook --------------------------------------------
+
+    def check_boundary(self, iteration: int) -> None:
+        """Called by engine._boost_loop at every chunk boundary with the
+        last COMPLETED iteration; may latch a drain. Never raises — a
+        capacity-plane failure must degrade to 'keep training'."""
+        self._boundaries += 1
+        if self.latch.requested():
+            return
+        try:
+            self._check(int(iteration))
+        except Exception as e:  # plan IO, heartbeat IO: never fail training
+            log.warn_once(
+                "flex-watch-error",
+                "flex: boundary check failed (%s: %s); capacity watching "
+                "degraded" % (type(e).__name__, str(e)[:200]),
+            )
+
+    def _check(self, iteration: int) -> None:
+        # phase 2: honor a posted drain marker (ours or a peer's) at the
+        # first boundary past its drain_after
+        if self._drain_after is None and self.procs > 1:
+            m = read_marker(self.marker)
+            if m is not None:
+                try:
+                    self._drain_after = int(m.get("drain_after", 0))
+                    self._pending = capacity_mod.PlanStep(
+                        int(m.get("world", 0)),
+                        str(m.get("reason", "plan")),
+                        self._drain_after,
+                    )
+                except (TypeError, ValueError):
+                    self._drain_after = None
+        if self._drain_after is not None:
+            if iteration > self._drain_after and self._pending is not None:
+                step = self._pending
+                self._request(step.reason,
+                              "%s: world %d -> %d (drain posted at "
+                              "iteration %d)" % (step.reason,
+                                                 self.live_world, step.world,
+                                                 self._drain_after))
+            return
+
+        # dead-rank degradation (pods only): a rank that heartbeat and
+        # went silent past the deadline drains the SURVIVORS — no barrier,
+        # the last periodic checkpoint is the recovery point
+        if (self.hb_base and self.procs > 1
+                and self._boundaries % DEAD_CHECK_EVERY == 0):
+            dead = [d for d in capacity_mod.dead_ranks(
+                        self.hb_base, self.procs, self.dead_after_s)
+                    if d.rank != self.rank]
+            if dead:
+                names = ",".join("%d" % d.rank for d in dead)
+                log.warning(
+                    "flex: rank(s) %s dead (heartbeat age %s > %.0fs); "
+                    "draining survivors to reshard without them"
+                    % (names,
+                       ["%.1fs" % d.age for d in dead], self.dead_after_s)
+                )
+                self._post_marker(iteration, self.procs - len(dead),
+                                  "dead_rank")
+                self._request("dead_rank", "dead_rank: ranks %s" % names,
+                              no_barrier=True)
+                return
+
+        # phase 1: a plan change initiates the drain
+        step = self.plan.desired(iteration, self.live_world)
+        if step is not None:
+            self._post_marker(iteration, step.world, step.reason)
+            if self.procs <= 1:
+                self._request(step.reason,
+                              "%s: world %d -> %d" % (step.reason,
+                                                      self.live_world,
+                                                      step.world))
+            else:
+                self._drain_after = iteration
+                self._pending = step
+
+    # -- failure composition ----------------------------------------------
+
+    def note_failure_drain(self, detail: str) -> None:
+        """Post the drain marker for a failure-path drain (collective
+        deadline): ``world 0`` tells the controller "target unknown —
+        consult the liveness evidence before relaunching"."""
+        self._post_marker(-1, 0, "collective_deadline")
+        _drain_counter().inc(reason="collective_deadline")
+
+    def drain_reason_for(self, exc: BaseException) -> Optional[str]:
+        """When flex is armed, a collective-watchdog deadline is a
+        capacity event, not a crash: the controller should reshard onto
+        the survivors. Returns the drain detail, or None for exceptions
+        flex does not claim (engine re-raises those untouched)."""
+        from ..resil import watchdog
+
+        if isinstance(exc, watchdog.CollectiveDeadlineError):
+            return "collective_deadline: %s" % (exc,)
+        return None
+
+
+def maybe_watch(plan_path: str, latch, *, checkpoint_path: str,
+                live_world: int, procs: int = 1, rank: int = 0,
+                hb_base: Optional[str] = None,
+                dead_after_s: float = 60.0) -> BoundaryWatch:
+    """engine.train's armed-path factory (the OFF gate — param unset, env
+    unset — lives in engine itself and never reaches this module)."""
+    return BoundaryWatch(
+        latch, capacity_mod.CapacityPlan(plan_path), live_world,
+        marker=marker_path(checkpoint_path), procs=procs, rank=rank,
+        hb_base=hb_base, dead_after_s=dead_after_s,
+    )

@@ -141,9 +141,9 @@ def main(argv=None) -> int:
         log.warning(
             "loop: retrain preempted (%s); checkpoint %s — re-run this "
             "command to resume; exiting %d"
-            % (e, e.checkpoint_path or "<none>", PREEMPT_EXIT_CODE)
+            % (e, e.checkpoint_path or "<none>", e.exit_code)
         )
-        return PREEMPT_EXIT_CODE
+        return e.exit_code
     return 0
 
 

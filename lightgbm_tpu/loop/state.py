@@ -1,4 +1,5 @@
-"""The continuous-training loop's journaled state machine.
+"""Journaled state machines: the shared base and the continuous-training
+loop's concrete machine.
 
 One cycle of the closed loop walks
 
@@ -16,7 +17,13 @@ controller's whole persistent state is the one file, and the atomic writer
 guarantees a reader sees either the old record or the new one, never a torn
 mix (docs/ContinuousTraining.md documents the format field by field).
 
-States:
+:class:`StateJournal` is the machinery with the loop specifics factored
+out — states, edges, fresh record and error class are class attributes —
+so the fleet orchestrator's journal (``lightgbm_tpu/flex/controller.py``)
+rides the same tested atomic-write/load/transition code instead of
+reimplementing it.
+
+Loop states:
 
   ``observe``   watching the drift signal; the only state a cycle starts or
                 ends in. ``last_outcome`` carries the previous cycle's
@@ -66,10 +73,15 @@ _EDGES = {
 }
 
 
-class LoopStateError(LightGBMError):
+class JournalError(LightGBMError):
     """An illegal transition or a structurally unusable journal — a
     controller bug or operator error, never a crash artifact (crash
     artifacts are impossible by the atomic-write construction)."""
+
+
+class LoopStateError(JournalError):
+    """The loop journal's flavor of :class:`JournalError` (kept as a
+    distinct class: PR 11 callers and tests catch it by name)."""
 
 
 def _fresh_record() -> Dict[str, Any]:
@@ -105,47 +117,70 @@ _CYCLE_FIELDS = (
 )
 
 
-class LoopJournal:
-    """The one durable record of where the loop is; every mutation is an
+class StateJournal:
+    """A single-JSON-object durable state machine; every mutation is an
     atomic file replace. Not thread-safe by design — one controller owns
     one journal (two controllers on one journal is an operator error the
-    seq counter makes visible, not a supported deployment)."""
+    seq counter makes visible, not a supported deployment).
+
+    Subclasses declare ``WHAT`` (the name used in error messages),
+    ``VERSION``, ``STATES``, ``EDGES``, ``ERROR`` (the exception class to
+    raise) and ``fresh_record`` (which must include ``version``, ``seq``,
+    ``state`` and ``updated_at``); :meth:`_on_transition` hooks
+    machine-specific edge bookkeeping.
+    """
+
+    WHAT = "state"
+    VERSION = 1
+    STATES: tuple = ()
+    EDGES: Dict[str, tuple] = {}
+    ERROR = JournalError
 
     def __init__(self, path: str, record: Optional[Dict[str, Any]] = None):
         self.path = path
-        self.rec = record if record is not None else _fresh_record()
+        self.rec = record if record is not None else self.fresh_record()
+
+    @classmethod
+    def fresh_record(cls) -> Dict[str, Any]:
+        return {
+            "version": cls.VERSION,
+            "seq": 0,
+            "state": cls.STATES[0],
+            "updated_at": "",
+        }
 
     # -- IO ----------------------------------------------------------------
 
     @classmethod
-    def load(cls, path: str) -> "LoopJournal":
+    def load(cls, path: str) -> "StateJournal":
         """Read the journal back, or start fresh when none exists. A file
         that exists but does not parse is NOT silently reset: the atomic
         writer cannot produce one, so it means operator damage — refusing
-        loudly beats re-entering the loop at the wrong step."""
+        loudly beats re-entering the machine at the wrong step."""
         try:
             with open(path, encoding="utf-8") as fh:
                 body = json.load(fh)
         except OSError:
             return cls(path)
         except ValueError as e:
-            raise LoopStateError(
-                "loop journal %r is not valid JSON (%s); the atomic writer "
-                "cannot have produced this — refusing to guess the loop "
-                "state. Repair or remove the file explicitly." % (path, e)
+            raise cls.ERROR(
+                "%s journal %r is not valid JSON (%s); the atomic writer "
+                "cannot have produced this — refusing to guess the %s "
+                "state. Repair or remove the file explicitly."
+                % (cls.WHAT, path, e, cls.WHAT)
             )
-        if not isinstance(body, dict) or body.get("version") != JOURNAL_VERSION:
-            raise LoopStateError(
-                "loop journal %r has version %r (supported: %d)"
-                % (path, body.get("version") if isinstance(body, dict)
-                   else None, JOURNAL_VERSION)
+        if not isinstance(body, dict) or body.get("version") != cls.VERSION:
+            raise cls.ERROR(
+                "%s journal %r has version %r (supported: %d)"
+                % (cls.WHAT, path, body.get("version") if isinstance(body, dict)
+                   else None, cls.VERSION)
             )
-        if body.get("state") not in STATES:
-            raise LoopStateError(
-                "loop journal %r records unknown state %r"
-                % (path, body.get("state"))
+        if body.get("state") not in cls.STATES:
+            raise cls.ERROR(
+                "%s journal %r records unknown state %r"
+                % (cls.WHAT, path, body.get("state"))
             )
-        rec = _fresh_record()
+        rec = cls.fresh_record()
         rec.update(body)
         return cls(path, rec)
 
@@ -165,14 +200,17 @@ class LoopJournal:
     def state(self) -> str:
         return str(self.rec["state"])
 
-    @property
-    def cycle(self) -> int:
-        return int(self.rec["cycle"])
-
     def get(self, key: str, default: Any = None) -> Any:
         return self.rec.get(key, default)
 
     # -- transitions -------------------------------------------------------
+
+    def _illegal(self, cur: str, state: str) -> str:
+        return "illegal %s transition %s -> %s" % (self.WHAT, cur, state)
+
+    def _on_transition(self, cur: str, state: str) -> None:
+        """Machine-specific bookkeeping for a legal edge, applied to
+        ``self.rec`` BEFORE the state/fields fold (same atomic write)."""
 
     def transition(self, state: str, **fields: Any) -> None:
         """Move to ``state``, folding ``fields`` into the record, in ONE
@@ -180,22 +218,12 @@ class LoopJournal:
         journal itself into an unreachable position). Re-entering the
         CURRENT state is always legal — that is exactly what a restarted
         controller does."""
-        if state not in STATES:
-            raise LoopStateError("unknown loop state %r" % (state,))
+        if state not in self.STATES:
+            raise self.ERROR("unknown %s state %r" % (self.WHAT, state))
         cur = self.state
-        if state != cur and state not in _EDGES[cur]:
-            raise LoopStateError(
-                "illegal loop transition %s -> %s (cycle %d)"
-                % (cur, state, self.cycle)
-            )
-        if cur == "observe" and state == "retrain":
-            # a new cycle begins: bump the counter and clear the previous
-            # cycle's candidate bookkeeping (previous_* survives — it keeps
-            # naming the last published-and-kept version until the next
-            # publish overwrites it)
-            self.rec["cycle"] = self.cycle + 1
-            for k in _CYCLE_FIELDS:
-                self.rec[k] = None
+        if state != cur and state not in self.EDGES[cur]:
+            raise self.ERROR(self._illegal(cur, state))
+        self._on_transition(cur, state)
         self.rec["state"] = state
         self.rec.update(fields)
         self._write()
@@ -206,6 +234,38 @@ class LoopJournal:
         validate edge."""
         self.rec.update(fields)
         self._write()
+
+
+class LoopJournal(StateJournal):
+    """The one durable record of where the loop is (see module doc)."""
+
+    WHAT = "loop"
+    VERSION = JOURNAL_VERSION
+    STATES = STATES
+    EDGES = _EDGES
+    ERROR = LoopStateError
+
+    @classmethod
+    def fresh_record(cls) -> Dict[str, Any]:
+        return _fresh_record()
+
+    @property
+    def cycle(self) -> int:
+        return int(self.rec["cycle"])
+
+    def _illegal(self, cur: str, state: str) -> str:
+        return "illegal loop transition %s -> %s (cycle %d)" % (
+            cur, state, self.cycle)
+
+    def _on_transition(self, cur: str, state: str) -> None:
+        if cur == "observe" and state == "retrain":
+            # a new cycle begins: bump the counter and clear the previous
+            # cycle's candidate bookkeeping (previous_* survives — it keeps
+            # naming the last published-and-kept version until the next
+            # publish overwrites it)
+            self.rec["cycle"] = self.cycle + 1
+            for k in _CYCLE_FIELDS:
+                self.rec[k] = None
 
     def finish_cycle(self, outcome: str) -> None:
         """Terminal arrow of a cycle: record the outcome, return to

@@ -668,11 +668,49 @@ def compute_verdicts(
             "verdict": "dead",
             "rank": r,
             "why": why,
+            # age_source: which clock judged the age (coord.heartbeat_age —
+            # "wall" from the blob's time stamp, "mtime" when a foreign/
+            # legacy writer omitted it; never the per-process mono clock)
             "evidence": {"age_s": None if age is None else round(age, 3),
                          "threshold_s": DEAD_MAX_AGE_S,
+                         "age_source": evidence.get("age_source"),
                          "heartbeat": evidence},
         })
     return verdicts
+
+
+#: flexctl's decision table (docs/FaultTolerance.md §Fleet orchestrator):
+#: what an orchestrator should DO about each verdict kind. Only *dead*
+#: triggers an automatic reshard — and only when its evidence shows a rank
+#: that heartbeat and then went silent (``age_s`` present); a missing
+#: heartbeat file is startup-ambiguous and stays advisory. straggler/stall
+#: are performance findings (the run is correct, just slow) and *skew* on
+#: a healthy pod means the collectives are already keeping ranks honest.
+VERDICT_ACTIONS = {
+    "dead": "drain_survivors",
+    "stall": "watch",
+    "straggler": "watch",
+    "skew": "watch",
+}
+
+
+def actions_for(summary: Dict) -> List[Dict]:
+    """The verdict→action plumbing flexctl consumes: one record per
+    verdict with the action from :data:`VERDICT_ACTIONS` (*dead* without
+    age evidence is demoted to ``watch``, see the table's doc)."""
+    out: List[Dict] = []
+    for v in summary.get("verdicts") or []:
+        action = VERDICT_ACTIONS.get(v.get("verdict"), "watch")
+        if (v.get("verdict") == "dead"
+                and (v.get("evidence") or {}).get("age_s") is None):
+            action = "watch"
+        out.append({
+            "rank": v.get("rank"),
+            "verdict": v.get("verdict"),
+            "action": action,
+            "why": v.get("why", ""),
+        })
+    return out
 
 
 def pod_summary(out_dir: str, now: Optional[float] = None,

@@ -56,3 +56,30 @@ def delays(
             return
         elapsed += d
         yield d
+
+
+def decorrelated(
+    base_s: float = 1.0,
+    max_s: float = 60.0,
+    seed: Optional[int] = None,
+) -> Iterator[float]:
+    """Decorrelated-jitter schedule (the AWS architecture-blog variant):
+    ``sleep_n = min(max_s, uniform(base_s, 3 * sleep_{n-1}))``.
+
+    Unlike ``delays``, this generator is UNBOUNDED — it is the restart
+    pacer for supervisors that run indefinitely (flexctl's relaunch loop),
+    which impose their own hard caps on *consecutive rapid* restarts
+    rather than on total attempts. Decorrelation matters there more than
+    in a finite retry loop: a whole fleet of controllers restarted by the
+    same capacity event must not re-converge onto synchronized retry
+    waves, and plain jittered exponential backoff re-correlates at the
+    ``max_s`` ceiling. Every value is in ``[base_s, max_s]``; ``seed``
+    makes the stream reproducible for the flap-guard tests."""
+    if base_s <= 0:
+        raise ValueError("decorrelated: base_s must be > 0 (got %r)"
+                         % (base_s,))
+    rng = random.Random(seed)
+    prev = base_s
+    while True:
+        prev = min(max_s, rng.uniform(base_s, 3.0 * prev))
+        yield prev

@@ -427,6 +427,13 @@ def _mesh_world(desc: Optional[Dict]) -> int:
     return size
 
 
+def mesh_world_of(gbdt) -> int:
+    """Row world size of the LIVE training mesh (1 for serial) — the
+    flexctl watcher's "current world" input, and the quantity the
+    exactness taxonomy keys on."""
+    return _mesh_world(_mesh_desc(gbdt))
+
+
 def check_reshard(ck_mesh: Optional[Dict], live_mesh: Optional[Dict]) -> bool:
     """Classify a checkpoint-vs-live mesh change; returns True when the
     resumed run stays byte-identical to the original.

@@ -325,21 +325,52 @@ class RankStaleness(tuple):
         return self[1]
 
 
+def heartbeat_age(hb_file: str, blob: Dict, now: float
+                  ) -> Tuple[Optional[float], str]:
+    """Cross-host-comparable heartbeat age: ``(age_s, source)``.
+
+    The wall-clock ``time`` stamp is the primary evidence (``source``
+    ``"wall"``); a blob that lacks it — a foreign or pre-PR-14 writer —
+    falls back to the heartbeat FILE's mtime (``"mtime"``), which the
+    filesystem stamped on the same host that judges it on single-host
+    pods and is NTP-comparable otherwise. ``mono`` is deliberately NEVER
+    used here: CLOCK_MONOTONIC is per-process (its epoch is the writer's
+    boot/start), so a cross-rank ``now - mono`` difference is
+    meaningless. ``(None, "missing")`` when neither source exists."""
+    try:
+        t = float(blob.get("time", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        t = 0.0
+    if t > 0.0:
+        return now - t, "wall"
+    try:
+        return now - os.stat(hb_file).st_mtime, "mtime"
+    except OSError:
+        return None, "missing"
+
+
 def stale_ranks(path: str, world: int, max_age_s: float,
                 now: Optional[float] = None) -> List[Tuple[int, Optional[float]]]:
     """Ranks whose heartbeat is older than ``max_age_s`` (age) or missing
     entirely (None) — the dead-rank shortlist a hung-collective warning
     points operators at. Entries are :class:`RankStaleness` — tuple-equal
-    to the historical ``(rank, age)`` shape, with ``.evidence`` on top."""
+    to the historical ``(rank, age)`` shape, with ``.evidence`` on top
+    (including ``age_source``: which clock judged the age, see
+    :func:`heartbeat_age`)."""
     now = time.time() if now is None else now
     out: List[Tuple[int, Optional[float]]] = []
     for r in range(world):
+        hb_file = heartbeat_path(path, r)
         try:
-            with open(heartbeat_path(path, r), encoding="utf-8") as fh:
+            with open(hb_file, encoding="utf-8") as fh:
                 blob = json.load(fh)
-            age = now - float(blob.get("time", 0.0))
-            if age > max_age_s:
-                out.append(RankStaleness(r, age, blob))
+            age, source = heartbeat_age(hb_file, blob, now)
+            if age is None:  # file vanished between open and stat
+                out.append(RankStaleness(r, None))
+            elif age > max_age_s:
+                ev = dict(blob)
+                ev["age_source"] = source
+                out.append(RankStaleness(r, age, ev))
         except (OSError, ValueError):
             out.append(RankStaleness(r, None))
     return out

@@ -1,4 +1,5 @@
-"""Preemption-aware training: SIGTERM -> emergency checkpoint -> exit 75.
+"""Boundary-latched exits: SIGTERM -> emergency checkpoint -> exit 75,
+and flexctl's planned drain -> coordinated checkpoint -> exit 76.
 
 TPU pods are preemptible: the scheduler sends SIGTERM, waits a grace
 window, then SIGKILLs. The serve stack already honors that contract with a
@@ -16,8 +17,16 @@ as "resume me", NOT "I failed": the re-run resumes from the emergency
 checkpoint instead of restarting the stage from scratch
 (docs/FaultTolerance.md §Elastic training).
 
+The fleet orchestrator (``lightgbm_tpu/flex/``) shares the same
+chunk-boundary mechanism through the :class:`BoundaryLatch` base: a
+planned capacity change latches ``reason="drain"`` instead of a signal,
+the boost loop takes the same checkpoint at the same boundary, and the
+process exits :data:`RESHARD_EXIT_CODE` — "relaunch me at the current
+capacity", distinct from 75's "resume me as I was"
+(docs/FaultTolerance.md §Fleet orchestrator).
+
 This module is deliberately jax-free: the bringup driver imports it by
-FILE path for the exit-code constant, exactly like resil/backoff.py.
+FILE path for the exit-code constants, exactly like resil/backoff.py.
 """
 from __future__ import annotations
 
@@ -32,7 +41,19 @@ from typing import Optional
 #: (success), 1 (real failure) and -signal codes (crash).
 PREEMPT_EXIT_CODE = 75
 
+#: The documented drain-for-reshard exit code: the trainer checkpointed at
+#: a chunk boundary because the WORLD is about to change (planned capacity
+#: event or dead-rank degradation) and must be RELAUNCHED at the current
+#: capacity — unlike 75, a plain same-world resume is the wrong response.
+#: 76 is EX_PROTOCOL in sysexits.h, the nearest free neighbor of 75;
+#: nothing else in the stack claims it.
+RESHARD_EXIT_CODE = 76
+
 ENV_PREEMPT = "LIGHTGBM_TPU_PREEMPT"
+
+#: the reasons a boundary latch carries; "preempt" keeps the exact exit-75
+#: semantics, "drain" is flexctl's planned/forced world change (exit 76)
+REASONS = ("preempt", "drain")
 
 
 def env_enabled() -> bool:
@@ -42,13 +63,16 @@ def env_enabled() -> bool:
 
 
 class TrainingPreempted(Exception):
-    """Raised out of engine.train when a preemption signal was honored.
+    """Raised out of engine.train when a boundary latch was honored.
 
     Deliberately NOT a LightGBMError: config-error handlers (e.g. the loop
     controller's bad-checkpoint fallback) must never swallow a preemption
     and retrain from scratch — the whole point is that the emergency
     checkpoint carries the run.
     """
+
+    #: which latch reason produced this exit; subclasses override
+    reason = "preempt"
 
     def __init__(self, message: str, checkpoint_path: Optional[str] = None,
                  iteration: int = -1, signum: int = 0) -> None:
@@ -57,8 +81,74 @@ class TrainingPreempted(Exception):
         self.iteration = int(iteration)
         self.signum = int(signum)
 
+    @property
+    def exit_code(self) -> int:
+        """The process exit code this latch reason maps to (75 / 76)."""
+        return RESHARD_EXIT_CODE if self.reason == "drain" \
+            else PREEMPT_EXIT_CODE
 
-class PreemptionWatcher:
+
+class TrainingDrained(TrainingPreempted):
+    """The drain flavor: the run checkpointed and exited because the world
+    is about to change; the orchestrator relaunches at current capacity
+    (exit :data:`RESHARD_EXIT_CODE`). Subclassing TrainingPreempted keeps
+    every existing "preemption is not a failure" handler correct — a drain
+    is never a failure either — while ``reason``/``exit_code`` let entry
+    points tell the two relaunch contracts apart."""
+
+    reason = "drain"
+
+    def __init__(self, message: str, checkpoint_path: Optional[str] = None,
+                 iteration: int = -1, signum: int = 0,
+                 detail: str = "") -> None:
+        super().__init__(message, checkpoint_path=checkpoint_path,
+                         iteration=iteration, signum=signum)
+        self.detail = str(detail)
+
+
+class BoundaryLatch:
+    """A reason-carrying flag the boost loop honors at the next chunk
+    boundary — the one mechanism behind both preemption (SIGTERM sets it
+    from a signal frame) and flexctl's drain (the capacity watcher sets it
+    from the boundary itself).
+
+    ``request`` is async-signal-safe by construction (attribute stores and
+    ``Event.set`` only; no I/O, no locks, no device calls) so the signal
+    subclass can route through it. First request wins, with one exception:
+    a later *preempt* upgrades a pending *drain* — the scheduler's kill
+    grace window is real and the drain's coordinated save may not fit in
+    it, so the exit must carry the preempt contract (75, no barrier).
+    """
+
+    def __init__(self) -> None:
+        self._event = threading.Event()
+        self.signum = 0
+        self.reason = "preempt"
+        self.detail = ""
+        #: set for dead-rank drains: the coordinated save barrier cannot
+        #: complete (a participant is gone), so the boundary skips it and
+        #: exits on the last periodic checkpoint
+        self.no_barrier = False
+
+    def request(self, reason: str = "drain", detail: str = "",
+                signum: int = 0, no_barrier: bool = False) -> bool:
+        """Latch; returns True when this call took effect (first request
+        wins; a preempt may upgrade a pending drain, see class doc)."""
+        if self._event.is_set() and not (
+                reason == "preempt" and self.reason != "preempt"):
+            return False
+        self.reason = reason if reason in REASONS else "drain"
+        self.detail = detail
+        self.signum = int(signum)
+        self.no_barrier = bool(no_barrier)
+        self._event.set()
+        return True
+
+    def requested(self) -> bool:
+        return self._event.is_set()
+
+
+class PreemptionWatcher(BoundaryLatch):
     """Latches a SIGTERM until the boost loop reaches a safe boundary.
 
     The handler itself does nothing but record the signal (async-signal
@@ -72,15 +162,13 @@ class PreemptionWatcher:
     """
 
     def __init__(self, signals=(signal.SIGTERM,)) -> None:
+        super().__init__()
         self.signals = tuple(signals)
-        self._event = threading.Event()
-        self.signum = 0
         self._previous = {}
         self.installed = False
 
     def _handler(self, signum, frame) -> None:
-        self.signum = int(signum)
-        self._event.set()
+        self.request("preempt", signum=int(signum))
 
     def install(self) -> bool:
         if threading.current_thread() is not threading.main_thread():
@@ -108,9 +196,6 @@ class PreemptionWatcher:
                 pass
         self._previous.clear()
         self.installed = False
-
-    def requested(self) -> bool:
-        return self._event.is_set()
 
     def __enter__(self) -> "PreemptionWatcher":
         self.install()

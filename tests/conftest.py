@@ -59,7 +59,8 @@ _QUICK_MODULES = {
     "test_api_surface", "test_bench_adopt", "test_binning",
     "test_binning_equiv", "test_bringup_stages", "test_device_chunk",
     "test_devprof", "test_dist_obs", "test_elastic",
-    "test_errors", "test_feature_importance", "test_graftlint",
+    "test_errors", "test_feature_importance", "test_flex",
+    "test_graftlint",
     "test_hist_modes", "test_irscan", "test_loop", "test_metric_alias",
     "test_micro_exact", "test_model_io", "test_model_obs", "test_native",
     "test_obs",

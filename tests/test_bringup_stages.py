@@ -29,7 +29,7 @@ def test_stage_table_complete():
         "irscan", "bench_early", "smoke_pallas", "smoke_xla_radix",
         "smoke_bf16", "smoke_psplit", "bench_chunk", "bench_multichip",
         "bench_predict", "prof", "devprof", "san", "loop", "elastic",
-        "podwatch", "bench",
+        "podwatch", "flex", "bench",
     }
 
 
@@ -291,6 +291,28 @@ def test_run_podwatch_invokes_smoke_by_file_path(monkeypatch):
     assert r["ok"] and seen["stage"] == "podwatch"
     assert seen["argv"][-1].endswith(
         _os.path.join("helpers", "podwatch_smoke.py"))
+
+
+def test_run_flex_invokes_smoke_by_file_path(monkeypatch):
+    """The flex stage (ISSUE 20) executes helpers/flex_smoke.py by FILE
+    path in a child — the parent driver stays jax-free; the smoke's
+    controller is itself jax-free and only its trainer children build
+    meshes (an orchestrator that imported jax would claim the chips its
+    children need)."""
+    import os as _os
+
+    seen = {}
+
+    def fake_run_child(stage, argv, env=None):
+        seen["stage"] = stage
+        seen["argv"] = argv
+        return {"ok": True}
+
+    monkeypatch.setattr(tb, "_run_child", fake_run_child)
+    r = tb.run_flex()
+    assert r["ok"] and seen["stage"] == "flex"
+    assert seen["argv"][-1].endswith(
+        _os.path.join("helpers", "flex_smoke.py"))
 
 
 def test_run_devprof_invokes_smoke_by_file_path(monkeypatch):

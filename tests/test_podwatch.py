@@ -462,3 +462,78 @@ def test_bench_diff_podwatch_spread_growth_warns_stable_passes():
     # no podwatch block at all: no rows, no noise
     rows, _ = bench_diff.compare(_bench_rec(), _bench_rec())
     assert not [r for r in rows if r["metric"].startswith("podwatch")]
+
+
+# ---------------------------------------------------------------------------
+# the verdict→action plane flexctl consumes (ISSUE 20): heartbeat ages must
+# be judged by a cross-host-comparable clock, and dead verdicts map to
+# drain_survivors only when the age evidence is real
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_age_mtime_fallback_and_age_source(tmp_path):
+    """A blob without a wall ``time`` stamp (foreign/legacy writer) is aged
+    by the heartbeat FILE's mtime — never by the per-process mono clock,
+    whose epoch is the writer's start and means nothing cross-rank."""
+    base = str(tmp_path / "ck")
+    now = 1030.0
+    # rank 0: no wall stamp, mono ancient (would read as ~1030s "old" if a
+    # broken implementation compared it to now); mtime says 130s
+    p0 = coord.heartbeat_path(base, 0)
+    with open(p0, "w", encoding="utf-8") as fh:
+        json.dump({"rank": 0, "iteration": 36, "mono": 1.5}, fh)
+    os.utime(p0, (now - 130.0, now - 130.0))
+    # rank 1: fresh wall stamp wins even though mono is equally ancient
+    p1 = coord.heartbeat_path(base, 1)
+    with open(p1, "w", encoding="utf-8") as fh:
+        json.dump({"rank": 1, "iteration": 40, "time": now - 5.0,
+                   "mono": 1.5}, fh)
+    os.utime(p1, (now - 500.0, now - 500.0))  # stale mtime must NOT matter
+
+    stale = coord.stale_ranks(base, 2, 60.0, now=now)
+    assert [s[0] for s in stale] == [0]
+    assert stale[0][1] == pytest.approx(130.0, abs=1.0)
+    assert stale[0].evidence["age_source"] == "mtime"
+
+    # the direct unit contract, including the missing-file terminal case
+    with open(p1, encoding="utf-8") as fh:
+        blob = json.load(fh)
+    assert coord.heartbeat_age(p1, blob, now) == (pytest.approx(5.0), "wall")
+    gone = str(tmp_path / "ck.hb.rank9.json")
+    assert coord.heartbeat_age(gone, {}, now) == (None, "missing")
+
+
+def test_actions_for_verdict_decision_table():
+    """flexctl's side of the contract: only a dead verdict WITH age
+    evidence reshards; a missing heartbeat file (age None) is
+    startup-ambiguous and is demoted to watch, like every advisory
+    verdict."""
+    summary = {"verdicts": [
+        {"rank": 1, "verdict": "dead", "why": "stale",
+         "evidence": {"age_s": 130.0}},
+        {"rank": 2, "verdict": "dead", "why": "no file",
+         "evidence": {"age_s": None}},
+        {"rank": 0, "verdict": "straggler", "why": "slow", "evidence": {}},
+        {"rank": 0, "verdict": "stall", "why": "collapsed", "evidence": {}},
+        {"rank": 3, "verdict": "skew", "why": "behind", "evidence": {}},
+    ]}
+    acts = {(a["rank"], a["verdict"]): a["action"]
+            for a in podwatch.actions_for(summary)}
+    assert acts == {
+        (1, "dead"): "drain_survivors",
+        (2, "dead"): "watch",
+        (0, "straggler"): "watch",
+        (0, "stall"): "watch",
+        (3, "skew"): "watch",
+    }
+    assert podwatch.actions_for({}) == []
+
+    # against the golden dead fixture: the stale rank reshards, the
+    # missing-heartbeat rank stays advisory, and evidence carries the
+    # clock that judged it
+    summary = podwatch.pod_summary(os.path.join(GOLDEN, "dead"), now=NOW)
+    acts = {a["rank"]: a["action"] for a in podwatch.actions_for(summary)
+            if a["verdict"] == "dead"}
+    assert acts == {1: "drain_survivors", 2: "watch"}
+    stale = [v for v in summary["verdicts"]
+             if v["verdict"] == "dead" and v["rank"] == 1][0]
+    assert stale["evidence"]["age_source"] == "wall"
